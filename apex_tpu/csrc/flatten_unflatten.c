@@ -1,0 +1,171 @@
+/* apex_tpu._apex_C — host-side flat-buffer pack/unpack.
+ *
+ * Native-path parity with the reference's apex_C extension
+ * (csrc/flatten_unflatten.cpp, which wraps torch's
+ * _flatten_dense_tensors/_unflatten_dense_tensors for DDP bucket
+ * packing).  Torch-free: operates on any objects exporting the CPython
+ * buffer protocol (numpy arrays, torch CPU tensors, memoryviews), so it
+ * serves the torch-CPU DDP shim and the host side of the JAX path alike.
+ *
+ * flatten(seq)            -> bytearray holding the concatenated bytes
+ * flatten_into(seq, dst)  -> packs into caller-provided writable buffer
+ * unflatten(src, sizes)   -> list of memoryview slices over src
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+static int
+get_contig_buffer(PyObject *obj, Py_buffer *view, int writable)
+{
+    int flags = PyBUF_C_CONTIGUOUS | (writable ? PyBUF_WRITABLE : PyBUF_SIMPLE);
+    if (PyObject_GetBuffer(obj, view, flags) != 0)
+        return -1;
+    return 0;
+}
+
+static PyObject *
+apexc_flatten_into(PyObject *self, PyObject *args)
+{
+    PyObject *seq_obj, *dst_obj;
+    if (!PyArg_ParseTuple(args, "OO", &seq_obj, &dst_obj))
+        return NULL;
+    PyObject *seq = PySequence_Fast(seq_obj, "flatten_into: first arg must be a sequence");
+    if (seq == NULL)
+        return NULL;
+
+    Py_buffer dst;
+    if (get_contig_buffer(dst_obj, &dst, 1) != 0) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t off = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(seq, i);
+        Py_buffer src;
+        if (get_contig_buffer(item, &src, 0) != 0)
+            goto fail;
+        if (off + src.len > dst.len) {
+            PyBuffer_Release(&src);
+            PyErr_Format(PyExc_ValueError,
+                         "flatten_into: destination too small (need > %zd bytes)",
+                         (Py_ssize_t)(off + src.len));
+            goto fail;
+        }
+        memcpy((char *)dst.buf + off, src.buf, src.len);
+        off += src.len;
+        PyBuffer_Release(&src);
+    }
+    PyBuffer_Release(&dst);
+    Py_DECREF(seq);
+    return PyLong_FromSsize_t(off);
+fail:
+    PyBuffer_Release(&dst);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+static PyObject *
+apexc_flatten(PyObject *self, PyObject *args)
+{
+    PyObject *seq_obj;
+    if (!PyArg_ParseTuple(args, "O", &seq_obj))
+        return NULL;
+    PyObject *seq = PySequence_Fast(seq_obj, "flatten: arg must be a sequence");
+    if (seq == NULL)
+        return NULL;
+
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t total = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_buffer src;
+        if (get_contig_buffer(PySequence_Fast_GET_ITEM(seq, i), &src, 0) != 0) {
+            Py_DECREF(seq);
+            return NULL;
+        }
+        total += src.len;
+        PyBuffer_Release(&src);
+    }
+
+    PyObject *out = PyByteArray_FromStringAndSize(NULL, total);
+    if (out == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    char *dst = PyByteArray_AS_STRING(out);
+    Py_ssize_t off = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_buffer src;
+        if (get_contig_buffer(PySequence_Fast_GET_ITEM(seq, i), &src, 0) != 0) {
+            Py_DECREF(out);
+            Py_DECREF(seq);
+            return NULL;
+        }
+        memcpy(dst + off, src.buf, src.len);
+        off += src.len;
+        PyBuffer_Release(&src);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+static PyObject *
+apexc_unflatten(PyObject *self, PyObject *args)
+{
+    PyObject *src_obj, *sizes_obj;
+    if (!PyArg_ParseTuple(args, "OO", &src_obj, &sizes_obj))
+        return NULL;
+    PyObject *sizes = PySequence_Fast(sizes_obj, "unflatten: sizes must be a sequence");
+    if (sizes == NULL)
+        return NULL;
+
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(sizes);
+    PyObject *result = PyList_New(n);
+    if (result == NULL) {
+        Py_DECREF(sizes);
+        return NULL;
+    }
+    Py_ssize_t off = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_ssize_t sz = PyLong_AsSsize_t(PySequence_Fast_GET_ITEM(sizes, i));
+        if (sz < 0 && PyErr_Occurred())
+            goto fail;
+        PyObject *mv = PyObject_CallMethod(src_obj, "__getitem__", "N",
+                                           PySlice_New(PyLong_FromSsize_t(off),
+                                                       PyLong_FromSsize_t(off + sz),
+                                                       NULL));
+        if (mv == NULL)
+            goto fail;
+        PyList_SET_ITEM(result, i, mv);
+        off += sz;
+    }
+    Py_DECREF(sizes);
+    return result;
+fail:
+    Py_DECREF(result);
+    Py_DECREF(sizes);
+    return NULL;
+}
+
+static PyMethodDef ApexCMethods[] = {
+    {"flatten", apexc_flatten, METH_VARARGS,
+     "flatten(seq) -> bytearray: concatenate the bytes of contiguous buffers."},
+    {"flatten_into", apexc_flatten_into, METH_VARARGS,
+     "flatten_into(seq, dst) -> nbytes: pack buffers into a writable buffer."},
+    {"unflatten", apexc_unflatten, METH_VARARGS,
+     "unflatten(src, sizes) -> list of slices of src with the given byte sizes."},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef apexc_module = {
+    PyModuleDef_HEAD_INIT, "_apex_C",
+    "Host-side flat-buffer pack/unpack (apex_C parity).", -1, ApexCMethods
+};
+
+PyMODINIT_FUNC
+PyInit__apex_C(void)
+{
+    return PyModule_Create(&apexc_module);
+}

@@ -1,0 +1,20 @@
+"""apex_tpu.ops — Pallas TPU kernels + pure-jnp oracle twins.
+
+This is the rebuild of the reference's native kernel layer (``csrc/`` and
+``apex/contrib/csrc/``).  Every fused kernel ships with a jnp reference
+implementation (the "oracle"); tests assert kernel ≡ oracle, mirroring the
+reference's fused-vs-eager test pattern.
+"""
+from .layer_norm import (
+    layer_norm,
+    rms_norm,
+    layer_norm_reference,
+    rms_norm_reference,
+)
+
+__all__ = [
+    "layer_norm",
+    "rms_norm",
+    "layer_norm_reference",
+    "rms_norm_reference",
+]

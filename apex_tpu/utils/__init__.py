@@ -1,0 +1,17 @@
+from .common import (
+    interpret_mode,
+    on_tpu,
+    round_up,
+    pad_rows,
+    cdiv,
+    tree_ravel,
+)
+
+__all__ = [
+    "interpret_mode",
+    "on_tpu",
+    "round_up",
+    "pad_rows",
+    "cdiv",
+    "tree_ravel",
+]

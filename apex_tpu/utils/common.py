@@ -1,0 +1,54 @@
+"""Shared helpers for apex_tpu.
+
+Pallas kernels compile natively on TPU and run in interpret mode everywhere
+else (CPU CI), mirroring the reference's "fused kernel vs eager fallback"
+dispatch (e.g. ``apex/normalization/fused_layer_norm.py :: FusedLayerNorm``
+falls back to ``F.layer_norm`` on CPU tensors).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+
+@functools.cache
+def on_tpu() -> bool:
+    """True when the default JAX backend is a real TPU."""
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Whether pallas_call should run in interpret mode (non-TPU backends)."""
+    return not on_tpu()
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    return cdiv(x, multiple) * multiple
+
+
+def pad_rows(x: jax.Array, multiple: int) -> tuple[jax.Array, int]:
+    """Zero-pad the leading dim of 2D ``x`` to a multiple; returns (padded, orig_rows)."""
+    rows = x.shape[0]
+    padded = round_up(max(rows, 1), multiple)
+    if padded != rows:
+        x = jnp.pad(x, ((0, padded - rows), (0, 0)))
+    return x, rows
+
+
+def tree_ravel(tree):
+    """Flatten a pytree of arrays into one 1-D buffer plus an unravel fn.
+
+    TPU-native analog of the reference's flat-buffer pack/unpack
+    (``csrc/flatten_unflatten.cpp :: apex_C.flatten/unflatten``).
+    """
+    return jax.flatten_util.ravel_pytree(tree)

@@ -1,0 +1,25 @@
+"""Zero-nvcc build for apex-tpu.
+
+The reference (shawnwang18/apex ``setup.py :: ext_modules``) gates ~25 CUDA
+extensions behind flags like ``--cpp_ext --cuda_ext --fmha``.  Here the compute
+path is Pallas (JIT, no compile step); the only native code is an optional
+plain-C++ host extension (``apex_tpu/csrc``) providing flat-buffer pack/unpack
+parity with the reference's ``apex_C`` (csrc/flatten_unflatten.cpp).  Build it
+with ``APEX_TPU_CPP_EXT=1 pip install .``; everything degrades gracefully to
+pure Python/NumPy when absent.  North star: ``pip install .`` succeeds with
+zero nvcc — there is no CUDA anywhere in this build.
+"""
+import os
+from setuptools import setup, Extension
+
+ext_modules = []
+if os.environ.get("APEX_TPU_CPP_EXT", "0") == "1":
+    ext_modules.append(
+        Extension(
+            "apex_tpu._apex_C",
+            sources=["apex_tpu/csrc/flatten_unflatten.c"],
+            extra_compile_args=["-O3"],
+        )
+    )
+
+setup(ext_modules=ext_modules)

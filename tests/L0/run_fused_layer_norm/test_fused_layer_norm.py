@@ -1,0 +1,116 @@
+"""Fused LayerNorm/RMSNorm kernel vs jnp-oracle tests.
+
+Mirrors the reference's ``tests/L0/run_fused_layer_norm/test_fused_layer_norm.py``
+(fused CUDA kernel vs ``torch.nn.LayerNorm`` within dtype tolerances), here
+Pallas-interpret vs pure jnp, including gradients.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import (
+    layer_norm,
+    layer_norm_reference,
+    rms_norm,
+    rms_norm_reference,
+)
+
+SHAPES = [(4, 16, 256), (3, 384), (16, 1024)]
+ODD_SHAPES = [(4, 65), (2, 3, 100)]  # H % 128 != 0 -> jnp fallback path
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else dict(rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("shape", SHAPES + ODD_SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("affine", [True, False])
+def test_layer_norm_forward(shape, dtype, affine):
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, shape, dtype)
+    h = shape[-1]
+    w = jax.random.normal(k2, (h,), jnp.float32) if affine else None
+    b = jax.random.normal(k3, (h,), jnp.float32) if affine else None
+    got = layer_norm(x, w, b)
+    want = layer_norm_reference(x, w, b)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("shape", SHAPES + ODD_SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rms_norm_forward(shape, dtype):
+    key = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, shape, dtype)
+    w = jax.random.normal(k2, (shape[-1],), jnp.float32)
+    got = rms_norm(x, w)
+    want = rms_norm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("shape", [(16, 256), (3, 384)])
+def test_layer_norm_grads(shape):
+    key = jax.random.PRNGKey(2)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, shape, jnp.float32)
+    h = shape[-1]
+    w = 1.0 + 0.1 * jax.random.normal(k2, (h,), jnp.float32)
+    b = 0.1 * jax.random.normal(k3, (h,), jnp.float32)
+
+    def loss_fused(x, w, b):
+        return jnp.sum(jnp.sin(layer_norm(x, w, b)))
+
+    def loss_ref(x, w, b):
+        return jnp.sum(jnp.sin(layer_norm_reference(x, w, b)))
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for gf, gr in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("shape", [(16, 256), (5, 512)])
+def test_rms_norm_grads(shape):
+    key = jax.random.PRNGKey(3)
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, shape, jnp.float32)
+    w = 1.0 + 0.1 * jax.random.normal(k2, (shape[-1],), jnp.float32)
+
+    def loss_fused(x, w):
+        return jnp.sum(jnp.cos(rms_norm(x, w)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.cos(rms_norm_reference(x, w)))
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1))(x, w)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    for gf, gr in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_normalized_shape_multi_dim():
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 3, 4, 64))
+    w = jnp.ones((4, 64))
+    b = jnp.zeros((4, 64))
+    got = layer_norm(x, w, b, normalized_shape=(4, 64))
+    x2 = x.reshape(6, 256)
+    want = layer_norm_reference(x2, w.reshape(-1), b.reshape(-1)).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_under_jit():
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 256))
+    w = jnp.ones((256,))
+    b = jnp.zeros((256,))
+    jitted = jax.jit(lambda x: layer_norm(x, w, b))
+    np.testing.assert_allclose(np.asarray(jitted(x)),
+                               np.asarray(layer_norm_reference(x, w, b)),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,14 @@
+"""Test harness config: force an 8-device CPU mesh.
+
+Mirrors the reference's multi-process-on-one-host distributed test pattern
+(``apex/transformer/testing/distributed_test_base.py``): we get N logical
+devices on a single host so TP/PP/DP logic is exercised without hardware.
+
+Note: the axon TPU plugin force-registers itself via sitecustomize and
+overrides JAX_PLATFORMS, so we must flip jax.config *after* import (verified:
+env-var routes are ignored in this image).
+"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
