@@ -1,0 +1,74 @@
+"""MultiTensorApply parity shim (reference:
+``apex/multi_tensor_apply/multi_tensor_apply.py :: MultiTensorApply``).
+
+The reference's applier hands a chunked tensor-list metadata struct to a CUDA
+kernel.  Here tensor lists are raveled into one flat buffer and the fused
+Pallas op runs over it; chunking is the kernel grid, so ``chunk_size`` is kept
+only for signature parity.  Because JAX is functional, appliers RETURN their
+outputs instead of writing in place; the overflow buffer becomes a returned
+fp32 flag.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import fused_update as _fu
+from apex_tpu.utils import tree_ravel
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier",
+           "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm"]
+
+
+def _ravel_list(tensors: Sequence[jax.Array]):
+    return tree_ravel(list(tensors))
+
+
+def multi_tensor_scale(noop_flag, tensor_lists, scale):
+    """[inputs] -> ([outputs], found_inf).  Parity: amp_C.multi_tensor_scale."""
+    inputs = tensor_lists[0]
+    flat, unravel = _ravel_list(inputs)
+    out, flag = _fu.fused_scale(flat, scale)
+    return unravel(out), jnp.maximum(jnp.asarray(noop_flag, jnp.float32), flag)
+
+
+def multi_tensor_axpby(noop_flag, tensor_lists, a, b):
+    """[xs, ys] -> ([outs], found_inf).  Parity: amp_C.multi_tensor_axpby."""
+    xs, ys = tensor_lists[0], tensor_lists[1]
+    xf, unravel = _ravel_list(xs)
+    yf, _ = _ravel_list(ys)
+    out, flag = _fu.fused_axpby(a, xf, b, yf)
+    return unravel(out), jnp.maximum(jnp.asarray(noop_flag, jnp.float32), flag)
+
+
+def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
+    """Global (and optionally per-tensor) L2 norm of a tensor list.
+
+    Parity: ``amp_C.multi_tensor_l2norm``.
+    """
+    tensors = tensor_lists[0]
+    flat, _ = _ravel_list(tensors)
+    gnorm = _fu.fused_l2norm(flat)
+    if per_tensor:
+        per = jnp.stack([jnp.sqrt(jnp.sum(jnp.square(
+            t.astype(jnp.float32)))) for t in tensors])
+        return gnorm, per
+    return gnorm, None
+
+
+class MultiTensorApply:
+    """Callable shim: ``applier(op, noop_flag, tensor_lists, *args)``."""
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        return op(noop_flag, tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply()

@@ -11,10 +11,28 @@ from .layer_norm import (
     layer_norm_reference,
     rms_norm_reference,
 )
+from .fused_update import (
+    fused_scale,
+    fused_axpby,
+    fused_l2norm,
+    fused_adam_flat,
+    fused_adagrad_flat,
+    fused_sgd_flat,
+    fused_lamb_phase1_flat,
+    adam_reference,
+)
 
 __all__ = [
     "layer_norm",
     "rms_norm",
     "layer_norm_reference",
     "rms_norm_reference",
+    "fused_scale",
+    "fused_axpby",
+    "fused_l2norm",
+    "fused_adam_flat",
+    "fused_adagrad_flat",
+    "fused_sgd_flat",
+    "fused_lamb_phase1_flat",
+    "adam_reference",
 ]

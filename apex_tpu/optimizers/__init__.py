@@ -1,0 +1,15 @@
+"""Fused optimizers (reference: ``apex/optimizers``).
+
+Each optimizer runs its whole update as one fused program over a flat fp32
+master buffer per param group — the TPU-native equivalent of the reference's
+multi-tensor kernel launches (see :mod:`apex_tpu.ops.fused_update`).
+"""
+from apex_tpu.optimizers.base import FusedOptimizerBase
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
+
+__all__ = ["FusedOptimizerBase", "FusedAdam", "FusedSGD", "FusedLAMB",
+           "FusedAdagrad", "FusedNovoGrad"]

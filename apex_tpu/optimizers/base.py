@@ -1,0 +1,164 @@
+"""Shared machinery for the fused optimizers.
+
+The reference's fused optimizers (``apex/optimizers/*``) are
+``torch.optim.Optimizer`` subclasses whose ``step`` makes one multi-tensor
+kernel launch per (param-group, dtype) pair.  The TPU-native design keeps the
+same public shape — construct with params (or param-group dicts), call
+``step(grads)`` — but the state is a flat fp32 master buffer per group
+(raveled pytree), and a step is ONE jitted program built around the Pallas
+fused-update kernels in :mod:`apex_tpu.ops.fused_update`.
+
+Differences from torch semantics, by design (functional JAX):
+* gradients are passed to ``step(grads)`` explicitly (no ``.grad`` fields);
+* ``step`` returns the updated params pytree (in the original dtypes) —
+  callers thread it through their train loop;
+* ``noop_flag``/``grad_scale`` keyword args plumb amp's overflow-skip and
+  unscale directly into the update kernel (no host sync).
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils import tree_ravel
+
+__all__ = ["FusedOptimizerBase"]
+
+
+def _leaf_sizes(tree) -> tuple[int, ...]:
+    return tuple(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+class _Group:
+    """One parameter group: flat fp32 master + per-leaf layout info."""
+
+    def __init__(self, params, options: dict[str, Any]):
+        flat, unravel = tree_ravel(params)
+        # Explicit copy: the master buffer is donated every step, and ravel of
+        # a single fp32 leaf can alias the caller's param array.
+        self.master = jnp.array(flat, dtype=jnp.float32, copy=True)
+        self.unravel = unravel
+        self.sizes = _leaf_sizes(params)
+        self.offsets = []
+        off = 0
+        for s in self.sizes:
+            self.offsets.append(off)
+            off += s
+        self.numel = off
+        self.options = dict(options)
+        self.state: dict[str, jax.Array] = {}
+
+    def params(self):
+        return self.unravel(self.master)
+
+    def ravel_grads(self, grads):
+        gflat, _ = tree_ravel(grads)
+        return gflat
+
+    def per_leaf_sq_norms(self, flat: jax.Array) -> jax.Array:
+        """Per-tensor sum-of-squares over a flat buffer (static slices)."""
+        return jnp.stack([
+            jnp.sum(jnp.square(jax.lax.dynamic_slice_in_dim(flat, off, size)))
+            for off, size in zip(self.offsets, self.sizes)
+        ])
+
+    def broadcast_per_leaf(self, scalars: jax.Array) -> jax.Array:
+        """Expand a (num_leaves,) vector to a flat per-element buffer."""
+        return jnp.repeat(scalars, jnp.asarray(self.sizes),
+                          total_repeat_length=self.numel)
+
+
+class FusedOptimizerBase:
+    """Base for FusedAdam/FusedLAMB/FusedSGD/FusedNovoGrad/FusedAdagrad.
+
+    ``params`` is a pytree of arrays, or a list of dicts
+    ``{"params": pytree, **per_group_overrides}`` (torch param-group parity,
+    reference: ``apex/optimizers/fused_adam.py :: FusedAdam.__init__``).
+    """
+
+    def __init__(self, params, defaults: dict[str, Any]):
+        self.defaults = dict(defaults)
+        if isinstance(params, (list, tuple)) and params and \
+                isinstance(params[0], dict):
+            groups = []
+            for g in params:
+                opts = dict(defaults)
+                opts.update({k: v for k, v in g.items() if k != "params"})
+                groups.append(_Group(g["params"], opts))
+        else:
+            groups = [_Group(params, dict(defaults))]
+        self.param_groups = groups
+        self._step_count = 0
+        for g in self.param_groups:
+            self._init_group_state(g)
+
+    # -- subclass interface -------------------------------------------------
+    def _init_group_state(self, group: _Group) -> None:
+        raise NotImplementedError
+
+    def _step_group(self, group: _Group, gflat: jax.Array, step: int,
+                    noop_flag, grad_scale) -> None:
+        """Update group.master and group.state in place (jitted inside)."""
+        raise NotImplementedError
+
+    # -- public API ---------------------------------------------------------
+    @property
+    def step_count(self) -> int:
+        return self._step_count
+
+    def step(self, grads, *, noop_flag=0.0, grad_scale=1.0):
+        """Apply one optimizer step.
+
+        ``grads``: pytree matching the params (single group) or a sequence of
+        pytrees (one per group).  Returns the updated params (same structure/
+        dtypes as construction time).
+        """
+        if len(self.param_groups) == 1:
+            grads_list: Sequence = [grads]
+        else:
+            grads_list = list(grads)
+            if len(grads_list) != len(self.param_groups):
+                raise ValueError(
+                    f"expected {len(self.param_groups)} grad pytrees, got "
+                    f"{len(grads_list)}")
+        self._step_count += 1
+        for group, g in zip(self.param_groups, grads_list):
+            gflat = group.ravel_grads(g)
+            self._step_group(group, gflat, self._step_count, noop_flag,
+                             grad_scale)
+        outs = [g.params() for g in self.param_groups]
+        return outs[0] if len(outs) == 1 else outs
+
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        """No-op (grads are explicit in JAX); kept for API parity."""
+
+    # -- checkpointing (parity: torch optimizer state_dict contract) --------
+    def state_dict(self) -> dict:
+        # Copies: internal buffers are donated on the next step; a checkpoint
+        # must outlive that.
+        return {
+            "step": self._step_count,
+            "groups": [
+                {
+                    "master": jnp.array(g.master, copy=True),
+                    "state": {k: jnp.array(v, copy=True)
+                              for k, v in g.state.items()},
+                    "options": dict(g.options),
+                }
+                for g in self.param_groups
+            ],
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self._step_count = int(sd["step"])
+        if len(sd["groups"]) != len(self.param_groups):
+            raise ValueError("param_groups mismatch in load_state_dict")
+        for g, gs in zip(self.param_groups, sd["groups"]):
+            # Copies: loaded buffers will be donated on the next step and must
+            # not alias the checkpoint arrays the caller still holds.
+            g.master = jnp.array(gs["master"], dtype=jnp.float32, copy=True)
+            g.state = {k: jnp.array(v, copy=True)
+                       for k, v in gs["state"].items()}
+            g.options.update(gs.get("options", {}))

@@ -1,0 +1,46 @@
+"""FusedAdagrad (parity: ``apex/optimizers/fused_adagrad.py`` over
+``amp_C.multi_tensor_adagrad``, csrc/multi_tensor_adagrad.cu)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_update import fused_adagrad_flat
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+__all__ = ["FusedAdagrad"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("w_mode",))
+def _adagrad_step(p, h, g, lr, eps, weight_decay, noop_flag, grad_scale, *,
+                  w_mode):
+    return fused_adagrad_flat(p, g, h, lr=lr, eps=eps,
+                              weight_decay=weight_decay, w_mode=w_mode,
+                              noop_flag=noop_flag, grad_scale=grad_scale)
+
+
+class FusedAdagrad(FusedOptimizerBase):
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, adagrad_w_mode=False):
+        defaults = dict(lr=lr, eps=eps, weight_decay=weight_decay)
+        self.adagrad_w_mode = bool(adagrad_w_mode)
+        super().__init__(params, defaults)
+
+    def _init_group_state(self, group):
+        group.state = {"sum": jnp.zeros_like(group.master)}
+
+    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
+        o = group.options
+        p, h = _adagrad_step(
+            group.master, group.state["sum"], gflat,
+            jnp.asarray(o["lr"], jnp.float32),
+            jnp.asarray(o["eps"], jnp.float32),
+            jnp.asarray(o["weight_decay"], jnp.float32),
+            jnp.asarray(noop_flag, jnp.float32),
+            jnp.asarray(grad_scale, jnp.float32),
+            w_mode=self.adagrad_w_mode)
+        group.master = p
+        group.state["sum"] = h

@@ -1,0 +1,68 @@
+"""FusedAdam — one Pallas kernel per step over a flat master buffer.
+
+Parity: ``apex/optimizers/fused_adam.py :: FusedAdam`` (driving
+``amp_C.multi_tensor_adam``, csrc/multi_tensor_adam.cu :: AdamFunctor).
+``adam_w_mode=True`` gives AdamW (decoupled decay), matching the reference
+default.  CUDA-specific knobs (``capturable``, ``master_weights``) are
+accepted and ignored — jit capture and fp32 masters are always on here.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_update import fused_adam_flat
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+__all__ = ["FusedAdam"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                   static_argnames=("adam_w_mode", "bias_correction"))
+def _adam_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
+               noop_flag, grad_scale, *, adam_w_mode, bias_correction):
+    return fused_adam_flat(
+        p, g, m, v, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay, step=step, adam_w_mode=adam_w_mode,
+        bias_correction=bias_correction, noop_flag=noop_flag,
+        grad_scale=grad_scale)
+
+
+class FusedAdam(FusedOptimizerBase):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                 weight_decay=0.0, amsgrad=False, set_grad_none=True,
+                 capturable=False, master_weights=False):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad "
+                               "variant.")  # same error as the reference
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay)
+        self.adam_w_mode = bool(adam_w_mode)
+        super().__init__(params, defaults)
+
+    def _init_group_state(self, group):
+        group.state = {"exp_avg": jnp.zeros_like(group.master),
+                       "exp_avg_sq": jnp.zeros_like(group.master)}
+
+    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
+        o = group.options
+        beta1, beta2 = o["betas"]
+        p, m, v = _adam_step(
+            group.master, group.state["exp_avg"], group.state["exp_avg_sq"],
+            gflat,
+            jnp.asarray(step, jnp.float32),
+            jnp.asarray(o["lr"], jnp.float32),
+            jnp.asarray(beta1, jnp.float32),
+            jnp.asarray(beta2, jnp.float32),
+            jnp.asarray(o["eps"], jnp.float32),
+            jnp.asarray(o["weight_decay"], jnp.float32),
+            jnp.asarray(noop_flag, jnp.float32),
+            jnp.asarray(grad_scale, jnp.float32),
+            adam_w_mode=self.adam_w_mode,
+            bias_correction=bool(o["bias_correction"]))
+        group.master = p
+        group.state["exp_avg"] = m
+        group.state["exp_avg_sq"] = v

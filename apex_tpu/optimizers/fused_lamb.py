@@ -1,0 +1,102 @@
+"""FusedLAMB — two-phase LAMB with per-tensor trust ratios.
+
+Parity: ``apex/optimizers/fused_lamb.py :: FusedLAMB`` over
+``amp_C.multi_tensor_l2norm`` + ``amp_C.multi_tensor_lamb``
+(csrc/multi_tensor_lamb.cu).  Phase 1 (elementwise Adam-style direction) runs
+as one Pallas kernel over the flat buffer; per-tensor w/u norms and the
+global-grad-norm clip are static-sliced reductions XLA fuses; phase 2 applies
+``p -= lr * trust_ratio * u`` with the per-tensor ratio broadcast through a
+``jnp.repeat`` over static leaf sizes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_update import fused_lamb_phase1_flat
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+__all__ = ["FusedLAMB"]
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2),
+    static_argnames=("bias_correction", "offsets", "sizes", "use_nvlamb"))
+def _lamb_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
+               max_grad_norm, noop_flag, grad_scale, *, bias_correction,
+               offsets, sizes, use_nvlamb):
+    g32 = g.astype(jnp.float32) * grad_scale
+    # global grad norm clip (reference: first multi_tensor_l2norm launch)
+    gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+    clip = jnp.where(
+        (max_grad_norm > 0) & (gnorm > max_grad_norm),
+        max_grad_norm / (gnorm + 1e-6), 1.0)
+
+    m_new, v_new, u = fused_lamb_phase1_flat(
+        p, g32, m, v, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay, step=step,
+        bias_correction=bias_correction, grad_scale=clip)
+
+    def sq_norms(flat):
+        return jnp.stack([
+            jnp.sum(jnp.square(jax.lax.dynamic_slice_in_dim(flat, off, size)))
+            for off, size in zip(offsets, sizes)])
+
+    w_norm = jnp.sqrt(sq_norms(p))
+    u_norm = jnp.sqrt(sq_norms(u))
+    # NVLAMB variant applies the trust ratio to every param; default LAMB
+    # skips params with zero norm (reference kernel's `use_nvlamb` flag).
+    ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
+                      jnp.float32(1.0))
+    if use_nvlamb:
+        ratio = w_norm / jnp.maximum(u_norm, 1e-12)
+    total = int(p.shape[0])
+    scale = jnp.repeat(ratio, jnp.asarray(sizes), total_repeat_length=total)
+    p_new = p - lr * scale * u
+
+    skip = noop_flag > 0
+    return (jnp.where(skip, p, p_new), jnp.where(skip, m, m_new),
+            jnp.where(skip, v, v_new))
+
+
+class FusedLAMB(FusedOptimizerBase):
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, adam_w_mode=True, grad_averaging=True,
+                 set_grad_none=True, max_grad_norm=1.0, use_nvlamb=False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad "
+                               "variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        max_grad_norm=max_grad_norm)
+        self.use_nvlamb = bool(use_nvlamb)
+        super().__init__(params, defaults)
+
+    def _init_group_state(self, group):
+        group.state = {"exp_avg": jnp.zeros_like(group.master),
+                       "exp_avg_sq": jnp.zeros_like(group.master)}
+
+    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
+        o = group.options
+        beta1, beta2 = o["betas"]
+        p, m, v = _lamb_step(
+            group.master, group.state["exp_avg"], group.state["exp_avg_sq"],
+            gflat,
+            jnp.asarray(step, jnp.float32),
+            jnp.asarray(o["lr"], jnp.float32),
+            jnp.asarray(beta1, jnp.float32),
+            jnp.asarray(beta2, jnp.float32),
+            jnp.asarray(o["eps"], jnp.float32),
+            jnp.asarray(o["weight_decay"], jnp.float32),
+            jnp.asarray(o["max_grad_norm"] or 0.0, jnp.float32),
+            jnp.asarray(noop_flag, jnp.float32),
+            jnp.asarray(grad_scale, jnp.float32),
+            bias_correction=bool(o["bias_correction"]),
+            offsets=tuple(group.offsets), sizes=tuple(group.sizes),
+            use_nvlamb=self.use_nvlamb)
+        group.master = p
+        group.state["exp_avg"] = m
+        group.state["exp_avg_sq"] = v

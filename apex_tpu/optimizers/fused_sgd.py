@@ -1,0 +1,54 @@
+"""FusedSGD (parity: ``apex/optimizers/fused_sgd.py`` over
+``amp_C.multi_tensor_sgd``, csrc/multi_tensor_sgd_kernel.cu)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_update import fused_sgd_flat
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+__all__ = ["FusedSGD"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("nesterov",))
+def _sgd_step(p, buf, g, lr, momentum, dampening, weight_decay, first,
+              noop_flag, grad_scale, *, nesterov):
+    return fused_sgd_flat(
+        p, g, buf, lr=lr, momentum=momentum, dampening=dampening,
+        weight_decay=weight_decay, nesterov=nesterov, first_run=first,
+        noop_flag=noop_flag, grad_scale=grad_scale)
+
+
+class FusedSGD(FusedOptimizerBase):
+    def __init__(self, params, lr, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False,
+                 wd_after_momentum=False, materialize_master_grads=True,
+                 set_grad_none=False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
+                        weight_decay=weight_decay, nesterov=nesterov)
+        super().__init__(params, defaults)
+
+    def _init_group_state(self, group):
+        group.state = {"momentum_buffer": jnp.zeros_like(group.master)}
+
+    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
+        o = group.options
+        p, buf = _sgd_step(
+            group.master, group.state["momentum_buffer"], gflat,
+            jnp.asarray(o["lr"], jnp.float32),
+            jnp.asarray(o["momentum"], jnp.float32),
+            jnp.asarray(o["dampening"], jnp.float32),
+            jnp.asarray(o["weight_decay"], jnp.float32),
+            jnp.asarray(1.0 if step == 1 else 0.0, jnp.float32),
+            jnp.asarray(noop_flag, jnp.float32),
+            jnp.asarray(grad_scale, jnp.float32),
+            nesterov=bool(o["nesterov"]))
+        group.master = p
+        group.state["momentum_buffer"] = buf
