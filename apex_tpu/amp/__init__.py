@@ -1,0 +1,31 @@
+"""apex_tpu.amp — automatic mixed precision (reference: ``apex/amp``).
+
+Public surface parity: ``initialize``, ``scale_loss``, ``master_params``,
+``state_dict``, ``load_state_dict`` plus the functional scaler API that the
+TPU path uses inside jitted train steps (:mod:`apex_tpu.amp.scaler`).
+"""
+from apex_tpu.amp.frontend import (
+    AmpOptimizer,
+    Properties,
+    initialize,
+    load_state_dict,
+    master_params,
+    opt_levels,
+    state_dict,
+)
+from apex_tpu.amp.handle import scale_loss
+from apex_tpu.amp.scaler import (
+    LossScaler,
+    LossScaleState,
+    init_loss_scale,
+    scale_loss_value,
+    unscale_grads,
+    update_scale,
+)
+
+__all__ = [
+    "AmpOptimizer", "Properties", "initialize", "load_state_dict",
+    "master_params", "opt_levels", "state_dict", "scale_loss",
+    "LossScaler", "LossScaleState", "init_loss_scale", "scale_loss_value",
+    "unscale_grads", "update_scale",
+]

@@ -1,0 +1,29 @@
+"""Process-global amp state (parity: ``apex/amp/_amp_state.py``)."""
+from __future__ import annotations
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        self.opt_properties = None
+        self.loss_scalers: list = []
+        self.optimizers: list = []
+
+
+amp_state = AmpState()
+
+
+def maybe_print(msg: str, rank0: bool = False) -> None:
+    if amp_state.verbosity > 0:
+        print(msg)
+
+
+def warn_or_err(msg: str) -> None:
+    if amp_state.hard_override:
+        print("Warning: " + msg)
+    else:
+        raise RuntimeError(msg + "  If you're sure you know what you're "
+                           "doing, supply hard_override=True to "
+                           "amp.initialize.")

@@ -1,0 +1,241 @@
+"""torch-CPU amp shim — lets the reference's training scripts
+(``examples/imagenet/main_amp.py``) run unmodified on this framework.
+
+Reference behavior being mirrored (``apex/amp/_initialize.py``,
+``_process_optimizer.py``, ``scaler.py``):
+
+* O0 — no-op fp32; static loss scale 1.0.
+* O1 — autocast around the model's forward (torch CPU autocast, bf16 —
+  there is no CUDA in this environment), dynamic loss scaling.
+* O2 — model cast to bf16 with BatchNorm kept fp32, fp32 master weights in
+  the patched optimizer, dynamic loss scaling.
+* O3 — pure bf16, static scale 1.0.
+
+``optimizer.step`` is patched to (a) step master weights where applicable
+and (b) skip the step entirely when the last unscale saw inf/nan, halving
+the scale — exactly the reference's skip-on-overflow contract.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import types
+
+import torch
+
+from apex_tpu.amp import _amp_state
+
+__all__ = ["initialize_torch", "torch_scale_loss"]
+
+_DEFAULT_SCALE = 2.0 ** 16
+_GROWTH_INTERVAL = 2000
+
+
+class _TorchScaler:
+    """Dynamic loss scaler over torch tensors (reference: LossScaler)."""
+
+    def __init__(self, loss_scale, min_scale=1.0, max_scale=2.0 ** 24):
+        self.dynamic = loss_scale == "dynamic"
+        self._scale = _DEFAULT_SCALE if self.dynamic else float(loss_scale)
+        self._unskipped = 0
+        self.found_inf = False
+        self._min_scale = min_scale if min_scale is not None else 1.0
+        self._max_scale = max_scale if max_scale is not None else 2.0 ** 24
+
+    def loss_scale(self):
+        return self._scale
+
+    def unscale_grads(self, params):
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is not None:
+                p.grad.mul_(inv)
+                if not torch.isfinite(p.grad).all():
+                    found = True
+        self.found_inf = found
+
+    def update(self):
+        if not self.dynamic:
+            self.found_inf = False
+            return
+        if self.found_inf:
+            self._scale = max(self._scale / 2.0, self._min_scale)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= _GROWTH_INTERVAL:
+                self._scale = min(self._scale * 2.0, self._max_scale)
+                self._unskipped = 0
+        self.found_inf = False
+
+    def state_dict(self):
+        return {"loss_scale": self._scale, "unskipped": self._unskipped,
+                "dynamic": self.dynamic}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["loss_scale"]
+        self._unskipped = sd.get("unskipped", 0)
+        self.dynamic = sd.get("dynamic", self.dynamic)
+
+
+def _cast_module(model: torch.nn.Module, dtype, keep_batchnorm_fp32: bool):
+    """Cast params/buffers to ``dtype``; optionally keep *Norm layers fp32."""
+    norm_types = (torch.nn.modules.batchnorm._BatchNorm,
+                  torch.nn.LayerNorm, torch.nn.GroupNorm)
+    for module in model.modules():
+        if keep_batchnorm_fp32 and isinstance(module, norm_types):
+            continue
+        for name, p in module.named_parameters(recurse=False):
+            p.data = p.data.to(dtype)
+        for name, b in module.named_buffers(recurse=False):
+            if b.is_floating_point():
+                module._buffers[name] = b.to(dtype)
+    return model
+
+
+def _wrap_forward_cast_inputs(model, dtype):
+    orig = model.forward
+
+    @functools.wraps(orig)
+    def forward(*args, **kw):
+        def cast(x):
+            if isinstance(x, torch.Tensor) and x.is_floating_point():
+                return x.to(dtype)
+            return x
+        args = [cast(a) for a in args]
+        kw = {k: cast(v) for k, v in kw.items()}
+        return orig(*args, **kw)
+
+    model.forward = forward
+    return model
+
+
+def _wrap_forward_autocast(model, dtype):
+    orig = model.forward
+
+    @functools.wraps(orig)
+    def forward(*args, **kw):
+        with torch.autocast(device_type="cpu", dtype=dtype):
+            return orig(*args, **kw)
+
+    model.forward = forward
+    return model
+
+
+def _patch_optimizer(optimizer, scaler: _TorchScaler, master_weights: bool):
+    optimizer._amp_scaler = scaler
+    optimizer._amp_stash = types.SimpleNamespace(already_patched=True)
+
+    if master_weights:
+        # fp32 master copy per param; grads land on the 16-bit model params
+        # and are copied (already unscaled) onto the masters before stepping.
+        masters = []
+        for group in optimizer.param_groups:
+            group_masters = []
+            for i, p in enumerate(group["params"]):
+                m = p.detach().clone().float()
+                m.requires_grad_(True)
+                group_masters.append(m)
+            masters.append(group_masters)
+            group["params"] = group_masters
+        optimizer._amp_masters = masters
+
+    if master_weights:
+        # zero_grad must clear the 16-bit MODEL params' grads too (autograd
+        # accumulates there), or stale grads leak into every later step —
+        # the reference patches zero_grad the same way
+        # (apex/amp/_process_optimizer.py).
+        orig_zero = optimizer.zero_grad
+
+        @functools.wraps(orig_zero)
+        def zero_grad(set_to_none=True):
+            orig_zero(set_to_none)
+            for model_group in optimizer._amp_model_groups:
+                for p in model_group:
+                    if p.grad is not None:
+                        if set_to_none:
+                            p.grad = None
+                        else:
+                            p.grad.detach_()
+                            p.grad.zero_()
+
+        optimizer.zero_grad = zero_grad
+
+    orig_step = optimizer.step
+
+    @functools.wraps(orig_step)
+    def step(closure=None):
+        if scaler.found_inf:
+            _amp_state.maybe_print(
+                f"Gradient overflow.  Skipping step, loss scaler reducing "
+                f"loss scale to {scaler._scale / 2.0}")
+            scaler.update()
+            return None
+        if master_weights:
+            for group_masters, model_group in zip(
+                    optimizer._amp_masters, optimizer._amp_model_groups):
+                for m, p in zip(group_masters, model_group):
+                    if p.grad is not None:
+                        m.grad = p.grad.detach().float()
+            out = orig_step(closure)
+            for group_masters, model_group in zip(
+                    optimizer._amp_masters, optimizer._amp_model_groups):
+                for m, p in zip(group_masters, model_group):
+                    p.data.copy_(m.data.to(p.dtype))
+        else:
+            out = orig_step(closure)
+        scaler.update()
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
+def initialize_torch(model, optimizer, props, num_losses=1,
+                     min_loss_scale=None, max_loss_scale=None):
+    """Apply an opt level to a torch module (+ optimizer)."""
+    opt_level = props.opt_level
+    scaler = _TorchScaler(props.loss_scale, min_scale=min_loss_scale,
+                          max_scale=max_loss_scale)
+
+    if opt_level == "O1":
+        _wrap_forward_autocast(model, torch.bfloat16)
+    elif opt_level in ("O2", "O3"):
+        keep_bn = bool(props.keep_batchnorm_fp32) and opt_level == "O2"
+        _cast_module(model, torch.bfloat16, keep_bn)
+        _wrap_forward_cast_inputs(model, torch.bfloat16)
+
+    if optimizer is None:
+        return model
+
+    optimizers = optimizer if isinstance(optimizer, (list, tuple)) \
+        else [optimizer]
+    for opt in optimizers:
+        use_masters = bool(props.master_weights) and opt_level == "O2"
+        if use_masters:
+            opt._amp_model_groups = [list(g["params"])
+                                     for g in opt.param_groups]
+        _patch_optimizer(opt, scaler, use_masters)
+    _amp_state.amp_state.loss_scalers = [scaler]
+    _amp_state.amp_state.optimizers = list(optimizers)
+    return (model, optimizer) if not isinstance(optimizer, (list, tuple)) \
+        else (model, optimizers)
+
+
+@contextlib.contextmanager
+def torch_scale_loss(loss, optimizers, delay_unscale=False):
+    opts = optimizers if isinstance(optimizers, (list, tuple)) \
+        else [optimizers]
+    scaler = getattr(opts[0], "_amp_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    yield loss * scaler.loss_scale()
+    if not delay_unscale:
+        for opt in opts:
+            params = [p for g in getattr(opt, "_amp_model_groups",
+                                         [g["params"]
+                                          for g in opt.param_groups])
+                      for p in g]
+            scaler.unscale_grads(params)

@@ -1,0 +1,304 @@
+"""amp frontend — opt-level Properties + initialize.
+
+Reference: ``apex/amp/frontend.py :: initialize, Properties`` and the
+O0..O3 opt-level classes.  The property matrix is kept verbatim; the only
+TPU-native change is that "fp16" defaults to bfloat16 (the MXU-native 16-bit
+type; fp16 is still selectable via ``cast_model_type=jnp.float16``).
+
+Two entry paths:
+* **JAX path** (the performance path): ``initialize(params, optimizer, ...)``
+  with a params pytree and an ``apex_tpu.optimizers`` instance — returns
+  (cast params, :class:`AmpOptimizer`) where the wrapper owns the loss scaler
+  and plumbs overflow-skip into the fused update kernels.
+* **torch path** (CPU parity for ``examples/imagenet/main_amp.py``): when
+  given a ``torch.nn.Module`` the call dispatches to
+  :mod:`apex_tpu.amp._torch_shim`.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp.scaler import (
+    LossScaler, init_loss_scale, scale_loss_value, unscale_grads,
+    update_scale)
+
+__all__ = ["Properties", "opt_levels", "initialize", "AmpOptimizer",
+           "state_dict", "load_state_dict", "master_params"]
+
+
+class Properties:
+    """Mutable options bag (parity: ``apex/amp/frontend.py :: Properties``)."""
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "patch_torch_functions": False,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+        }
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__ and name in self.__dict__["options"]:
+            return self.__dict__["options"][name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if "options" in self.__dict__ and name in self.__dict__["options"]:
+            self.__dict__["options"][name] = value
+        else:
+            super().__setattr__(name, value)
+
+    def _update(self, **kw):
+        for k, v in kw.items():
+            if v is not None:
+                self.options[k] = v
+        return self
+
+
+class O3:
+    brief = "O3: pure 16-bit training."
+
+    def __call__(self, properties: Properties) -> Properties:
+        return properties._update(
+            enabled=True, opt_level="O3", cast_model_type=jnp.bfloat16,
+            patch_torch_functions=False, keep_batchnorm_fp32=False,
+            master_weights=False, loss_scale=1.0)
+
+
+class O2:
+    brief = "O2: 16-bit model + fp32 master weights + dynamic loss scaling."
+
+    def __call__(self, properties: Properties) -> Properties:
+        return properties._update(
+            enabled=True, opt_level="O2", cast_model_type=jnp.bfloat16,
+            patch_torch_functions=False, keep_batchnorm_fp32=True,
+            master_weights=True, loss_scale="dynamic")
+
+
+class O1:
+    brief = "O1: autocast around compute-bound ops + dynamic loss scaling."
+
+    def __call__(self, properties: Properties) -> Properties:
+        return properties._update(
+            enabled=True, opt_level="O1", cast_model_type=None,
+            patch_torch_functions=True, keep_batchnorm_fp32=None,
+            master_weights=None, loss_scale="dynamic")
+
+
+class O0:
+    brief = "O0: pure fp32 training."
+
+    def __call__(self, properties: Properties) -> Properties:
+        return properties._update(
+            enabled=True, opt_level="O0", cast_model_type=jnp.float32,
+            patch_torch_functions=False, keep_batchnorm_fp32=None,
+            master_weights=False, loss_scale=1.0)
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+
+
+def _is_torch_module(model) -> bool:
+    try:
+        import torch
+        return isinstance(model, torch.nn.Module)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class AmpOptimizer:
+    """Loss-scaling optimizer wrapper produced by :func:`initialize`.
+
+    Composes an ``apex_tpu.optimizers`` instance with a
+    :class:`~apex_tpu.amp.scaler.LossScaler`: ``step(scaled_grads)`` unscales
+    with fused overflow detection, applies the update with the overflow
+    ``noop_flag`` predicated into the kernel, then runs the dynamic-scale
+    schedule — the whole reference ``scale_loss``-exit + patched
+    ``optimizer.step`` flow (SURVEY §3.1) with no host sync.
+    """
+
+    def __init__(self, optimizer, properties: Properties, num_losses=1,
+                 min_loss_scale=None, max_loss_scale=2.0 ** 24):
+        self._optimizer = optimizer
+        self._properties = properties
+        # one scaler per loss (parity: amp's per-loss_id LossScalers)
+        self.loss_scalers = [
+            LossScaler(properties.loss_scale, min_loss_scale=min_loss_scale,
+                       max_loss_scale=max_loss_scale)
+            for _ in range(num_losses)]
+        self._last_found_inf = None
+
+    @property
+    def inner(self):
+        return self._optimizer
+
+    @property
+    def param_groups(self):
+        return self._optimizer.param_groups
+
+    @property
+    def loss_scaler(self):
+        return self.loss_scalers[0]
+
+    def scale(self, loss, loss_id=0):
+        return scale_loss_value(loss, self.loss_scalers[loss_id].state)
+
+    def scale_value(self, loss_id=0) -> float:
+        return self.loss_scalers[loss_id].loss_scale()
+
+    def step(self, scaled_grads, loss_id=0, **kw):
+        scaler = self.loss_scalers[loss_id]
+        st = scaler.state
+        grads, st = unscale_grads(scaled_grads, st)
+        params = self._optimizer.step(grads, noop_flag=st.found_inf, **kw)
+        # device array kept lazily; reading .last_step_skipped syncs, step()
+        # itself never does (the no-host-sync contract).
+        self._last_found_inf = st.found_inf
+        scaler.state = update_scale(
+            st, min_scale=scaler._min_scale, max_scale=scaler._max_scale)
+        return params
+
+    @property
+    def _last_step_skipped(self) -> bool:
+        if self._last_found_inf is None:
+            return False
+        return bool(self._last_found_inf > 0)
+
+    last_step_skipped = _last_step_skipped
+
+    def zero_grad(self, set_to_none: bool = True):
+        self._optimizer.zero_grad(set_to_none)
+
+    def state_dict(self):
+        return {"optimizer": self._optimizer.state_dict(),
+                "loss_scaler": self.loss_scalers[0].state_dict(),
+                "loss_scalers": [s.state_dict() for s in self.loss_scalers]}
+
+    def load_state_dict(self, sd):
+        self._optimizer.load_state_dict(sd["optimizer"])
+        if "loss_scalers" in sd:
+            for s, ssd in zip(self.loss_scalers, sd["loss_scalers"]):
+                s.load_state_dict(ssd)
+        else:
+            self.loss_scalers[0].load_state_dict(sd["loss_scaler"])
+
+
+def _cast_params(params, dtype, keep_fp32_names=()):
+    """Cast a params pytree to ``dtype``, keeping fp32 for matching names."""
+    if dtype is None:
+        return params
+
+    def cast(path, x):
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path).lower()
+        if any(k in name for k in keep_fp32_names):
+            return x
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def initialize(models, optimizers=None, enabled=True, opt_level="O1",
+               cast_model_type=None, patch_torch_functions=None,
+               keep_batchnorm_fp32=None, master_weights=None,
+               loss_scale=None, cast_model_outputs=None, num_losses=1,
+               verbosity=1, min_loss_scale=None, max_loss_scale=2.0 ** 24):
+    """Configure mixed precision (parity: ``apex.amp.initialize``).
+
+    JAX path: ``models`` is a params pytree; returns ``(params, optimizer)``
+    with params cast per the opt level and the optimizer wrapped in
+    :class:`AmpOptimizer`.  torch path: ``models`` is a ``torch.nn.Module``
+    (CPU parity shim).
+    """
+    if not enabled:
+        return (models, optimizers) if optimizers is not None else models
+    if opt_level not in opt_levels:
+        raise RuntimeError(f"Unexpected optimization level {opt_level}")
+
+    # argparse-style string bools (the reference maps these explicitly;
+    # main_amp.py passes --keep-batchnorm-fp32 "False" as a string)
+    def _to_bool(v, name):
+        if isinstance(v, str):
+            if v == "True":
+                return True
+            if v == "False":
+                return False
+            raise RuntimeError(f"{name} must be True/False or a bool, got "
+                               f"{v!r}")
+        return v
+
+    keep_batchnorm_fp32 = _to_bool(keep_batchnorm_fp32,
+                                   "keep_batchnorm_fp32")
+    master_weights = _to_bool(master_weights, "master_weights")
+    if isinstance(loss_scale, str) and loss_scale != "dynamic":
+        loss_scale = float(loss_scale)
+
+    props = opt_levels[opt_level](Properties())
+    props._update(cast_model_type=cast_model_type,
+                  patch_torch_functions=patch_torch_functions,
+                  keep_batchnorm_fp32=keep_batchnorm_fp32,
+                  master_weights=master_weights,
+                  loss_scale=loss_scale)
+    _amp_state.amp_state.opt_properties = props
+    _amp_state.amp_state.verbosity = verbosity
+
+    if _is_torch_module(models):
+        from apex_tpu.amp import _torch_shim
+        return _torch_shim.initialize_torch(
+            models, optimizers, props, num_losses=num_losses,
+            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+
+    # JAX path: params pytree (+ apex_tpu optimizer)
+    keep = ("batchnorm", "bn") if props.keep_batchnorm_fp32 else ()
+    cast = None if props.opt_level == "O1" else props.cast_model_type
+    params = _cast_params(models, cast, keep)
+    if optimizers is None:
+        return params
+    wrapped = AmpOptimizer(optimizers, props, num_losses=num_losses,
+                           min_loss_scale=min_loss_scale,
+                           max_loss_scale=max_loss_scale)
+    _amp_state.amp_state.loss_scalers = list(wrapped.loss_scalers)
+    _amp_state.amp_state.optimizers = [wrapped]
+    return params, wrapped
+
+
+def master_params(optimizer):
+    """Iterate per-parameter fp32 master arrays (parity:
+    ``amp.master_params``, e.g. for ``clip_grad_norm_(amp.master_params(opt),
+    ...)``).  Works for both the JAX optimizers and the torch shim."""
+    inner = getattr(optimizer, "inner", optimizer)
+    groups = getattr(inner, "param_groups", None)
+    if groups and isinstance(groups[0], dict):  # torch optimizer
+        for g in groups:
+            yield from g["params"]
+        return
+    for group in groups:
+        for off, size, shape in zip(group.offsets, group.sizes,
+                                    group.shapes):
+            yield jax.lax.dynamic_slice_in_dim(
+                group.master, off, size).reshape(shape)
+
+
+def state_dict(destination=None):
+    """Persist loss-scaler state (parity: ``amp.state_dict``)."""
+    d = destination if destination is not None else {}
+    for i, s in enumerate(getattr(_amp_state.amp_state, "loss_scalers", [])):
+        d[f"loss_scaler{i}"] = s.state_dict()
+    return d
+
+
+def load_state_dict(state):
+    scalers = getattr(_amp_state.amp_state, "loss_scalers", [])
+    for i, s in enumerate(scalers):
+        key = f"loss_scaler{i}"
+        if key in state:
+            s.load_state_dict(state[key])

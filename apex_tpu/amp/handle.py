@@ -1,0 +1,42 @@
+"""``amp.scale_loss`` context manager (parity: ``apex/amp/handle.py``).
+
+torch path: yields ``loss * scale``; on ``__exit__`` unscales the grads
+sitting on the optimizer's params, detects overflow, and arms the patched
+``optimizer.step`` to skip (the reference flow).
+
+JAX path: yields the scaled loss value.  Gradient unscaling happens inside
+``AmpOptimizer.step`` (functional grads are explicit), so exit is a no-op —
+the ctx manager exists for source-level API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from apex_tpu.amp import _amp_state
+
+__all__ = ["scale_loss"]
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
+               delay_overflow_check=False):
+    try:
+        import torch
+        is_torch = isinstance(loss, torch.Tensor)
+    except ImportError:  # pragma: no cover
+        is_torch = False
+
+    if is_torch:
+        from apex_tpu.amp._torch_shim import torch_scale_loss
+        with torch_scale_loss(loss, optimizers,
+                              delay_unscale=delay_unscale) as scaled:
+            yield scaled
+        return
+
+    # JAX path
+    opt = optimizers[0] if isinstance(optimizers, (list, tuple)) \
+        else optimizers
+    if hasattr(opt, "scale"):
+        yield opt.scale(loss, loss_id=loss_id)
+    else:
+        yield loss

@@ -1,0 +1,150 @@
+"""Legacy manual mixed-precision helpers (reference: ``apex/fp16_utils`` —
+``fp16_optimizer.py :: FP16_Optimizer``, ``loss_scaler.py``, ``fp16util.py``).
+
+These predate amp in the reference and are kept for API parity.  On TPU the
+16-bit type is bfloat16.  ``FP16_Optimizer`` wraps an ``apex_tpu.optimizers``
+instance (which already maintains fp32 masters) with static or dynamic loss
+scaling.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_update import fused_scale
+from apex_tpu.utils import tree_ravel
+
+__all__ = ["FP16_Optimizer", "LossScaler", "DynamicLossScaler",
+           "network_to_half", "BN_convert_float", "prep_param_lists",
+           "master_params_to_model_params", "model_grads_to_master_grads",
+           "to_python_float"]
+
+
+class LossScaler:
+    """Static loss scaler (parity: ``fp16_utils/loss_scaler.py``)."""
+
+    def __init__(self, scale=1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree.map(lambda g: g * (1.0 / self.cur_scale), grads)
+
+    def update_scale(self, overflow):
+        pass
+
+    @staticmethod
+    def has_overflow(grads) -> bool:
+        leaves = jax.tree_util.tree_leaves(grads)
+        return bool(jnp.any(jnp.stack([
+            jnp.any(~jnp.isfinite(g)) for g in leaves])))
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic loss scaler (parity: ``fp16_utils/loss_scaler.py``)."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        super().__init__(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.last_overflow_iter = -1
+        self.cur_iter = 0
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % \
+                self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def network_to_half(params):
+    """Cast a params pytree to bf16 (parity: ``network_to_half`` which wraps
+    a torch net in half with fp32 BN via ``tofp16``/``BN_convert_float``)."""
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+
+def BN_convert_float(params):
+    """Identity for pytrees (BN params are kept fp32 by the module layer)."""
+    return params
+
+
+def prep_param_lists(params):
+    """(model_params, master_params) pair (parity: ``prep_param_lists``)."""
+    master = jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    return params, master
+
+
+def master_params_to_model_params(model_params, master_params):
+    return jax.tree.map(
+        lambda mp, m: m.astype(mp.dtype), model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    return jax.tree.map(lambda g: g.astype(jnp.float32), model_grads)
+
+
+def to_python_float(t) -> float:
+    return float(t)
+
+
+class FP16_Optimizer:
+    """Wraps an ``apex_tpu.optimizers`` optimizer with loss scaling.
+
+    Parity: ``apex/fp16_utils/fp16_optimizer.py :: FP16_Optimizer`` —
+    ``static_loss_scale`` / ``dynamic_loss_scale`` kwargs, overflow-skip.
+    The wrapped optimizer already keeps fp32 masters, so master management
+    collapses into it.
+    """
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            args = dynamic_loss_args or {}
+            self.loss_scaler = DynamicLossScaler(**args)
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.cur_scale
+
+    def scale_loss(self, loss):
+        return loss * self.loss_scaler.cur_scale
+
+    # in torch this is loss.backward() inside; here the caller passes grads
+    def step(self, scaled_grads):
+        # single fused pass: unscale + overflow flag (amp_C.multi_tensor_scale
+        # equivalent); one scalar host read for the imperative overflow API
+        flat, unravel = tree_ravel(scaled_grads)
+        out, flag = fused_scale(flat, 1.0 / self.loss_scaler.cur_scale)
+        params = self.optimizer.step(unravel(out), noop_flag=flag)
+        self.overflow = bool(flag > 0)
+        self.loss_scaler.update_scale(self.overflow)
+        return params
+
+    def zero_grad(self, set_to_none=True):
+        self.optimizer.zero_grad(set_to_none)
+
+    def state_dict(self):
+        return {
+            "optimizer_state_dict": self.optimizer.state_dict(),
+            "cur_scale": self.loss_scaler.cur_scale,
+        }
+
+    def load_state_dict(self, sd):
+        self.optimizer.load_state_dict(sd["optimizer_state_dict"])
+        self.loss_scaler.cur_scale = sd["cur_scale"]

@@ -41,6 +41,8 @@ class _Group:
         self.master = jnp.array(flat, dtype=jnp.float32, copy=True)
         self.unravel = unravel
         self.sizes = _leaf_sizes(params)
+        self.shapes = tuple(tuple(x.shape)
+                            for x in jax.tree_util.tree_leaves(params))
         self.offsets = []
         off = 0
         for s in self.sizes:

@@ -1,0 +1,218 @@
+"""amp tests (reference: ``tests/L0/run_amp`` — opt-level properties,
+loss scaling, checkpointing, overflow-skip)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.scaler import (
+    DEFAULT_GROWTH_INTERVAL, DEFAULT_INIT_SCALE, init_loss_scale,
+    unscale_grads, update_scale)
+from apex_tpu.optimizers import FusedAdam
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {"w": jnp.asarray(rng.randn(64, 32), jnp.float32),
+            "b": jnp.asarray(rng.randn(32), jnp.float32)}
+
+
+class TestOptLevels:
+    def test_o0_properties(self):
+        p = amp.opt_levels["O0"](amp.Properties())
+        assert p.opt_level == "O0"
+        assert p.cast_model_type == jnp.float32
+        assert p.loss_scale == 1.0
+        assert p.master_weights is False
+
+    def test_o1_properties(self):
+        p = amp.opt_levels["O1"](amp.Properties())
+        assert p.patch_torch_functions is True
+        assert p.loss_scale == "dynamic"
+
+    def test_o2_properties(self):
+        p = amp.opt_levels["O2"](amp.Properties())
+        assert p.cast_model_type == jnp.bfloat16
+        assert p.keep_batchnorm_fp32 is True
+        assert p.master_weights is True
+        assert p.loss_scale == "dynamic"
+
+    def test_o3_properties(self):
+        p = amp.opt_levels["O3"](amp.Properties())
+        assert p.keep_batchnorm_fp32 is False
+        assert p.loss_scale == 1.0
+
+    def test_bad_opt_level(self):
+        with pytest.raises(RuntimeError):
+            amp.initialize(_params(), None, opt_level="O4")
+
+    def test_override(self):
+        params, opt = amp.initialize(
+            _params(), FusedAdam(_params()), opt_level="O2",
+            loss_scale=128.0)
+        assert opt.loss_scaler.loss_scale() == 128.0
+
+
+class TestInitializeJax:
+    def test_o2_casts_params(self):
+        params, opt = amp.initialize(_params(), FusedAdam(_params()),
+                                     opt_level="O2")
+        assert params["w"].dtype == jnp.bfloat16
+        assert isinstance(opt, amp.AmpOptimizer)
+
+    def test_o0_keeps_fp32(self):
+        params = amp.initialize(_params(), opt_level="O0")
+        assert params["w"].dtype == jnp.float32
+
+
+class TestDynamicScaler:
+    def test_init(self):
+        s = init_loss_scale("dynamic")
+        assert float(s.loss_scale) == DEFAULT_INIT_SCALE
+
+    def test_static(self):
+        s = init_loss_scale(512.0)
+        assert not s.dynamic
+        s2 = update_scale(s.replace(found_inf=jnp.asarray(1.0)))
+        assert float(s2.loss_scale) == 512.0  # static never changes
+
+    def test_backoff_on_overflow(self):
+        s = init_loss_scale("dynamic")
+        s = s.replace(found_inf=jnp.asarray(1.0, jnp.float32))
+        s2 = update_scale(s)
+        assert float(s2.loss_scale) == DEFAULT_INIT_SCALE * 0.5
+        assert int(s2.growth_tracker) == 0
+
+    def test_growth_after_interval(self):
+        s = init_loss_scale("dynamic").replace(
+            growth_tracker=jnp.asarray(DEFAULT_GROWTH_INTERVAL - 1,
+                                       jnp.int32))
+        s2 = update_scale(s)
+        assert float(s2.loss_scale) == DEFAULT_INIT_SCALE * 2
+        assert int(s2.growth_tracker) == 0
+
+    def test_unscale_detects_inf(self):
+        s = init_loss_scale("dynamic")
+        grads = {"a": jnp.asarray([1.0, jnp.inf]), "b": jnp.ones(3)}
+        out, s2 = unscale_grads(grads, s)
+        assert float(s2.found_inf) == 1.0
+
+    def test_unscale_divides(self):
+        s = init_loss_scale(4.0)
+        grads = {"a": jnp.asarray([8.0, 4.0])}
+        out, s2 = unscale_grads(grads, s)
+        np.testing.assert_allclose(np.asarray(out["a"]), [2.0, 1.0])
+
+    def test_jit_carried(self):
+        # scaler state must flow through jit (the TPU-native requirement)
+        @jax.jit
+        def step(s):
+            return update_scale(s.replace(
+                found_inf=jnp.asarray(1.0, jnp.float32)))
+        s2 = step(init_loss_scale("dynamic"))
+        assert float(s2.loss_scale) == DEFAULT_INIT_SCALE * 0.5
+
+
+class TestAmpOptimizer:
+    def test_overflow_skips_step(self):
+        params = _params()
+        cast, opt = amp.initialize(params, FusedAdam(params, lr=0.1),
+                                   opt_level="O2")
+        bad = {"w": jnp.full((64, 32), jnp.inf, jnp.float32),
+               "b": jnp.ones(32, jnp.float32)}
+        out = opt.step(bad)
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(params["w"]))
+        assert opt._last_step_skipped
+        assert opt.loss_scaler.loss_scale() == DEFAULT_INIT_SCALE * 0.5
+
+    def test_clean_step_applies(self):
+        params = _params()
+        cast, opt = amp.initialize(params, FusedAdam(params, lr=0.1),
+                                   opt_level="O2")
+        scale = opt.loss_scaler.loss_scale()
+        g = {"w": jnp.ones((64, 32), jnp.float32) * scale,
+             "b": jnp.ones(32, jnp.float32) * scale}
+        out = opt.step(g)
+        assert not np.allclose(np.asarray(out["w"]),
+                               np.asarray(params["w"]))
+        assert not opt._last_step_skipped
+
+    def test_scale_loss_ctx(self):
+        params = _params()
+        _, opt = amp.initialize(params, FusedAdam(params), opt_level="O2")
+        loss = jnp.asarray(2.0)
+        with amp.scale_loss(loss, opt) as scaled:
+            assert float(scaled) == 2.0 * opt.loss_scaler.loss_scale()
+
+    def test_state_dict_roundtrip(self):
+        params = _params()
+        _, opt = amp.initialize(params, FusedAdam(params), opt_level="O2")
+        bad = {"w": jnp.full((64, 32), jnp.nan, jnp.float32),
+               "b": jnp.ones(32, jnp.float32)}
+        opt.step(bad)  # halves scale
+        sd = amp.state_dict()
+        assert sd["loss_scaler0"]["loss_scale"] == DEFAULT_INIT_SCALE * 0.5
+        _, opt2 = amp.initialize(params, FusedAdam(params), opt_level="O2")
+        amp.load_state_dict(sd)
+        assert opt2.loss_scaler.loss_scale() == DEFAULT_INIT_SCALE * 0.5
+
+
+class TestEndToEndTraining:
+    def test_o2_loss_decreases(self):
+        """Linear-regression convergence under O2 (bf16 params, dynamic
+        scale) — the minimal analog of the reference L1 cross-product runs."""
+        rng = np.random.RandomState(0)
+        W_true = rng.randn(16, 4).astype(np.float32)
+        X = rng.randn(256, 16).astype(np.float32)
+        Y = X @ W_true
+        params = {"w": jnp.zeros((16, 4), jnp.float32)}
+        cast_params, opt = amp.initialize(params, FusedAdam(params, lr=0.05),
+                                          opt_level="O2")
+
+        def loss_fn(p, scale):
+            pred = jnp.asarray(X, jnp.bfloat16) @ p["w"].astype(jnp.bfloat16)
+            err = (pred.astype(jnp.float32) - Y) ** 2
+            return jnp.mean(err) * scale
+
+        grad_fn = jax.jit(jax.grad(loss_fn))
+        losses = []
+        p = cast_params
+        for i in range(60):
+            scale = jnp.asarray(opt.loss_scaler.loss_scale(), jnp.float32)
+            g = grad_fn(p, scale)
+            losses.append(float(loss_fn(p, 1.0)))
+            p = opt.step(g)
+            p = {"w": p["w"].astype(jnp.bfloat16)}
+        assert losses[-1] < losses[0] * 0.1
+
+
+class TestFP16Utils:
+    def test_fp16_optimizer(self):
+        from apex_tpu.fp16_utils import FP16_Optimizer
+        params = _params()
+        opt = FP16_Optimizer(FusedAdam(params, lr=0.01),
+                             static_loss_scale=8.0)
+        g = {"w": jnp.ones((64, 32)) * 8.0, "b": jnp.ones(32) * 8.0}
+        out = opt.step(g)
+        assert not np.allclose(np.asarray(out["w"]), np.asarray(params["w"]))
+        assert not opt.overflow
+
+    def test_dynamic_overflow(self):
+        from apex_tpu.fp16_utils import FP16_Optimizer
+        params = _params()
+        opt = FP16_Optimizer(FusedAdam(params, lr=0.01),
+                             dynamic_loss_scale=True)
+        scale0 = opt.loss_scale
+        g = {"w": jnp.full((64, 32), jnp.inf), "b": jnp.ones(32)}
+        out = opt.step(g)
+        assert opt.overflow
+        assert opt.loss_scale == scale0 / 2.0
+        np.testing.assert_array_equal(np.asarray(out["w"]),
+                                      np.asarray(params["w"]))
+
+    def test_network_to_half(self):
+        from apex_tpu.fp16_utils import network_to_half
+        p = network_to_half(_params())
+        assert p["w"].dtype == jnp.bfloat16

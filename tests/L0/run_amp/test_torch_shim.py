@@ -1,0 +1,127 @@
+"""torch-CPU shim tests (reference flow: ``examples/imagenet/main_amp.py``)."""
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu import amp
+
+
+def _mlp():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Linear(16, 32), torch.nn.ReLU(),
+        torch.nn.BatchNorm1d(32), torch.nn.Linear(32, 4))
+
+
+def _train(model, opt, steps=30):
+    torch.manual_seed(1)
+    X = torch.randn(128, 16)
+    W = torch.randn(16, 4)
+    Y = X @ W
+    losses = []
+    for _ in range(steps):
+        opt.zero_grad()
+        out = model(X)
+        loss = torch.nn.functional.mse_loss(out.float(), Y)
+        with amp.scale_loss(loss, opt) as scaled:
+            scaled.backward()
+        opt.step()
+        losses.append(loss.item())
+    return losses
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2"])
+def test_loss_decreases(opt_level):
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level=opt_level)
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0] * 0.7, (opt_level, losses[:3], losses[-3:])
+
+
+def test_o2_casts_model_keeps_bn_fp32():
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level="O2")
+    assert model[0].weight.dtype == torch.bfloat16
+    assert model[2].weight.dtype == torch.float32  # BN kept fp32
+
+
+def test_o2_keep_batchnorm_fp32_string_false():
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level="O2",
+                                keep_batchnorm_fp32="False")
+    assert model[2].weight.dtype == torch.bfloat16
+
+
+def test_o2_zero_grad_clears_model_grads():
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level="O2")
+    X = torch.randn(8, 16)
+    loss = model(X).float().pow(2).mean()
+    with amp.scale_loss(loss, opt) as scaled:
+        scaled.backward()
+    opt.step()
+    opt.zero_grad()
+    for p in model.parameters():
+        assert p.grad is None or torch.all(p.grad == 0)
+
+
+def test_o2_grads_do_not_accumulate_across_steps():
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.0)  # lr=0: params frozen
+    model, opt = amp.initialize(model, opt, opt_level="O2",
+                                loss_scale=128.0)
+    X = torch.randn(8, 16)
+
+    def one_grad():
+        opt.zero_grad()
+        loss = model(X).float().pow(2).mean()
+        with amp.scale_loss(loss, opt) as scaled:
+            scaled.backward()
+        opt.step()
+        return [p.grad.clone() for p in model.parameters()
+                if p.grad is not None]
+
+    g1 = one_grad()
+    g2 = one_grad()
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a.float().numpy(), b.float().numpy(),
+                                   atol=1e-3)
+
+
+def test_master_params_iterates_per_param():
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level="O2")
+    masters = list(amp.master_params(opt))
+    assert len(masters) == len(list(model.parameters()))
+    # torch path: clip_grad idiom must work
+    torch.nn.utils.clip_grad_norm_(masters, 1.0)
+
+
+def test_master_params_jax_path_shapes():
+    import jax.numpy as jnp
+    from apex_tpu.optimizers import FusedAdam
+    params = {"w": jnp.ones((4, 8)), "b": jnp.ones(8)}
+    _, opt = amp.initialize(params, FusedAdam(params), opt_level="O2")
+    masters = list(amp.master_params(opt))
+    assert {tuple(m.shape) for m in masters} == {(8,), (4, 8)}
+    assert all(m.dtype == jnp.float32 for m in masters)
+
+
+def test_max_loss_scale_honored():
+    import jax.numpy as jnp
+    from apex_tpu.amp.scaler import update_scale
+    from apex_tpu.optimizers import FusedAdam
+    params = {"w": jnp.ones((8, 8))}
+    _, opt = amp.initialize(params, FusedAdam(params), opt_level="O2",
+                            max_loss_scale=2.0 ** 10)
+    s = opt.loss_scalers[0]
+    s.state = s.state.replace(
+        loss_scale=jnp.asarray(2.0 ** 10, jnp.float32),
+        growth_tracker=jnp.asarray(1999, jnp.int32))
+    s.update_scale()
+    assert s.loss_scale() == 2.0 ** 10

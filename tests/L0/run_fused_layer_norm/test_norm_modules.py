@@ -1,0 +1,84 @@
+"""FusedLayerNorm/FusedRMSNorm module tests (reference:
+``tests/L0/run_fused_layer_norm/test_fused_layer_norm.py`` module cases)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm, FusedRMSNorm, fused_layer_norm, fused_rms_norm)
+from apex_tpu.ops import layer_norm_reference, rms_norm_reference
+
+
+@pytest.mark.parametrize("hidden", [256, 300])
+def test_layer_norm_module(hidden):
+    m = FusedLayerNorm(normalized_shape=hidden)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 7, hidden), jnp.float32)
+    params = m.init(jax.random.key(0), x)
+    y = m.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(layer_norm_reference(x)), atol=1e-5)
+
+
+def test_layer_norm_module_grads():
+    hidden = 256
+    m = FusedLayerNorm(normalized_shape=hidden)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, hidden), jnp.float32)
+    params = m.init(jax.random.key(0), x)
+
+    def loss(p, x):
+        return jnp.sum(m.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params, x)
+    ref_g = jax.grad(
+        lambda p, x: jnp.sum((layer_norm_reference(
+            x, p["params"]["weight"], p["params"]["bias"])) ** 2))(params, x)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                atol=1e-4), g, ref_g)
+
+
+def test_rms_norm_module():
+    hidden = 384
+    m = FusedRMSNorm(normalized_shape=hidden)
+    x = jnp.asarray(np.random.RandomState(1).randn(5, hidden), jnp.float32)
+    params = m.init(jax.random.key(0), x)
+    y = m.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(rms_norm_reference(x)), atol=1e-5)
+
+
+def test_no_affine():
+    m = FusedLayerNorm(normalized_shape=128, elementwise_affine=False)
+    x = jnp.asarray(np.random.RandomState(2).randn(3, 128), jnp.float32)
+    params = m.init(jax.random.key(0), x)
+    assert not jax.tree_util.tree_leaves(params)  # no params
+    y = m.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(layer_norm_reference(x)), atol=1e-5)
+
+
+def test_functional_multidim_normalized_shape():
+    x = jnp.asarray(np.random.RandomState(3).randn(6, 4, 128), jnp.float32)
+    y = fused_layer_norm(x, (4, 128))
+    ref = layer_norm_reference(x.reshape(6, -1)).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+
+
+def test_functional_rms_with_weight():
+    x = jnp.asarray(np.random.RandomState(4).randn(6, 256), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(5).rand(256), jnp.float32)
+    y = fused_rms_norm(x, 256, weight=w)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(rms_norm_reference(x, w)),
+                               atol=1e-5)
+
+
+def test_bf16_input_fp32_params():
+    hidden = 256
+    m = FusedLayerNorm(normalized_shape=hidden)
+    x = jnp.asarray(np.random.RandomState(6).randn(8, hidden), jnp.bfloat16)
+    params = m.init(jax.random.key(0), x)
+    assert params["params"]["weight"].dtype == jnp.float32
+    y = m.apply(params, x)
+    assert y.dtype == jnp.bfloat16
