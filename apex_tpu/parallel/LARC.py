@@ -1,0 +1,77 @@
+"""LARC — layer-wise adaptive rate clipping (reference:
+``apex/parallel/LARC.py :: LARC``).
+
+Wraps an ``apex_tpu.optimizers`` optimizer; before delegating to
+``inner.step`` it rescales each parameter tensor's gradient by the local
+adaptive rate  ``eta * ||p|| / (||g|| + wd * ||p|| + eps)``, clipped to the
+group lr when ``clip=True`` — exactly the reference's algorithm, computed
+per-leaf with XLA-fused reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LARC"]
+
+
+class LARC:
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True,
+                 eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        # Absorb weight decay: the reference zeroes the group's wd and folds
+        # wd*p into the grad BEFORE trust-ratio scaling, so the decay term is
+        # scaled too (apex/parallel/LARC.py :: LARC.step).
+        self._group_wd = []
+        for group in self.optim.param_groups:
+            self._group_wd.append(group.options.get("weight_decay", 0.0))
+            group.options["weight_decay"] = 0.0
+
+    @property
+    def param_groups(self):
+        return self.optim.param_groups
+
+    @property
+    def inner(self):
+        return self.optim
+
+    def state_dict(self):
+        return self.optim.state_dict()
+
+    def load_state_dict(self, sd):
+        self.optim.load_state_dict(sd)
+
+    def zero_grad(self, set_to_none=True):
+        self.optim.zero_grad(set_to_none)
+
+    def _scale_group(self, group, wd, grads):
+        lr = group.options["lr"]
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        scaled = []
+        for g, off, size in zip(leaves, group.offsets, group.sizes):
+            p = jax.lax.dynamic_slice_in_dim(
+                group.master, off, size).reshape(g.shape)
+            g32 = g.astype(jnp.float32)
+            pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+            gn = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive = self.trust_coefficient * pn / \
+                (gn + wd * pn + self.eps)
+            if self.clip:
+                adaptive = jnp.minimum(adaptive / lr, 1.0)
+            # zero-norm params: grad passes through unscaled (reference skips)
+            mult = jnp.where((pn > 0) & (gn > 0), adaptive, 1.0)
+            scaled.append(((g32 + wd * p) * mult).astype(g.dtype))
+        return jax.tree_util.tree_unflatten(treedef, scaled)
+
+    def step(self, grads, **kw):
+        groups = self.optim.param_groups
+        if len(groups) == 1:
+            grads_list = [grads]
+        else:
+            grads_list = list(grads)
+        out = [self._scale_group(g, wd, gr)
+               for g, wd, gr in zip(groups, self._group_wd, grads_list)]
+        return self.optim.step(out[0] if len(groups) == 1 else out, **kw)

@@ -1,0 +1,14 @@
+"""apex_tpu.parallel — data parallelism (reference: ``apex/parallel``).
+
+* :class:`DistributedDataParallel` — bucketed grad psum over the data axis.
+* :class:`SyncBatchNorm` + :func:`convert_syncbn_model` — cross-replica BN.
+* :class:`LARC` — layer-wise adaptive rate clipping.
+"""
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel, flat_allreduce)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm, convert_syncbn_model)
+from apex_tpu.parallel.LARC import LARC
+
+__all__ = ["DistributedDataParallel", "flat_allreduce", "SyncBatchNorm",
+           "convert_syncbn_model", "LARC"]

@@ -1,0 +1,145 @@
+"""SyncBatchNorm — cross-replica batch norm over a mesh axis.
+
+Reference: ``apex/parallel/sync_batchnorm.py`` / ``optimized_sync_batchnorm*``
+over the ``syncbn`` CUDA ext (``csrc/welford.cu``): per-GPU Welford stats,
+allreduce of (mean, var, count), then the BN apply; backward allreduces the
+two reduction terms (Σdy, Σdy·x̂).
+
+TPU-native design: the stats are ``psum`` of (Σx, Σx², n) over the ``data``
+mesh axis inside the jitted step — autodiff of that psum reproduces the
+reference's backward collectives automatically, so there is no hand-written
+backward.  Channel-last layouts are native on TPU (the reference's
+``channel_last=True`` fast path is the default here).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SyncBatchNorm", "convert_syncbn_model"]
+
+
+class SyncBatchNorm(nn.Module):
+    """BatchNorm synchronized across the ``axis_name`` mesh axis.
+
+    Parity kwargs follow ``torch.nn.BatchNorm`` /
+    ``apex.parallel.SyncBatchNorm``: ``momentum`` is the running-stat update
+    rate, ``use_running_average`` selects eval behavior.  ``process_group``
+    maps to ``axis_name`` (+ optional ``axis_index_groups`` subsets — the
+    reference's ``create_syncbn_process_group`` grouping).
+    """
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = "data"
+    axis_index_groups: Any = None
+    channel_last: bool = True  # NHWC; TPU-native layout
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        feat_ax = -1 if self.channel_last else 1
+        reduce_axes = tuple(i for i in range(x.ndim)
+                            if i != (feat_ax % x.ndim))
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+
+        ra_mean = self.variable(
+            "batch_stats", "running_mean",
+            lambda: jnp.zeros((self.num_features,), jnp.float32))
+        ra_var = self.variable(
+            "batch_stats", "running_var",
+            lambda: jnp.ones((self.num_features,), jnp.float32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # Parallel Welford merge (the syncbn ext's numerics,
+            # csrc/welford.cu): local centered stats, then psum-combine —
+            # avoids the catastrophic cancellation of E[x²] − mean².
+            n_local = jnp.asarray(x32.size // self.num_features, jnp.float32)
+            mean_local = jnp.mean(x32, axis=reduce_axes)
+            var_local = jnp.mean(
+                jnp.square(x32 - mean_local.reshape(
+                    [1 if i in reduce_axes else -1
+                     for i in range(x.ndim)])), axis=reduce_axes)
+            sync = self.axis_name is not None and not self.is_initializing()
+            if sync:
+                n, nm = jax.lax.psum(
+                    (n_local, n_local * mean_local), self.axis_name,
+                    axis_index_groups=self.axis_index_groups)
+                mean = nm / n
+                m2 = jax.lax.psum(
+                    n_local * (var_local + jnp.square(mean_local - mean)),
+                    self.axis_name,
+                    axis_index_groups=self.axis_index_groups)
+                var = m2 / n
+            else:
+                n, mean, var = n_local, mean_local, var_local
+            if self.track_running_stats and not self.is_initializing():
+                m = self.momentum
+                # unbiased var for running stats (torch semantics)
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+
+        shape = [1] * x.ndim
+        shape[feat_ax] = self.num_features
+        inv = jax.lax.rsqrt(var + self.eps).reshape(shape)
+        y = (x32 - mean.reshape(shape)) * inv
+        if self.affine:
+            weight = self.param("weight", nn.initializers.ones,
+                                (self.num_features,), jnp.float32)
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.num_features,), jnp.float32)
+            y = y * weight.reshape(shape) + bias.reshape(shape)
+        return y.astype(dtype)
+
+
+def convert_syncbn_model(module, process_group=None, channel_last=False):
+    """Recursively swap BatchNorm for SyncBatchNorm (torch modules only).
+
+    Parity: ``apex.parallel.convert_syncbn_model``.  This is a
+    single-process CPU shim: params/stats are preserved but no cross-process
+    sync occurs (there is no multi-process torch on TPU), so
+    ``process_group``/``channel_last`` are accepted for signature parity and
+    ignored.  Flax models are immutable — instantiate
+    :class:`SyncBatchNorm` directly instead; passing a flax module raises.
+    """
+    try:
+        import torch
+        if isinstance(module, torch.nn.Module):
+            return _convert_torch(module)
+    except ImportError:  # pragma: no cover
+        pass
+    raise TypeError(
+        "convert_syncbn_model converts torch modules; flax models should "
+        "use apex_tpu.parallel.SyncBatchNorm directly (flax modules are "
+        "immutable).")
+
+
+def _convert_torch(module):
+    import torch
+    mod = module
+    if isinstance(module, torch.nn.modules.batchnorm._BatchNorm):
+        # keep torch-side sync off (single-process CPU shim) but preserve
+        # params/stats — the conversion contract from the reference
+        mod = torch.nn.BatchNorm2d(module.num_features, module.eps,
+                                   module.momentum, module.affine,
+                                   module.track_running_stats) \
+            if isinstance(module, torch.nn.BatchNorm2d) else module
+        if module.affine:
+            with torch.no_grad():
+                mod.weight = module.weight
+                mod.bias = module.bias
+        mod.running_mean = module.running_mean
+        mod.running_var = module.running_var
+    for name, child in module.named_children():
+        new = _convert_torch(child)
+        if new is not child:
+            setattr(mod, name, new)
+    return mod
