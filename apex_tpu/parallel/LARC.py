@@ -22,13 +22,6 @@ class LARC:
         self.trust_coefficient = trust_coefficient
         self.clip = clip
         self.eps = eps
-        # Absorb weight decay: the reference zeroes the group's wd and folds
-        # wd*p into the grad BEFORE trust-ratio scaling, so the decay term is
-        # scaled too (apex/parallel/LARC.py :: LARC.step).
-        self._group_wd = []
-        for group in self.optim.param_groups:
-            self._group_wd.append(group.options.get("weight_decay", 0.0))
-            group.options["weight_decay"] = 0.0
 
     @property
     def param_groups(self):
@@ -47,31 +40,53 @@ class LARC:
     def zero_grad(self, set_to_none=True):
         self.optim.zero_grad(set_to_none)
 
-    def _scale_group(self, group, wd, grads):
+    def _scale_group(self, group, wd, grads, grad_scale):
         lr = group.options["lr"]
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         scaled = []
         for g, off, size in zip(leaves, group.offsets, group.sizes):
             p = jax.lax.dynamic_slice_in_dim(
                 group.master, off, size).reshape(g.shape)
-            g32 = g.astype(jnp.float32)
+            # unscale BEFORE the norm/fold so amp loss scaling doesn't
+            # distort the trust ratio or the folded wd*p term
+            g32 = g.astype(jnp.float32) / grad_scale
             pn = jnp.sqrt(jnp.sum(jnp.square(p)))
             gn = jnp.sqrt(jnp.sum(jnp.square(g32)))
             adaptive = self.trust_coefficient * pn / \
                 (gn + wd * pn + self.eps)
             if self.clip:
                 adaptive = jnp.minimum(adaptive / lr, 1.0)
-            # zero-norm params: grad passes through unscaled (reference skips)
-            mult = jnp.where((pn > 0) & (gn > 0), adaptive, 1.0)
-            scaled.append(((g32 + wd * p) * mult).astype(g.dtype))
+            # zero-norm params: grad passes through untouched — no scaling,
+            # no wd fold (reference only acts when both norms are nonzero)
+            apply = (pn > 0) & (gn > 0)
+            mult = jnp.where(apply, adaptive, 1.0)
+            folded = jnp.where(apply, g32 + wd * p, g32)
+            scaled.append((folded * mult).astype(g.dtype))
         return jax.tree_util.tree_unflatten(treedef, scaled)
 
-    def step(self, grads, **kw):
+    def step(self, grads, grad_scale=None, **kw):
+        """Scale grads by the per-param trust ratio, then delegate.
+
+        The reference zeroes each group's ``weight_decay`` and folds
+        ``wd*p`` into the grad before scaling, restoring wd afterwards —
+        same here, so ``state_dict`` still records the true wd.
+        ``grad_scale`` (amp's loss-scale) is consumed here: grads are
+        unscaled before the norm computation, and the inner step runs with
+        scale 1.
+        """
         groups = self.optim.param_groups
         if len(groups) == 1:
             grads_list = [grads]
         else:
             grads_list = list(grads)
-        out = [self._scale_group(g, wd, gr)
-               for g, wd, gr in zip(groups, self._group_wd, grads_list)]
-        return self.optim.step(out[0] if len(groups) == 1 else out, **kw)
+        scale = 1.0 if grad_scale is None else grad_scale
+        saved_wd = [g.options.get("weight_decay", 0.0) for g in groups]
+        try:
+            for g in groups:
+                g.options["weight_decay"] = 0.0
+            out = [self._scale_group(g, wd, gr, scale)
+                   for g, wd, gr in zip(groups, saved_wd, grads_list)]
+            return self.optim.step(out[0] if len(groups) == 1 else out, **kw)
+        finally:
+            for g, wd in zip(groups, saved_wd):
+                g.options["weight_decay"] = wd

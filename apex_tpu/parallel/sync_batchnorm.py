@@ -127,17 +127,24 @@ def _convert_torch(module):
     mod = module
     if isinstance(module, torch.nn.modules.batchnorm._BatchNorm):
         # keep torch-side sync off (single-process CPU shim) but preserve
-        # params/stats — the conversion contract from the reference
-        mod = torch.nn.BatchNorm2d(module.num_features, module.eps,
-                                   module.momentum, module.affine,
-                                   module.track_running_stats) \
-            if isinstance(module, torch.nn.BatchNorm2d) else module
+        # ALL state (params, running stats, num_batches_tracked) — the
+        # conversion contract from the reference.  torch SyncBatchNorm maps
+        # to a plain BatchNorm of the same class layout (BatchNorm2d: its
+        # dominant conv use) so forward works without a process group.
+        cls = type(module)
+        if isinstance(module, torch.nn.SyncBatchNorm):
+            cls = torch.nn.BatchNorm2d
+        mod = cls(module.num_features, module.eps, module.momentum,
+                  module.affine, module.track_running_stats)
         if module.affine:
             with torch.no_grad():
                 mod.weight = module.weight
                 mod.bias = module.bias
         mod.running_mean = module.running_mean
         mod.running_var = module.running_var
+        if module.track_running_stats and \
+                module.num_batches_tracked is not None:
+            mod.num_batches_tracked = module.num_batches_tracked
     for name, child in module.named_children():
         new = _convert_torch(child)
         if new is not child:

@@ -1,0 +1,30 @@
+"""Megatron-style model-parallel toolkit, TPU-native.
+
+Reference: ``apex/transformer`` — tensor/pipeline/sequence parallelism over
+NCCL process groups.  Here the topology is a single ``jax.sharding.Mesh``
+with named axes; "process groups" become mesh axes, NCCL collectives become
+XLA collectives (``psum`` / ``all_gather`` / ``psum_scatter`` / ``ppermute``)
+inside ``shard_map``, and 1F1B p2p becomes collective-permute on the pipe
+axis.  See ``parallel_state`` for the topology API.
+"""
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer import pipeline_parallel
+from apex_tpu.transformer import functional
+from apex_tpu.transformer.enums import (
+    ModelType, LayerType, AttnType, AttnMaskType,
+)
+from apex_tpu.transformer.utils import divide, split_tensor_along_last_dim
+
+__all__ = [
+    "parallel_state",
+    "tensor_parallel",
+    "pipeline_parallel",
+    "functional",
+    "ModelType",
+    "LayerType",
+    "AttnType",
+    "AttnMaskType",
+    "divide",
+    "split_tensor_along_last_dim",
+]

@@ -1,0 +1,4 @@
+"""Pipeline-aware mixed precision (reference: ``apex/transformer/amp``)."""
+from apex_tpu.transformer.amp.grad_scaler import GradScaler
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,24 @@
+"""Fused functional ops (reference: ``apex/transformer/functional``)."""
+from apex_tpu.transformer.functional.fused_softmax import (
+    FusedScaleMaskSoftmax,
+    ScaledUpperTriangMaskedSoftmax,
+    ScaledMaskedSoftmax,
+    ScaledSoftmax,
+    GenericScaledMaskedSoftmax,
+)
+from apex_tpu.transformer.functional.fused_rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "ScaledUpperTriangMaskedSoftmax",
+    "ScaledMaskedSoftmax",
+    "ScaledSoftmax",
+    "GenericScaledMaskedSoftmax",
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+]

@@ -1,0 +1,66 @@
+"""Rotary positional embedding, fused apply.
+
+Reference: ``apex/transformer/functional/fused_rope.py`` over the
+``fused_rotary_positional_embedding`` CUDA ext — RoPE fwd/bwd with cached
+cos/sin and thd (packed varlen) variants.
+
+TPU-native: RoPE is cheap elementwise work that XLA fuses into the
+surrounding attention matmuls, so the jnp expression IS the fused kernel;
+the function names/signatures match the reference.  Layout: ``[s, b, h, d]``
+(Megatron sequence-first), ``freqs`` is ``[s, 1, 1, d]`` (or broadcastable).
+The rotation follows the reference's interleave-halves convention
+(rotate_half), applied to the first ``freqs.shape[-1]`` channels with any
+remainder passed through.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+]
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate((-x2, x1), axis=-1)
+
+
+def _apply(t, cos_, sin_):
+    rot_dim = cos_.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    t_rot = t_rot * cos_ + _rotate_half(t_rot) * sin_
+    if t_pass.shape[-1] == 0:
+        return t_rot
+    return jnp.concatenate((t_rot, t_pass), axis=-1)
+
+
+def fused_apply_rotary_pos_emb(t, freqs, transpose_output_memory=False):
+    """Apply RoPE given raw frequencies (reference computes cos/sin inside
+    the kernel).  ``transpose_output_memory`` is a CUDA memory-layout knob;
+    accepted and ignored (XLA owns layout)."""
+    return _apply(t, jnp.cos(freqs).astype(t.dtype),
+                  jnp.sin(freqs).astype(t.dtype))
+
+
+def fused_apply_rotary_pos_emb_cached(t, cos_, sin_,
+                                      transpose_output_memory=False):
+    """Cached-cos/sin variant."""
+    return _apply(t, cos_.astype(t.dtype), sin_.astype(t.dtype))
+
+
+def fused_apply_rotary_pos_emb_thd(t, cu_seqlens, freqs):
+    """Packed varlen ([t, h, d] with cu_seqlens boundaries) variant:
+    positions restart at each sequence start."""
+    positions = jnp.arange(t.shape[0])
+    starts = jnp.zeros((t.shape[0],), cu_seqlens.dtype)
+    # position within sequence = index - start of my sequence
+    seq_id = jnp.searchsorted(cu_seqlens[1:], positions, side="right")
+    starts = cu_seqlens[seq_id]
+    local_pos = positions - starts
+    cos_ = jnp.cos(freqs)[local_pos].astype(t.dtype)   # [t, 1, d]
+    sin_ = jnp.sin(freqs)[local_pos].astype(t.dtype)
+    return _apply(t, cos_.reshape(t.shape[0], 1, -1),
+                  sin_.reshape(t.shape[0], 1, -1))

@@ -1,0 +1,144 @@
+"""Scale + mask + softmax, fused.
+
+Reference: ``apex/transformer/functional/fused_softmax.py ::
+FusedScaleMaskSoftmax`` — dispatches between three CUDA kernels
+(upper-triangular causal / generic mask / no mask) when dtype and shape
+constraints hold, else a python fallback ``mask + softmax (+scale)``.
+
+TPU-native: XLA fuses scale+mask+softmax into one VPU loop natively, so the
+"fused kernel" here is the jnp expression compiled under jit — the kernel
+availability matrix collapses.  The class keeps the reference's interface
+(``is_kernel_available``, ``forward_fused_softmax``,
+``forward_torch_softmax``, input-in-fp16/bf16 checks, optional
+softmax-in-fp32 with result cast) so Megatron-style attention code ports
+unchanged.  A Pallas blockwise kernel covers the long-sequence regime as
+part of fused attention (``apex_tpu.ops.attention``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "ScaledUpperTriangMaskedSoftmax",
+    "ScaledMaskedSoftmax",
+    "ScaledSoftmax",
+    "GenericScaledMaskedSoftmax",
+]
+
+
+def _softmax(x):
+    x = x - jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def ScaledUpperTriangMaskedSoftmax(x, scale: Optional[float] = None):
+    """Causal scale+mask+softmax for [b, sq, sk] score blocks (reference:
+    ``scaled_upper_triang_masked_softmax_cuda``)."""
+    if scale is not None:
+        x = x * scale
+    sq, sk = x.shape[-2], x.shape[-1]
+    causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+    x = jnp.where(causal, x, jnp.finfo(x.dtype).min)
+    return _softmax(x)
+
+
+def ScaledMaskedSoftmax(x, mask, scale: Optional[float] = None):
+    """Arbitrary-mask variant: ``mask`` is True (or 1) where attention is
+    DISABLED, matching the reference's convention."""
+    if scale is not None:
+        x = x * scale
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), jnp.finfo(x.dtype).min, x)
+    return _softmax(x)
+
+
+def ScaledSoftmax(x, scale: Optional[float] = None):
+    if scale is not None:
+        x = x * scale
+    return _softmax(x)
+
+
+GenericScaledMaskedSoftmax = ScaledMaskedSoftmax
+
+
+class FusedScaleMaskSoftmax:
+    """Reference-parity module.  Args mirror
+    ``FusedScaleMaskSoftmax.__init__``: ``mask_func`` is the python-fallback
+    masking fn, ``softmax_in_fp32`` upcasts before softmax and casts back.
+    """
+
+    def __init__(self, input_in_fp16: bool, input_in_bf16: bool,
+                 attn_mask_type: AttnMaskType,
+                 scaled_masked_softmax_fusion: bool,
+                 mask_func: Optional[Callable],
+                 softmax_in_fp32: bool,
+                 scale: Optional[float]):
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError(
+                "both fp16 and bf16 flags cannot be active at the same "
+                "time.")
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if not (scale is None or softmax_in_fp32):
+            raise RuntimeError(
+                "softmax should be in fp32 when scaled")
+
+    def __call__(self, input, mask):
+        assert input.ndim == 4  # [b, np, sq, sk]
+        if self.is_kernel_available(mask, *input.shape):
+            return self.forward_fused_softmax(input, mask)
+        return self.forward_torch_softmax(input, mask)
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """The reference gates on dtype/seqlen/divisibility (16 < sk <=
+        16384 etc.); under XLA the fused path is always available — kept as
+        a method so callers probing it still work."""
+        return self.scaled_masked_softmax_fusion
+
+    def forward_fused_softmax(self, input, mask):
+        b, np_, sq, sk = input.shape
+        x = input
+        if self.input_in_float16 and self.softmax_in_fp32:
+            x = x.astype(jnp.float32)
+        if self.attn_mask_type == AttnMaskType.causal:
+            probs = ScaledUpperTriangMaskedSoftmax(
+                x.reshape(-1, sq, sk), self.scale).reshape(b, np_, sq, sk)
+        elif mask is not None:
+            probs = ScaledMaskedSoftmax(x, mask, self.scale)
+        else:
+            probs = ScaledSoftmax(x, self.scale)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(input.dtype)
+        return probs
+
+    def forward_torch_softmax(self, input, mask):
+        """The reference's eager fallback: mask_func + softmax (+scale);
+        the oracle the fused path is tested against."""
+        x = input
+        if self.input_in_float16 and self.softmax_in_fp32:
+            x = x.astype(jnp.float32)
+        if self.scale is not None:
+            x = x * self.scale
+        if self.attn_mask_type == AttnMaskType.causal and mask is None:
+            sq, sk = x.shape[-2], x.shape[-1]
+            mask = ~jnp.tril(jnp.ones((1, 1, sq, sk), bool), k=sk - sq)
+        if mask is not None and self.mask_func is not None:
+            x = self.mask_func(x, mask)
+        elif mask is not None:
+            x = jnp.where(mask.astype(bool), jnp.finfo(x.dtype).min, x)
+        probs = _softmax(x)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(input.dtype)
+        return probs

@@ -1,0 +1,20 @@
+"""Transformer logging (reference: ``apex/transformer/log_util.py``)."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_transformer_logger", "set_logging_level"]
+
+_LOGGER_NAME = "apex_tpu.transformer"
+
+
+def get_transformer_logger(name: str = _LOGGER_NAME) -> logging.Logger:
+    name = name if name.startswith(_LOGGER_NAME) else \
+        f"{_LOGGER_NAME}.{name}"
+    return logging.getLogger(name)
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the transformer-subpackage log level (reference keeps a
+    dedicated logger tree so framework logs are separable)."""
+    logging.getLogger(_LOGGER_NAME).setLevel(verbosity)

@@ -1,0 +1,280 @@
+"""Model-parallel topology state, TPU-native.
+
+Reference: ``apex/transformer/parallel_state.py :: initialize_model_parallel``
+builds NCCL process groups for TP x PP x DP (+ virtual PP, embedding group).
+Here the whole topology is ONE ``jax.sharding.Mesh`` whose named axes play
+the role of process groups:
+
+=====================  ==========================================
+reference concept      TPU-native equivalent
+=====================  ==========================================
+process group          mesh axis name (bind with ``shard_map``)
+group world size       mesh axis size (static)
+rank in group          ``jax.lax.axis_index(axis)`` (traced)
+NCCL allreduce         ``jax.lax.psum(x, axis)``
+NCCL p2p send/recv     ``jax.lax.ppermute`` on the pipe axis
+destroy groups         :func:`destroy_model_parallel`
+=====================  ==========================================
+
+Rank ordering matches Megatron: global rank =
+``pp_rank * (dp*cp*tp) + dp_rank * (cp*tp) + cp_rank * tp + tp_rank`` —
+i.e. TP ranks are adjacent devices (ride ICI), PP is outermost.  The mesh
+axes are ``("pipe", "data", "context", "tensor")``; the ``context`` axis is
+an extension over the reference for ring-attention context parallelism
+(the reference's longest-sequence tool is Megatron SP, which reuses the
+tensor axis — see SURVEY.md §2.4).
+
+World sizes are static Python ints (available any time after
+``initialize_model_parallel``).  Ranks exist only inside a traced/sharded
+region — SPMD programs are rank-agnostic at host level — except when the
+axis has size 1, where rank getters return a static 0.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "initialize_model_parallel",
+    "destroy_model_parallel",
+    "model_parallel_is_initialized",
+    "get_mesh",
+    "get_tensor_model_parallel_group",
+    "get_pipeline_model_parallel_group",
+    "get_data_parallel_group",
+    "get_context_parallel_group",
+    "get_embedding_group",
+    "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+    "get_data_parallel_world_size",
+    "get_context_parallel_world_size",
+    "get_tensor_model_parallel_rank",
+    "get_pipeline_model_parallel_rank",
+    "get_data_parallel_rank",
+    "get_context_parallel_rank",
+    "get_pipeline_model_parallel_prev_rank",
+    "get_pipeline_model_parallel_next_rank",
+    "is_pipeline_first_stage",
+    "is_pipeline_last_stage",
+    "get_virtual_pipeline_model_parallel_rank",
+    "set_virtual_pipeline_model_parallel_rank",
+    "get_virtual_pipeline_model_parallel_world_size",
+    "get_tensor_model_parallel_src_rank",
+]
+
+# Axis names — the moral equivalents of _TENSOR_MODEL_PARALLEL_GROUP etc.
+TENSOR_AXIS = "tensor"
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+CONTEXT_AXIS = "context"
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+
+
+def initialize_model_parallel(
+        tensor_model_parallel_size_: int = 1,
+        pipeline_model_parallel_size_: int = 1,
+        virtual_pipeline_model_parallel_size_: Optional[int] = None,
+        pipeline_model_parallel_split_rank_: Optional[int] = None,
+        context_parallel_size_: int = 1,
+        *,
+        devices: Optional[Sequence] = None,
+        default_backend: Optional[str] = None,
+        p2p_backend: Optional[str] = None,
+) -> Mesh:
+    """Build the global device mesh (reference: NCCL group construction).
+
+    ``default_backend`` / ``p2p_backend`` are accepted for signature parity
+    with the reference ("nccl"/"ucc") and ignored — XLA owns transport
+    selection (ICI intra-slice, DCN across slices).
+
+    Data-parallel size is inferred as
+    ``n_devices // (tp * pp * cp)``, like the reference infers it from the
+    world size.
+    """
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    cp = context_parallel_size_
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    denom = tp * pp * cp
+    if n % denom != 0:
+        raise RuntimeError(
+            f"world size ({n}) is not divisible by tensor ({tp}) x "
+            f"pipeline ({pp}) x context ({cp}) parallel sizes")
+    dp = n // denom
+    grid = np.asarray(devices, dtype=object).reshape(pp, dp, cp, tp)
+    _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
+    if virtual_pipeline_model_parallel_size_ is not None:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = (
+            virtual_pipeline_model_parallel_size_)
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def destroy_model_parallel() -> None:
+    """Drop the mesh (reference: destroy all process groups)."""
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _MESH = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel is not initialized; call "
+            "initialize_model_parallel() first")
+    return _MESH
+
+
+# --- groups (axis names) ----------------------------------------------------
+
+def get_tensor_model_parallel_group() -> str:
+    get_mesh()
+    return TENSOR_AXIS
+
+
+def get_pipeline_model_parallel_group() -> str:
+    get_mesh()
+    return PIPE_AXIS
+
+
+def get_data_parallel_group() -> str:
+    get_mesh()
+    return DATA_AXIS
+
+
+def get_context_parallel_group() -> str:
+    get_mesh()
+    return CONTEXT_AXIS
+
+
+def get_embedding_group() -> str:
+    """Reference ties first+last PP stage into an _EMBEDDING_GROUP for tied
+    word-embedding grad allreduce; on a mesh that reduction is a masked psum
+    over the pipe axis (see ``pipeline_parallel.embedding_grads_all_reduce``).
+    """
+    get_mesh()
+    return PIPE_AXIS
+
+
+# --- static world sizes -----------------------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[TENSOR_AXIS]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[PIPE_AXIS]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[DATA_AXIS]
+
+
+def get_context_parallel_world_size() -> int:
+    return get_mesh().shape[CONTEXT_AXIS]
+
+
+# --- ranks (traced inside shard_map; static 0 when axis size is 1) ----------
+
+def _axis_rank(axis: str):
+    if get_mesh().shape[axis] == 1:
+        return 0
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError as e:
+        raise RuntimeError(
+            f"rank on axis {axis!r} only exists inside a sharded region "
+            f"(shard_map/pjit binding {axis!r}); SPMD host code is "
+            "rank-agnostic") from e
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CONTEXT_AXIS)
+
+
+def get_pipeline_model_parallel_prev_rank():
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() - 1) % pp
+
+
+def get_pipeline_model_parallel_next_rank():
+    pp = get_pipeline_model_parallel_world_size()
+    return (get_pipeline_model_parallel_rank() + 1) % pp
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vr = _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+        if vr is not None and vr != 0:
+            return False
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vr = _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+        vws = _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+        if vr is not None and vws is not None and vr != vws - 1:
+            return False
+    pp = get_pipeline_model_parallel_world_size()
+    if pp == 1:
+        return True
+    return get_pipeline_model_parallel_rank() == pp - 1
+
+
+# --- virtual pipeline bookkeeping (host-side, like the reference) -----------
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def get_tensor_model_parallel_src_rank():
+    """Rank of TP-rank-0 within my TP group, i.e. my global rank with the TP
+    coordinate zeroed.  Traced inside a sharded region (like all ranks)."""
+    tp = get_tensor_model_parallel_world_size()
+    # global rank laid out (pp, dp, cp, tp) with tp minor
+    parts = []
+    stride = 1
+    for axis in (TENSOR_AXIS, CONTEXT_AXIS, DATA_AXIS, PIPE_AXIS):
+        r = _axis_rank(axis)
+        parts.append(r * stride)
+        stride *= get_mesh().shape[axis]
+    global_rank = sum(parts)
+    return (global_rank // tp) * tp

@@ -1,0 +1,30 @@
+"""Pipeline parallelism (reference: ``apex/transformer/pipeline_parallel``)."""
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    get_forward_backward_func,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    setup_microbatch_calculator,
+    get_num_microbatches,
+    get_current_global_batch_size,
+    update_num_microbatches,
+    listify_model,
+    get_kth_microbatch,
+)
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "p2p_communication",
+    "setup_microbatch_calculator",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "listify_model",
+    "get_kth_microbatch",
+]

@@ -1,0 +1,94 @@
+"""Stage-to-stage activation/grad exchange over the pipe mesh axis.
+
+Reference: ``apex/transformer/pipeline_parallel/p2p_communication.py ::
+_communicate`` — builds torch.distributed P2POp batches (NCCL isend/irecv)
+between adjacent pipeline stages, with shape pre-exchange and fused
+send+recv variants.
+
+TPU-native: adjacent-stage exchange is ``jax.lax.ppermute`` on the pipe
+axis — a single collective-permute riding ICI, which *is* the fused
+send+recv (every rank sends and receives in one op; the reference needed
+``batch_isend_irecv`` to get that).  Shapes are static under jit, so the
+reference's shape pre-exchange protocol has no equivalent — ``tensor_shape``
+kwargs are accepted and ignored.
+
+All functions must run inside a region binding the pipe axis.  Semantics of
+the ring: rank r's payload lands on r+1 (forward) or r-1 (backward); the
+wrap-around edge (last→first) is what the reference's "first/last stage has
+no prev/next" checks handle — callers mask it (the schedule does).
+"""
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.transformer.parallel_state import PIPE_AXIS
+
+__all__ = [
+    "send_forward", "recv_forward", "send_backward", "recv_backward",
+    "send_forward_recv_backward", "send_backward_recv_forward",
+    "send_forward_recv_forward", "send_backward_recv_backward",
+]
+
+
+def _shift(x, direction: int, axis_name: str):
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + direction) % n) for i in range(n)]
+    return jax.tree.map(
+        lambda a: jax.lax.ppermute(a, axis_name, perm), x)
+
+
+def send_forward_recv_forward(output_tensor, *, axis_name: str = PIPE_AXIS,
+                              **_ignored):
+    """Rotate activations one stage forward: what I return is what the
+    previous stage sent me (reference: fused send_forward + recv_forward)."""
+    return _shift(output_tensor, +1, axis_name)
+
+
+def send_backward_recv_backward(input_tensor_grad, *,
+                                axis_name: str = PIPE_AXIS, **_ignored):
+    """Rotate grads one stage backward (reference: fused send_backward +
+    recv_backward)."""
+    return _shift(input_tensor_grad, -1, axis_name)
+
+
+# Individual send/recv halves: with collective-permute the send and the recv
+# are one op; each half is expressed as the rotation (the unneeded output is
+# simply unused — XLA DCE keeps exactly one collective when both halves of a
+# pair are called, and the schedule uses the fused forms anyway).
+
+def send_forward(output_tensor, *, axis_name: str = PIPE_AXIS, **_ignored):
+    return _shift(output_tensor, +1, axis_name)
+
+
+def recv_forward(payload, *, axis_name: str = PIPE_AXIS, **_ignored):
+    """Receive from the previous stage.  ``payload`` is the value being
+    rotated (SPMD: every rank contributes its send while receiving)."""
+    return _shift(payload, +1, axis_name)
+
+
+def send_backward(input_tensor_grad, *, axis_name: str = PIPE_AXIS,
+                  **_ignored):
+    return _shift(input_tensor_grad, -1, axis_name)
+
+
+def recv_backward(payload, *, axis_name: str = PIPE_AXIS, **_ignored):
+    return _shift(payload, -1, axis_name)
+
+
+def send_forward_recv_backward(output_tensor, input_tensor_grad=None, *,
+                               axis_name: str = PIPE_AXIS, **_ignored):
+    """The 1F1B steady-state pair: activations go forward while grads come
+    back (reference fuses these two P2POps; here it is two ppermutes that
+    XLA schedules concurrently on opposite ICI directions)."""
+    fwd = _shift(output_tensor, +1, axis_name)
+    if input_tensor_grad is None:
+        return fwd
+    return fwd, _shift(input_tensor_grad, -1, axis_name)
+
+
+def send_backward_recv_forward(input_tensor_grad, output_tensor=None, *,
+                               axis_name: str = PIPE_AXIS, **_ignored):
+    bwd = _shift(input_tensor_grad, -1, axis_name)
+    if output_tensor is None:
+        return bwd
+    return bwd, _shift(output_tensor, +1, axis_name)

@@ -1,0 +1,274 @@
+"""Pipeline-parallel forward/backward executors.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/`` — three
+interchangeable executors behind ``get_forward_backward_func()``:
+no-pipelining (microbatch loop + grad accumulation), 1F1B without
+interleaving, and interleaved 1F1B over virtual model chunks, built from
+explicit NCCL p2p sends/recvs and ``torch.autograd.backward`` calls.
+
+TPU-native design: a pipeline is a ``lax.scan`` over "ticks" whose carry is
+the activation flowing around the pipe-axis ring via ``ppermute``.  The
+backward schedule is not hand-written: differentiating the scan transposes
+every ppermute (reverse rotation) and replays stages in reverse — XLA
+derives the cooldown/steady/warmup structure that the reference encodes by
+hand.  Memory-wise this executor stashes one activation per tick (GPipe
+profile); wrap ``stage_fn`` in ``jax.checkpoint`` to rematerialize (the
+reference's deallocate-output-tensor + checkpointing knobs).
+
+Functional contract (instead of the reference's ``forward_step_func(batch,
+model)`` + mutable ``.grad``):
+
+* ``stage_fn(stage_params, hidden, microbatch) -> hidden`` — one pipeline
+  stage; runs on every rank with its own stage's params.
+* ``input_fn(microbatch) -> hidden`` — stage-0 entry (embedding etc.).
+* ``loss_fn(hidden, microbatch) -> scalar`` — last-stage exit.
+* ``params`` — per-stage params pytree, each leaf with leading stage dim
+  sharded over the pipe axis (inside shard_map each rank sees its slice).
+
+Every executor returns ``(mean_loss, grads)`` (or ``(mean_loss, None)``
+when ``forward_only``); grads are per-rank stage grads ready for the DP
+reduction / optimizer.  Run inside ``shard_map`` binding the pipe axis
+(the no-pipelining executor runs anywhere).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.parallel_state import PIPE_AXIS
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+]
+
+
+def get_forward_backward_func(
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        pipeline_model_parallel_size: Optional[int] = None):
+    """Pick the executor for the current topology (reference:
+    ``schedules/__init__.py :: get_forward_backward_func``)."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = (
+            parallel_state.get_pipeline_model_parallel_world_size()
+            if parallel_state.model_parallel_is_initialized() else 1)
+    if virtual_pipeline_model_parallel_size is None:
+        virtual_pipeline_model_parallel_size = (
+            parallel_state.get_virtual_pipeline_model_parallel_world_size())
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None and \
+                virtual_pipeline_model_parallel_size > 1:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def _microbatch(batch, idx):
+    return jax.tree.map(lambda x: x[idx], batch)
+
+
+def forward_backward_no_pipelining(
+        stage_fn: Callable, loss_fn: Callable, params, batch, *,
+        num_microbatches: int, input_fn: Callable = None,
+        forward_only: bool = False, **_parity_kwargs):
+    """Microbatch loop with gradient accumulation, no pipelining
+    (reference: ``fwd_bwd_no_pipelining.py``).  ``batch`` leaves have
+    leading dim ``num_microbatches``.  The reference defers the DDP grad
+    sync to the last microbatch; here grads are accumulated locally in the
+    scan and reduced once by the caller — same traffic."""
+    input_fn = input_fn or (lambda mb: mb)
+
+    def one_loss(p, mb):
+        return loss_fn(stage_fn(p, input_fn(mb), mb), mb)
+
+    if forward_only:
+        def tick(acc, idx):
+            return acc + one_loss(params, _microbatch(batch, idx)), None
+        total, _ = jax.lax.scan(
+            tick, jnp.zeros((), jnp.float32), jnp.arange(num_microbatches))
+        return total / num_microbatches, None
+
+    grad_fn = jax.value_and_grad(one_loss)
+
+    def tick(carry, idx):
+        loss_acc, grad_acc = carry
+        loss, g = grad_fn(params, _microbatch(batch, idx))
+        return (loss_acc + loss,
+                jax.tree.map(jnp.add, grad_acc, g)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss_acc, grad_acc), _ = jax.lax.scan(
+        tick, (jnp.zeros((), jnp.float32), zeros),
+        jnp.arange(num_microbatches))
+    inv = 1.0 / num_microbatches
+    return loss_acc * inv, jax.tree.map(lambda g: g * inv, grad_acc)
+
+
+def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
+                         num_microbatches: int, axis_name: str):
+    """The pipelined forward as one scan; returns this rank's summed loss
+    (nonzero only on the last stage).  Differentiating this function IS the
+    pipelined backward."""
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_ticks = num_microbatches + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    mb0 = _microbatch(batch, 0)
+    hidden0 = input_fn(mb0)
+    state0 = jax.tree.map(jnp.zeros_like, hidden0)
+
+    def tick(carry, t):
+        state, loss_acc = carry
+        # at tick t, stage s holds microbatch t-s (stage 0 injects t; ticks
+        # outside [0, n_micro) are bubble compute, masked out below — the
+        # reference's warmup/cooldown, paid here as masked ticks)
+        mb_idx = jnp.clip(t - stage, 0, num_microbatches - 1)
+        mb = _microbatch(batch, mb_idx)
+        x = jax.tree.map(
+            lambda inj, s: jnp.where(stage == 0, inj, s),
+            input_fn(mb), state)
+        y = stage_fn(params, x, mb)
+        # last stage emits microbatch t-(n_stages-1)
+        loss = loss_fn(y, mb)
+        valid = (stage == n_stages - 1) & (t - stage >= 0)
+        loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
+        state = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, perm), y)
+        return (state, loss_acc), None
+
+    (_, loss_acc), _ = jax.lax.scan(
+        tick, (state0, jnp.zeros((), jnp.float32)), jnp.arange(n_ticks))
+    return loss_acc / num_microbatches
+
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn: Callable, loss_fn: Callable, params, batch, *,
+        num_microbatches: int, input_fn: Callable = None,
+        forward_only: bool = False, axis_name: str = PIPE_AXIS,
+        **_parity_kwargs):
+    """1F1B-equivalent pipelined executor (reference:
+    ``fwd_bwd_pipelining_without_interleaving.py``).
+
+    Params leaves are this rank's stage slice (leading stage dim consumed
+    by shard_map).  The loss value is psum'd over the pipe axis for
+    reporting (it lives on the last stage); grads come from plain
+    ``jax.grad`` of the local loss — ppermute transposition carries
+    cotangents back through the stages.
+    """
+    input_fn = input_fn or (lambda mb: mb)
+    local = functools.partial(
+        _pipeline_local_loss, stage_fn, loss_fn, input_fn,
+        num_microbatches=num_microbatches, axis_name=axis_name)
+    if forward_only:
+        loss = local(params, batch)
+        return jax.lax.psum(loss, axis_name), None
+    loss, grads = jax.value_and_grad(local)(params, batch)
+    return jax.lax.psum(loss, axis_name), grads
+
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn: Callable, loss_fn: Callable, params, batch, *,
+        num_microbatches: int, input_fn: Callable = None,
+        forward_only: bool = False, axis_name: str = PIPE_AXIS,
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        **_parity_kwargs):
+    """Virtual-pipeline executor (reference:
+    ``fwd_bwd_pipelining_with_interleaving.py``): the model is split into
+    ``v`` chunks per rank; hiddens make ``v`` laps around the ring (the
+    ring wrap-around last->first IS the chunk hand-off).
+
+    Params leaves carry a local leading chunk dim ``[v, ...]``; chunk ``c``
+    on rank ``r`` is virtual stage ``c * pp + r``.  Current implementation
+    runs the laps sequentially (bubble ``v*(pp-1)`` ticks, vs. the
+    reference's interleaved ``(pp-1)/v``-style bubble); the lap structure
+    and APIs match, the steady-state interleave is a planned optimization
+    (tracked in ``bench.py`` MFU numbers).
+    """
+    input_fn = input_fn or (lambda mb: mb)
+    v = virtual_pipeline_model_parallel_size
+    if v is None:
+        v = (parallel_state.get_virtual_pipeline_model_parallel_world_size()
+             or jax.tree.leaves(params)[0].shape[0])
+
+    def local(params, batch):
+        # laps 1..v-1 consume the previous lap's last-stage output stream as
+        # stage-0 input while loss_fn still sees the ORIGINAL microbatches
+        def lap_stage_fn(p, x, mb):
+            return stage_fn(p, x, mb["orig"])
+
+        def lap_input_fn(mb):
+            return mb["hidden"]
+
+        def lap_loss_fn(y, mb):
+            return loss_fn(y, mb["orig"])
+
+        chunk0 = jax.tree.map(lambda x: x[0], params)
+        if v == 1:
+            return _pipeline_local_loss(
+                stage_fn, loss_fn, input_fn, chunk0, batch,
+                num_microbatches=num_microbatches, axis_name=axis_name)
+        stream = _collect_lap_outputs(
+            stage_fn, input_fn, chunk0, batch,
+            num_microbatches=num_microbatches, axis_name=axis_name)
+        for chunk in range(1, v - 1):
+            chunk_params = jax.tree.map(lambda x, c=chunk: x[c], params)
+            stream = _collect_lap_outputs(
+                lap_stage_fn, lap_input_fn, chunk_params,
+                {"hidden": stream, "orig": batch},
+                num_microbatches=num_microbatches, axis_name=axis_name)
+        chunk_last = jax.tree.map(lambda x: x[v - 1], params)
+        return _pipeline_local_loss(
+            lap_stage_fn, lap_loss_fn, lap_input_fn, chunk_last,
+            {"hidden": stream, "orig": batch},
+            num_microbatches=num_microbatches, axis_name=axis_name)
+
+    if forward_only:
+        loss = local(params, batch)
+        return jax.lax.psum(loss, axis_name), None
+    loss, grads = jax.value_and_grad(local)(params, batch)
+    return jax.lax.psum(loss, axis_name), grads
+
+
+def _collect_lap_outputs(stage_fn, input_fn, params, batch, *,
+                         num_microbatches: int, axis_name: str):
+    """Run one full pipeline lap, returning the stream of last-stage
+    outputs rotated to stage 0 (stacked per microbatch) so the next chunk
+    lap can consume them as inputs."""
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n_ticks = num_microbatches + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    mb0 = _microbatch(batch, 0)
+    hidden0 = input_fn(mb0)
+    state0 = jax.tree.map(jnp.zeros_like, hidden0)
+
+    def tick(carry, t):
+        state = carry
+        # stage s holds microbatch t-s at tick t (see _pipeline_local_loss)
+        mb_idx = jnp.clip(t - stage, 0, num_microbatches - 1)
+        mb_in = _microbatch(batch, mb_idx)
+        x = jax.tree.map(
+            lambda inj, s: jnp.where(stage == 0, inj, s),
+            input_fn(mb_in), state)
+        y = stage_fn(params, x, mb_in)
+        state = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, perm), y)
+        # after the rotation, stage 0 holds what the last stage produced at
+        # tick t; that is microbatch t - n_stages + 1's lap output
+        return state, state
+
+    _, stream = jax.lax.scan(tick, state0, jnp.arange(n_ticks))
+    # lap output for microbatch m lands on stage 0 after tick m+n_stages-1,
+    # i.e. stream[m + n_stages - 1]; slice those out
+    out = jax.tree.map(lambda s: s[n_stages - 1:, ...], stream)
+    # only stage 0's copy is meaningful next lap (input_fn of the next lap
+    # reads it there); other stages' entries rotate in as the lap runs
+    return out

@@ -1,0 +1,75 @@
+"""Pipeline utilities (reference:
+``apex/transformer/pipeline_parallel/utils.py``): microbatch-calculator
+globals, model listification, shape helpers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+
+__all__ = [
+    "setup_microbatch_calculator",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "listify_model",
+    "get_kth_microbatch",
+    "_reconfigure_microbatch_calculator",
+]
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def setup_microbatch_calculator(rank: int, rampup_batch_size,
+                                global_batch_size: int,
+                                micro_batch_size: int,
+                                data_parallel_size: int) -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    assert _GLOBAL_NUM_MICROBATCHES_CALCULATOR is None, (
+        "microbatch calculator is already initialized")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _reconfigure_microbatch_calculator(rank: int, rampup_batch_size,
+                                       global_batch_size: int,
+                                       micro_batch_size: int,
+                                       data_parallel_size: int) -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def get_num_microbatches() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> Optional[int]:
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is None:
+        return None
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples,
+                            consistency_check: bool = True) -> None:
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(
+        consumed_samples, consistency_check)
+
+
+def listify_model(model):
+    if isinstance(model, (list, tuple)):
+        return list(model)
+    return [model]
+
+
+def get_kth_microbatch(batch, k: int, micro_batch_size: int):
+    """Slice microbatch k out of a global batch pytree (leading dim =
+    batch)."""
+    import jax
+    return jax.tree.map(
+        lambda x: x[k * micro_batch_size:(k + 1) * micro_batch_size], batch)

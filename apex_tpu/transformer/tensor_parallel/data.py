@@ -1,0 +1,39 @@
+"""TP data broadcast (reference: ``apex/transformer/tensor_parallel/data.py``).
+
+The reference broadcasts a dict of int tensors from TP-rank-0 so every rank
+in a tensor-parallel group consumes identical batches.  In single-program
+SPMD every rank computes on the same traced values by construction, so the
+broadcast is usually a no-op — but the contract (all TP ranks see rank-0's
+data even if their host fed them different arrays) is preserved with a
+masked psum on the tensor axis.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+__all__ = ["broadcast_data"]
+
+
+def broadcast_data(keys, data, datatype=jnp.int32,
+                   axis_name: str = TENSOR_AXIS):
+    """Return ``{k: rank0's data[k]}`` across the TP group.
+
+    Reference packs all keys into one flat int64 tensor, broadcasts once,
+    and unpacks; here each array is broadcast with one masked psum (XLA
+    fuses them).  Must run inside a region binding ``axis_name`` when tp>1.
+    """
+    if axis_name == TENSOR_AXIS and \
+            parallel_state.model_parallel_is_initialized() and \
+            parallel_state.get_tensor_model_parallel_world_size() == 1:
+        return {k: jnp.asarray(data[k], datatype) for k in keys}
+    rank = jax.lax.axis_index(axis_name)
+    out = {}
+    for k in keys:
+        x = jnp.asarray(data[k], datatype)
+        out[k] = jax.lax.psum(jnp.where(rank == 0, x, jnp.zeros_like(x)),
+                              axis_name)
+    return out

@@ -1,0 +1,269 @@
+"""Tensor-parallel layers (flax.linen over the mappings collectives).
+
+Reference: ``apex/transformer/tensor_parallel/layers.py`` —
+``ColumnParallelLinear`` (shard out-features), ``RowParallelLinear`` (shard
+in-features, allreduce out), ``VocabParallelEmbedding`` (shard vocab rows),
+and ``LinearWithGradAccumulationAndAsyncCommunication`` (async grad-input
+allreduce overlapped with the wgrad GEMM).
+
+TPU-native notes:
+
+* Layers are ``flax.linen`` modules holding the *per-partition* shard of
+  each weight; run them inside ``shard_map`` binding the tensor axis (or
+  with tp==1 anywhere).  Per-rank shard init folds the axis index into the
+  RNG key so shards are independent (reference: master-weight scatter).
+* The reference's hand-rolled comm/compute overlap
+  (``LinearWithGradAccumulationAndAsyncCommunication``: launch grad-input
+  allreduce async, compute wgrad GEMM meanwhile) is XLA's job: the
+  scheduler overlaps the psum from ``copy_to...``'s backward with the wgrad
+  dot automatically.  ``gradient_accumulation_fusion`` (wgrad accumulated
+  into an fp32 main_grad by ``fused_weight_gradient_mlp_cuda``) maps to
+  XLA buffer donation + fp32 accumulate in the optimizer path; the flag is
+  accepted and documented, not re-implemented.
+* Layout convention follows Megatron: activations ``[s, b, h]`` when
+  sequence parallel is on (dim 0 = sequence).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.utils import VocabUtility, divide
+
+__all__ = [
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "linear_with_grad_accumulation_and_async_allreduce",
+    "set_tensor_model_parallel_attributes",
+    "set_defaults_if_not_set_tensor_model_parallel_attributes",
+    "copy_tensor_model_parallel_attributes",
+    "param_is_not_tensor_parallel_duplicate",
+]
+
+_DEFAULT_INIT = nn.initializers.xavier_normal()
+
+
+def _tp_world() -> int:
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_tensor_model_parallel_world_size()
+    return 1
+
+
+def _shard_init(init: Callable, axis_name: str, world: int) -> Callable:
+    """Fold the TP rank into the init key so each shard draws independent
+    weights (reference inits the full master weight then scatters)."""
+    if world == 1:
+        return init
+
+    def f(key, shape, dtype):
+        return init(jax.random.fold_in(
+            key, jax.lax.axis_index(axis_name)), shape, dtype)
+    return f
+
+
+def linear_with_grad_accumulation_and_async_allreduce(
+        input, weight, bias=None, gradient_accumulation_fusion: bool = False,
+        async_grad_allreduce: bool = True,
+        sequence_parallel_enabled: bool = False,
+        axis_name: str = TENSOR_AXIS):
+    """Functional core of ColumnParallelLinear (reference:
+    ``LinearWithGradAccumulationAndAsyncCommunication.apply``).
+
+    ``weight`` is ``[out_per_partition, in]``; fwd = ``x @ W^T (+ b)``.
+    Sequence parallel: ``x`` arrives ``[s/tp, b, h]``, is all-gathered over
+    the tensor axis for the GEMM, and the input grad is reduce-scattered
+    back — both directions expressed by ``gather_from_sequence_parallel_
+    region``'s custom VJP.  Otherwise ``copy_to...`` makes the backward
+    psum explicit.  XLA overlaps that collective with the wgrad dot (the
+    reference's hand-built async overlap).
+    """
+    if sequence_parallel_enabled:
+        x = mappings.gather_from_sequence_parallel_region(
+            input, axis_name, tensor_parallel_output_grad=True)
+    elif async_grad_allreduce:
+        x = mappings.copy_to_tensor_model_parallel_region(input, axis_name)
+    else:
+        x = input
+    out = jnp.matmul(x, weight.T)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class ColumnParallelLinear(nn.Module):
+    """Linear with out-features sharded over TP: ``Y_i = X @ A_i^T``
+    (reference: ``ColumnParallelLinear``).  Returns ``(output,
+    output_bias)`` — bias is deferred when ``skip_bias_add`` so a later op
+    can fuse it (reference keeps that contract)."""
+    input_size: int
+    output_size: int
+    bias: bool = True
+    gather_output: bool = True
+    init_method: Callable = _DEFAULT_INIT
+    stride: int = 1                    # parity; partition striding unused
+    keep_master_weight_for_test: bool = False
+    skip_bias_add: bool = False
+    no_async_tensor_model_parallel_allreduce: bool = False
+    params_dtype: Any = jnp.float32
+    use_cpu_initialization: bool = False   # parity; XLA places params
+    gradient_accumulation_fusion: bool = False
+    sequence_parallel_enabled: bool = False
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, input_):
+        world = _tp_world()
+        out_per_partition = divide(self.output_size, world)
+        weight = self.param(
+            "weight", _shard_init(self.init_method, self.axis_name, world),
+            (out_per_partition, self.input_size), self.params_dtype)
+        b = self.param("bias", nn.initializers.zeros,
+                       (out_per_partition,), self.params_dtype) \
+            if self.bias else None
+        output_parallel = linear_with_grad_accumulation_and_async_allreduce(
+            input_, weight,
+            bias=None if self.skip_bias_add else b,
+            gradient_accumulation_fusion=self.gradient_accumulation_fusion,
+            async_grad_allreduce=not self.no_async_tensor_model_parallel_allreduce,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            axis_name=self.axis_name)
+        if self.gather_output:
+            assert not self.sequence_parallel_enabled, \
+                "gather_output incompatible with sequence_parallel " \
+                "(reference asserts the same)"
+            output = mappings.gather_from_tensor_model_parallel_region(
+                output_parallel, self.axis_name)
+        else:
+            output = output_parallel
+        output_bias = b if self.skip_bias_add else None
+        return output, output_bias
+
+
+class RowParallelLinear(nn.Module):
+    """Linear with in-features sharded over TP: ``Y = sum_i X_i @ A_i^T``
+    (reference: ``RowParallelLinear``).  The partial products are psum'd
+    (or reduce-scattered to sequence shards under SP); bias is added after
+    the reduction, on the full output."""
+    input_size: int
+    output_size: int
+    bias: bool = True
+    input_is_parallel: bool = False
+    init_method: Callable = _DEFAULT_INIT
+    stride: int = 1
+    keep_master_weight_for_test: bool = False
+    skip_bias_add: bool = False
+    params_dtype: Any = jnp.float32
+    use_cpu_initialization: bool = False
+    gradient_accumulation_fusion: bool = False
+    sequence_parallel_enabled: bool = False
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, input_):
+        world = _tp_world()
+        in_per_partition = divide(self.input_size, world)
+        weight = self.param(
+            "weight", _shard_init(self.init_method, self.axis_name, world),
+            (self.output_size, in_per_partition), self.params_dtype)
+        b = self.param("bias", nn.initializers.zeros,
+                       (self.output_size,), self.params_dtype) \
+            if self.bias else None
+        if self.input_is_parallel:
+            input_parallel = input_
+        else:
+            assert not self.sequence_parallel_enabled, \
+                "sequence_parallel requires input_is_parallel"
+            input_parallel = mappings.scatter_to_tensor_model_parallel_region(
+                input_, self.axis_name)
+        output_parallel = jnp.matmul(input_parallel, weight.T)
+        if self.sequence_parallel_enabled:
+            output = mappings.reduce_scatter_to_sequence_parallel_region(
+                output_parallel, self.axis_name)
+        else:
+            output = mappings.reduce_from_tensor_model_parallel_region(
+                output_parallel, self.axis_name)
+        if not self.skip_bias_add:
+            if b is not None:
+                output = output + b
+            return output, None
+        return output, b
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding with vocab rows sharded over TP (reference:
+    ``VocabParallelEmbedding``): out-of-range token ids are masked to 0,
+    looked up locally, zeroed, and psum'd — one allreduce, no gather of the
+    embedding table."""
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Callable = nn.initializers.normal(stddev=0.02)
+    params_dtype: Any = jnp.float32
+    use_cpu_initialization: bool = False
+    axis_name: str = TENSOR_AXIS
+
+    @nn.compact
+    def __call__(self, input_):
+        world = _tp_world()
+        per_partition = divide(self.num_embeddings, world)
+        weight = self.param(
+            "weight", _shard_init(self.init_method, self.axis_name, world),
+            (per_partition, self.embedding_dim), self.params_dtype)
+        if world == 1:
+            return jnp.take(weight, input_, axis=0)
+        rank = jax.lax.axis_index(self.axis_name)
+        start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world)
+        input_mask = (input_ < start) | (input_ >= start + per_partition)
+        masked_input = jnp.clip(input_ - start, 0, per_partition - 1)
+        output_parallel = jnp.take(weight, masked_input, axis=0)
+        output_parallel = jnp.where(
+            input_mask[..., None], 0.0, output_parallel)
+        return mappings.reduce_from_tensor_model_parallel_region(
+            output_parallel, self.axis_name)
+
+
+# --- param attribute helpers (reference: same names) ------------------------
+# JAX arrays are immutable and attribute-less; these helpers operate on any
+# attribute-bearing carrier (flax Partitioned boxes, SimpleNamespace wrappers,
+# torch params in the CPU shim) so Megatron-style bookkeeping code ports.
+
+_TP_DEFAULTS = {"tensor_model_parallel": False,
+                "partition_dim": -1,
+                "partition_stride": 1}
+
+
+def set_tensor_model_parallel_attributes(tensor, is_parallel: bool, dim: int,
+                                         stride: int) -> None:
+    for attr in _TP_DEFAULTS:
+        assert not hasattr(tensor, attr)
+    tensor.tensor_model_parallel = is_parallel
+    tensor.partition_dim = dim
+    tensor.partition_stride = stride
+
+
+def set_defaults_if_not_set_tensor_model_parallel_attributes(tensor) -> None:
+    for attr, default in _TP_DEFAULTS.items():
+        if not hasattr(tensor, attr):
+            setattr(tensor, attr, default)
+
+
+def copy_tensor_model_parallel_attributes(destination, source) -> None:
+    for attr in _TP_DEFAULTS:
+        if hasattr(source, attr):
+            setattr(destination, attr, getattr(source, attr))
+
+
+def param_is_not_tensor_parallel_duplicate(param) -> bool:
+    """True if the param is TP-sharded (not a replicated duplicate) or this
+    is TP rank 0 — i.e. it should be counted exactly once globally."""
+    if getattr(param, "tensor_model_parallel", False):
+        return True
+    rank = parallel_state.get_tensor_model_parallel_rank()
+    return bool(rank == 0) if isinstance(rank, int) else rank == 0

@@ -1,0 +1,191 @@
+"""Collective algebra with asymmetric forward/backward, as custom-VJP ops.
+
+Reference: ``apex/transformer/tensor_parallel/mappings.py`` — torch autograd
+Functions pairing a forward collective with a *different* backward collective
+(the algebra tensor parallelism is built from).  TPU-native: the collectives
+are XLA ops on a mesh axis (bind with ``shard_map``), and the fwd/bwd pairing
+is ``jax.custom_vjp``:
+
+==============================================  =========  ===========
+op (reference Function)                         forward    backward
+==============================================  =========  ===========
+copy_to_tensor_model_parallel_region            identity   psum
+reduce_from_tensor_model_parallel_region        psum       identity
+scatter_to_tensor_model_parallel_region         split(-1)  all_gather(-1)
+gather_from_tensor_model_parallel_region        all_gather(-1)  split(-1)
+scatter_to_sequence_parallel_region             split(0)   all_gather(0)
+gather_from_sequence_parallel_region            all_gather(0)  reduce_scatter(0)
+reduce_scatter_to_sequence_parallel_region      reduce_scatter(0)  all_gather(0)
+==============================================  =========  ===========
+
+Sequence-parallel ops act on dim 0 = the sequence dim of Megatron's
+``[s, b, h]`` activation layout.  When the tensor axis has size 1 every op
+is the identity (matching the reference's world_size==1 early-returns).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+]
+
+
+def _is_identity(axis_name: str) -> bool:
+    """The reference's world_size==1 early-return — only valid when the
+    requested axis really is the (size-1) tensor axis; any other axis name
+    must go through the collectives (its size is only known when bound)."""
+    return (axis_name == TENSOR_AXIS
+            and parallel_state.model_parallel_is_initialized()
+            and parallel_state.get_tensor_model_parallel_world_size() == 1)
+
+
+def _split(x, axis_name: str, dim: int):
+    """Take this rank's chunk along ``dim``."""
+    n = jax.lax.axis_size(axis_name)
+    chunk = x.shape[dim] // n
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=dim)
+
+
+def _gather(x, axis_name: str, dim: int):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter(x, axis_name: str, dim: int):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+                                tiled=True)
+
+
+# --- copy / reduce ----------------------------------------------------------
+
+def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """Identity forward / psum backward (``_CopyToModelParallelRegion``).
+    Entry point of ColumnParallelLinear: the activation is replicated across
+    TP, so its grad is the sum of per-rank grads."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, g: (jax.lax.psum(g, axis_name),))
+    return f(x)
+
+
+def reduce_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """psum forward / identity backward (``_ReduceFromModelParallelRegion``).
+    Exit point of RowParallelLinear: partial products are summed."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return jax.lax.psum(x, axis_name)
+
+    f.defvjp(lambda x: (jax.lax.psum(x, axis_name), None),
+             lambda _, g: (g,))
+    return f(x)
+
+
+# --- scatter / gather on the hidden (last) dim ------------------------------
+
+def scatter_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """split last dim forward / all-gather backward
+    (``_ScatterToModelParallelRegion``)."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return _split(x, axis_name, x.ndim - 1)
+
+    f.defvjp(lambda x: (_split(x, axis_name, x.ndim - 1), None),
+             lambda _, g: (_gather(g, axis_name, g.ndim - 1),))
+    return f(x)
+
+
+def gather_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """all-gather last dim forward / split backward
+    (``_GatherFromModelParallelRegion``)."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return _gather(x, axis_name, x.ndim - 1)
+
+    f.defvjp(lambda x: (_gather(x, axis_name, x.ndim - 1), None),
+             lambda _, g: (_split(g, axis_name, g.ndim - 1),))
+    return f(x)
+
+
+# --- sequence-parallel trio (dim 0 = sequence) ------------------------------
+
+def scatter_to_sequence_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """split dim 0 forward / all-gather backward
+    (``_ScatterToSequenceParallelRegion``); used for SP embedding output."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return _split(x, axis_name, 0)
+
+    f.defvjp(lambda x: (_split(x, axis_name, 0), None),
+             lambda _, g: (_gather(g, axis_name, 0),))
+    return f(x)
+
+
+def gather_from_sequence_parallel_region(
+        x, axis_name: str = TENSOR_AXIS,
+        tensor_parallel_output_grad: bool = True):
+    """all-gather dim 0 forward / reduce-scatter backward
+    (``_GatherFromSequenceParallelRegion``).  This is the SP entry into a
+    TP matmul: seq-sharded activations are gathered to full sequence; the
+    backward reduce-scatters the (replicated-and-summed) grad back to seq
+    shards.  With ``tensor_parallel_output_grad=False`` the grad is just
+    split (no reduction), matching the reference flag."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return _gather(x, axis_name, 0)
+
+    if tensor_parallel_output_grad:
+        bwd = lambda _, g: (_reduce_scatter(g, axis_name, 0),)
+    else:
+        bwd = lambda _, g: (_split(g, axis_name, 0),)
+    f.defvjp(lambda x: (_gather(x, axis_name, 0), None), bwd)
+    return f(x)
+
+
+def reduce_scatter_to_sequence_parallel_region(
+        x, axis_name: str = TENSOR_AXIS):
+    """reduce-scatter dim 0 forward / all-gather backward
+    (``_ReduceScatterToSequenceParallelRegion``).  SP exit out of a TP
+    matmul: partial sums are reduced and simultaneously re-sharded over
+    sequence."""
+    if _is_identity(axis_name):
+        return x
+
+    @jax.custom_vjp
+    def f(x):
+        return _reduce_scatter(x, axis_name, 0)
+
+    f.defvjp(lambda x: (_reduce_scatter(x, axis_name, 0), None),
+             lambda _, g: (_gather(g, axis_name, 0),))
+    return f(x)

@@ -1,0 +1,32 @@
+"""MemoryBuffer parity (reference:
+``apex/transformer/tensor_parallel/memory.py :: MemoryBuffer``).
+
+The reference pre-allocates one contiguous buffer and hands out zero-copy
+views (used for grad accumulation buffers).  XLA owns device memory and
+donation/aliasing replaces manual pooling, so this is a thin functional
+stand-in: it keeps one flat array and returns reshaped slices.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MemoryBuffer"]
+
+
+class MemoryBuffer:
+    def __init__(self, numel: int, dtype=jnp.float32):
+        self.numel = numel
+        self.dtype = dtype
+        self.data = jnp.zeros((numel,), dtype=dtype)
+
+    def zero(self):
+        self.data = jnp.zeros_like(self.data)
+
+    def get(self, shape, start_index: int):
+        """A view of ``shape`` starting at ``start_index`` (functional: a
+        sliced copy; XLA elides it when fused)."""
+        end = start_index + int(np.prod(shape))
+        if end > self.numel:
+            raise RuntimeError("requested tensor is out of the buffer range")
+        return self.data[start_index:end].reshape(shape)

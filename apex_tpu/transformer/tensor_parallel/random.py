@@ -1,0 +1,141 @@
+"""Parallel RNG state tracking + activation checkpointing.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` forks a distinct CUDA RNG stream per TP rank so
+dropout differs across TP ranks but replays identically when activations are
+recomputed; ``CheckpointFunction`` saves/restores those states around
+recompute.
+
+TPU-native: JAX RNG is a pure function of a threefry key, so both problems
+dissolve:
+
+* *distinct per-rank streams* — fold the TP rank (``lax.axis_index``) into
+  the stream's key;
+* *recompute-identical dropout* — ``jax.checkpoint`` replays the same traced
+  key derivations bit-exactly; no state save/restore exists to get wrong.
+
+The tracker API is preserved so Megatron-style model code ports over: each
+``fork()`` at a given call site yields a deterministic key derived from
+(seed, stream name, per-trace call counter, TP rank).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+__all__ = [
+    "RNGStatesTracker",
+    "CudaRNGStatesTracker",  # parity alias
+    "get_rng_tracker",
+    "get_cuda_rng_tracker",  # parity alias
+    "model_parallel_seed",
+    "model_parallel_cuda_manual_seed",  # parity alias
+    "checkpoint",
+]
+
+# Megatron's offsets: tensor-parallel streams get seed + 2718 + tp_rank,
+# the default (data-parallel) stream gets seed.
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+_TP_SEED_OFFSET = 2718
+
+
+class RNGStatesTracker:
+    """Named deterministic RNG streams (reference: ``CudaRNGStatesTracker``).
+
+    ``add(name, seed)`` registers a stream.  ``fork(name)`` yields a fresh
+    ``jax.random`` key for that stream: ``fold_in(key(seed), call_counter)``
+    plus, for the model-parallel stream, the traced TP rank.  The call
+    counter is per-trace Python state — successive ``fork``s at different
+    call sites give independent keys, and ``jax.checkpoint`` recompute
+    replays the identical traced derivation (the property the reference's
+    state save/restore machinery exists to enforce).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.states_ = {}       # name -> base key
+        self.seeds_ = set()
+        self._counters = {}     # name -> fork call counter (trace-time)
+        self._per_rank = {}     # name -> fold in TP rank?
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int, *, per_tp_rank: bool = False):
+        if seed in self.seeds_:
+            raise RuntimeError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise RuntimeError(f"rng state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+        self._counters[name] = 0
+        self._per_rank[name] = per_tp_rank
+
+    def _next_key(self, name: str):
+        if name not in self.states_:
+            raise RuntimeError(f"rng state {name} is not added")
+        key = jax.random.fold_in(self.states_[name], self._counters[name])
+        self._counters[name] += 1
+        if self._per_rank[name]:
+            tp = 1
+            if parallel_state.model_parallel_is_initialized():
+                tp = parallel_state.get_tensor_model_parallel_world_size()
+            if tp > 1:
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(TENSOR_AXIS))
+        return key
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a key for the named stream (reference forks the CUDA RNG
+        state; here the key itself is the forked stream)."""
+        yield self._next_key(name)
+
+
+# parity alias — there is no CUDA, but Megatron-style code calls this name
+CudaRNGStatesTracker = RNGStatesTracker
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed: int) -> None:
+    """Initialize the default + model-parallel streams (reference:
+    ``model_parallel_cuda_manual_seed``): default stream = ``seed`` shared
+    across TP ranks; model-parallel stream = ``seed + 2718`` folded with the
+    TP rank so dropout differs across TP shards."""
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("default", seed)
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME,
+                           seed + _TP_SEED_OFFSET, per_tp_rank=True)
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+def checkpoint(function, distribute_saved_activations: bool = False, *args):
+    """Activation checkpointing (reference: ``CheckpointFunction.apply``).
+
+    ``jax.checkpoint`` rematerializes ``function`` on the backward pass;
+    RNG replay is automatic (see module docstring).
+    ``distribute_saved_activations`` (reference: shard the saved input over
+    TP ranks to save memory) is accepted for parity; XLA's SPMD partitioner
+    already keeps residuals sharded per the mesh, so it is a no-op here.
+    """
+    return jax.checkpoint(function)(*args)

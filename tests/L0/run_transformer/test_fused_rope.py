@@ -1,0 +1,73 @@
+"""RoPE tests (reference: tests/L0/run_transformer/test_fused_rope.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.transformer.functional import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+
+S, B, H, D = 8, 2, 3, 16
+
+
+def _freqs(s=S, d=D):
+    inv = 1.0 / (10000 ** (jnp.arange(0, d, 2) / d))
+    f = jnp.outer(jnp.arange(s), inv)
+    return jnp.concatenate([f, f], axis=-1).reshape(s, 1, 1, d)
+
+
+def test_cached_matches_uncached():
+    t = jax.random.normal(jax.random.key(0), (S, B, H, D))
+    freqs = _freqs()
+    out = fused_apply_rotary_pos_emb(t, freqs)
+    cached = fused_apply_rotary_pos_emb_cached(
+        t, jnp.cos(freqs), jnp.sin(freqs))
+    np.testing.assert_allclose(out, cached, rtol=1e-6)
+
+
+def test_norm_preserved():
+    """Rotations preserve pairwise norms."""
+    t = jax.random.normal(jax.random.key(1), (S, B, H, D))
+    out = fused_apply_rotary_pos_emb(t, _freqs())
+    np.testing.assert_allclose(
+        jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(t, axis=-1),
+        rtol=1e-5)
+
+
+def test_position_zero_is_identity():
+    t = jax.random.normal(jax.random.key(2), (S, B, H, D))
+    out = fused_apply_rotary_pos_emb(t, _freqs())
+    np.testing.assert_allclose(out[0], t[0], rtol=1e-6, atol=1e-6)
+
+
+def test_partial_rotation_passthrough():
+    t = jax.random.normal(jax.random.key(3), (S, B, H, D))
+    freqs = _freqs(d=D // 2)  # rotate only the first half of channels
+    out = fused_apply_rotary_pos_emb(t, freqs)
+    np.testing.assert_allclose(out[..., D // 2:], t[..., D // 2:])
+
+
+def test_thd_matches_per_sequence_sbhd():
+    """Packed varlen equals applying RoPE per sequence from position 0."""
+    lens = [3, 5]
+    cu = jnp.asarray([0, 3, 8])
+    t = jax.random.normal(jax.random.key(4), (8, H, D))
+    freqs = _freqs(s=8).reshape(8, 1, D)
+    out = fused_apply_rotary_pos_emb_thd(t, cu, freqs.reshape(8, 1, 1, D))
+    # oracle: each sequence restarts positions
+    for seq_idx, (start, ln) in enumerate(zip([0, 3], lens)):
+        seg = t[start:start + ln].reshape(ln, 1, H, D)
+        ref = fused_apply_rotary_pos_emb(
+            seg, freqs[:ln].reshape(ln, 1, 1, D))
+        np.testing.assert_allclose(
+            out[start:start + ln], ref.reshape(ln, H, D), rtol=1e-5,
+            atol=1e-6)
+
+
+def test_grad_flows():
+    t = jax.random.normal(jax.random.key(5), (S, B, H, D))
+    g = jax.grad(lambda t: jnp.sum(
+        fused_apply_rotary_pos_emb(t, _freqs()) ** 2))(t)
+    assert np.all(np.isfinite(np.asarray(g)))

@@ -1,0 +1,79 @@
+"""FusedScaleMaskSoftmax: fused path vs eager fallback (reference:
+tests/L0/run_transformer/test_fused_softmax.py — kernel vs python-fallback
+equality)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import (
+    FusedScaleMaskSoftmax,
+    ScaledMaskedSoftmax,
+    ScaledUpperTriangMaskedSoftmax,
+)
+
+B, NP, SQ, SK = 2, 4, 16, 16
+
+
+def _attention_mask_func(scores, mask):
+    return jnp.where(mask.astype(bool), -10000.0, scores)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("scale", [None, 0.5])
+def test_causal_fused_vs_fallback(dtype, scale):
+    x = jax.random.normal(jax.random.key(0), (B, NP, SQ, SK)).astype(dtype)
+    m = FusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=dtype == jnp.bfloat16,
+        attn_mask_type=AttnMaskType.causal,
+        scaled_masked_softmax_fusion=True,
+        mask_func=_attention_mask_func, softmax_in_fp32=True, scale=scale)
+    fused = m.forward_fused_softmax(x, None)
+    fallback = m.forward_torch_softmax(x, None)
+    np.testing.assert_allclose(
+        np.asarray(fused, np.float32), np.asarray(fallback, np.float32),
+        rtol=1e-3, atol=1e-3)
+    # rows sum to one
+    np.testing.assert_allclose(
+        np.sum(np.asarray(fused, np.float32), -1), 1.0, rtol=1e-2)
+
+
+@pytest.mark.parametrize("scale", [None, 2.0])
+def test_padding_mask_fused_vs_fallback(scale):
+    x = jax.random.normal(jax.random.key(1), (B, NP, SQ, SK))
+    mask = jax.random.bernoulli(
+        jax.random.key(2), 0.3, (B, 1, SQ, SK))
+    m = FusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=False,
+        attn_mask_type=AttnMaskType.padding,
+        scaled_masked_softmax_fusion=True,
+        mask_func=_attention_mask_func, softmax_in_fp32=True, scale=scale)
+    fused = m(x, mask)
+    fallback = m.forward_torch_softmax(x, mask)
+    np.testing.assert_allclose(fused, fallback, rtol=1e-4, atol=1e-4)
+
+
+def test_causal_masks_upper_triangle():
+    x = jnp.zeros((1, SQ, SK))
+    probs = ScaledUpperTriangMaskedSoftmax(x)
+    probs = np.asarray(probs)[0]
+    for i in range(SQ):
+        np.testing.assert_allclose(probs[i, i + 1:], 0.0, atol=1e-7)
+        np.testing.assert_allclose(probs[i, :i + 1], 1.0 / (i + 1),
+                                   rtol=1e-5)
+
+
+def test_masked_softmax_disables_masked_positions():
+    x = jnp.zeros((1, 1, 2, 4))
+    mask = jnp.asarray([[[[True, False, False, True],
+                          [False, False, True, True]]]])
+    probs = np.asarray(ScaledMaskedSoftmax(x, mask))
+    np.testing.assert_allclose(probs[0, 0, 0], [0, 0.5, 0.5, 0], atol=1e-6)
+    np.testing.assert_allclose(probs[0, 0, 1], [0.5, 0.5, 0, 0], atol=1e-6)
+
+
+def test_fp16_bf16_both_raises():
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(True, True, AttnMaskType.padding, True,
+                              None, True, None)

@@ -1,0 +1,134 @@
+"""Collective-algebra tests (reference: tests/L0/run_transformer/test_mappings.py).
+
+Each mapping is checked for BOTH directions of its contract: forward value
+and backward (custom-VJP) value, against the plain-numpy equivalent.
+"""
+import functools
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+
+TP = 4
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def _run(fn, *args, in_specs, out_specs):
+    mesh = parallel_state.get_mesh()
+    return jax.jit(functools.partial(jax.shard_map, check_vma=False)(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))(*args)
+
+
+FULL = P(None, ("pipe", "data", "context", "tensor"))
+SHARD_LAST = P(None, ("pipe", "data", "context", "tensor"))
+
+
+def test_copy_to_region_fwd_and_bwd():
+    x = jnp.arange(8.0).reshape(2, 4)
+
+    def body(x):
+        y = tensor_parallel.copy_to_tensor_model_parallel_region(x)
+        # grad of sum(y) w.r.t. x should be psum(ones) = TP * ones
+        g = jax.grad(lambda x: jnp.sum(
+            tensor_parallel.copy_to_tensor_model_parallel_region(x)))(x)
+        return y, g
+
+    y, g = _run(body, x, in_specs=(P(),), out_specs=(P(), P()))
+    np.testing.assert_allclose(y, x)
+    np.testing.assert_allclose(g, TP * np.ones_like(x))
+
+
+def test_reduce_from_region_fwd_and_bwd():
+    x = jnp.ones((2, 4))
+
+    def body(x):
+        y = tensor_parallel.reduce_from_tensor_model_parallel_region(x)
+        g = jax.grad(lambda x: jnp.sum(
+            tensor_parallel.reduce_from_tensor_model_parallel_region(x)))(x)
+        return y, g
+
+    y, g = _run(body, x, in_specs=(P(),), out_specs=(P(), P()))
+    np.testing.assert_allclose(y, TP * np.ones((2, 4)))
+    np.testing.assert_allclose(g, np.ones_like(x))  # identity bwd
+
+
+def test_scatter_gather_roundtrip():
+    x = jnp.arange(2.0 * 8).reshape(2, 8)
+
+    def body(x):
+        mine = tensor_parallel.scatter_to_tensor_model_parallel_region(x)
+        back = tensor_parallel.gather_from_tensor_model_parallel_region(mine)
+        return mine.shape[-1] * jnp.ones(()), back
+
+    width, back = _run(body, x, in_specs=(P(),), out_specs=(P(), P()))
+    assert int(width) == 8 // TP
+    np.testing.assert_allclose(back, x)
+
+
+def test_gather_bwd_is_split():
+    x = jnp.ones((2, 2 * TP))  # global; local shard is [2, 2]
+
+    def body(x):
+        g = jax.grad(lambda x: jnp.sum(
+            tensor_parallel.gather_from_tensor_model_parallel_region(x)))(x)
+        return g
+
+    g = _run(body, x, in_specs=(P(None, "tensor"),),
+             out_specs=P(None, "tensor"))
+    # each shard's grad is its slice of ones
+    np.testing.assert_allclose(g, np.ones((2, 2 * TP)))
+
+
+def test_sequence_parallel_gather_reduce_scatter():
+    # local seq shard: [s/tp, b]; full seq length 8
+    full = jnp.arange(8.0 * 2).reshape(8, 2)
+
+    def body(x):
+        gathered = tensor_parallel.gather_from_sequence_parallel_region(x)
+        # reduce_scatter of the gathered tensor: sums TP copies then
+        # scatters -> TP * my shard
+        rs = tensor_parallel.reduce_scatter_to_sequence_parallel_region(
+            gathered)
+        return gathered, rs
+
+    gathered, rs = _run(body, full,
+                        in_specs=(P("tensor"),),
+                        out_specs=(P(), P("tensor")))
+    np.testing.assert_allclose(gathered, full)
+    np.testing.assert_allclose(rs, TP * full)
+
+
+def test_scatter_to_sequence_parallel_region():
+    full = jnp.arange(8.0 * 2).reshape(8, 2)
+
+    def body(x):
+        return tensor_parallel.scatter_to_sequence_parallel_region(x)
+
+    mine = _run(body, full, in_specs=(P(),), out_specs=P("tensor"))
+    np.testing.assert_allclose(mine, full)
+
+
+def test_tp1_identity():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=1)
+    x = jnp.arange(6.0).reshape(2, 3)
+    for fn in (tensor_parallel.copy_to_tensor_model_parallel_region,
+               tensor_parallel.reduce_from_tensor_model_parallel_region,
+               tensor_parallel.scatter_to_tensor_model_parallel_region,
+               tensor_parallel.gather_from_tensor_model_parallel_region,
+               tensor_parallel.scatter_to_sequence_parallel_region,
+               tensor_parallel.gather_from_sequence_parallel_region,
+               tensor_parallel.reduce_scatter_to_sequence_parallel_region):
+        np.testing.assert_allclose(fn(x), x)

@@ -1,0 +1,44 @@
+"""Microbatch calculator tests (reference:
+tests/L0/run_transformer/test_microbatches.py)."""
+import pytest
+
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+
+
+def test_constant():
+    calc = ConstantNumMicroBatches(
+        global_batch_size=32, micro_batch_size=2, data_parallel_size=2)
+    assert calc.get() == 8
+    assert calc.get_current_global_batch_size() == 32
+    calc.update(1000, True)  # no-op
+    assert calc.get() == 8
+
+
+def test_constant_indivisible_raises():
+    with pytest.raises(AssertionError):
+        ConstantNumMicroBatches(30, 4, 2)
+
+
+def test_rampup():
+    calc = RampupBatchsizeNumMicroBatches(
+        start_batch_size=4, batch_size_increment=4, ramup_samples=100,
+        global_batch_size=16, micro_batch_size=2, data_parallel_size=1)
+    assert calc.get_current_global_batch_size() == 4
+    assert calc.get() == 2
+    # 3 increments over 100 samples -> 33.3 samples per increment
+    calc.update(50, True)
+    assert calc.get_current_global_batch_size() == 8
+    calc.update(101, True)
+    assert calc.get_current_global_batch_size() == 16
+    assert calc.get() == 8
+
+
+def test_builder_dispatch():
+    c = build_num_microbatches_calculator(0, None, 16, 2, 1)
+    assert isinstance(c, ConstantNumMicroBatches)
+    r = build_num_microbatches_calculator(0, [4, 4, 100], 16, 2, 1)
+    assert isinstance(r, RampupBatchsizeNumMicroBatches)

@@ -1,0 +1,85 @@
+"""Topology tests (reference: tests/L0/run_transformer/test_parallel_state.py)."""
+import functools
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    parallel_state.destroy_model_parallel()
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_initialize_and_sizes():
+    assert not parallel_state.model_parallel_is_initialized()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2)
+    assert parallel_state.model_parallel_is_initialized()
+    assert parallel_state.get_tensor_model_parallel_world_size() == 2
+    assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_data_parallel_world_size() == 2
+    assert parallel_state.get_context_parallel_world_size() == 1
+    mesh = parallel_state.get_mesh()
+    assert mesh.shape["tensor"] == 2 and mesh.shape["pipe"] == 2
+
+
+def test_invalid_world_size():
+    with pytest.raises(RuntimeError):
+        parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size_=3)
+
+
+def test_destroy():
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=2)
+    parallel_state.destroy_model_parallel()
+    assert not parallel_state.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        parallel_state.get_mesh()
+
+
+def test_ranks_inside_shard_map():
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=4, pipeline_model_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+
+    def body():
+        return (parallel_state.get_tensor_model_parallel_rank(),
+                parallel_state.get_pipeline_model_parallel_rank(),
+                parallel_state.get_tensor_model_parallel_src_rank())
+
+    out_spec = P("pipe", "data", "context", "tensor")
+    f = functools.partial(jax.shard_map, check_vma=False)(
+        lambda: tuple(x.reshape(1, 1, 1, 1) for x in body()),
+        mesh=mesh, in_specs=(), out_specs=out_spec)
+    tp_rank, pp_rank, src = jax.jit(f)()
+    # tp rank varies along the tensor axis only
+    np.testing.assert_array_equal(
+        np.asarray(tp_rank)[0, 0, 0], np.arange(4))
+    np.testing.assert_array_equal(
+        np.asarray(pp_rank)[:, 0, 0, 0], np.arange(2))
+    # src rank = my global rank with tp coordinate zeroed -> multiple of tp
+    assert np.all(np.asarray(src) % 4 == 0)
+
+
+def test_first_last_stage_static_when_pp1():
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=1)
+    assert parallel_state.is_pipeline_first_stage() is True
+    assert parallel_state.is_pipeline_last_stage() is True
+
+
+def test_virtual_pipeline_bookkeeping():
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=2,
+        virtual_pipeline_model_parallel_size_=2)
+    assert parallel_state.get_virtual_pipeline_model_parallel_world_size() == 2
+    parallel_state.set_virtual_pipeline_model_parallel_rank(1)
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
+    # non-zero virtual rank means "not the first model chunk"
+    assert parallel_state.is_pipeline_first_stage() is False

@@ -1,0 +1,101 @@
+"""RNG tracker + checkpoint tests (reference:
+tests/L0/run_transformer/test_random.py): per-rank streams differ, default
+stream is shared, recompute replays dropout identically.
+"""
+import functools
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+TP = 4
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    tp_random.model_parallel_seed(123)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_add_duplicate_seed_or_name_raises():
+    tracker = tp_random.RNGStatesTracker()
+    tracker.add("a", 1)
+    with pytest.raises(RuntimeError):
+        tracker.add("b", 1)       # duplicate seed
+    with pytest.raises(RuntimeError):
+        tracker.add("a", 2)       # duplicate name
+    with pytest.raises(RuntimeError):
+        with tracker.fork("missing"):
+            pass
+
+
+def test_model_parallel_stream_differs_across_ranks():
+    mesh = parallel_state.get_mesh()
+
+    def body():
+        tracker = tp_random.get_rng_tracker()
+        with tracker.fork() as key:
+            bits = jax.random.uniform(key, (4,))
+        return bits.reshape(1, 4)
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(),
+        out_specs=P("tensor")))()
+    out = np.asarray(out)  # [TP, 4]
+    for i in range(TP):
+        for j in range(i + 1, TP):
+            assert not np.allclose(out[i], out[j]), (
+                "model-parallel dropout streams must differ across TP ranks")
+
+
+def test_default_stream_shared_across_ranks():
+    mesh = parallel_state.get_mesh()
+
+    def body():
+        tracker = tp_random.get_rng_tracker()
+        with tracker.fork("default") as key:
+            bits = jax.random.uniform(key, (4,))
+        return bits.reshape(1, 4)
+
+    out = np.asarray(jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(), out_specs=P("tensor")))())
+    for i in range(1, TP):
+        np.testing.assert_array_equal(out[0], out[i])
+
+
+def test_checkpoint_recompute_identical_dropout():
+    """The property CudaRNGStatesTracker exists to enforce: grads through a
+    checkpointed dropout region equal grads through the plain region."""
+    tp_random.model_parallel_cuda_manual_seed(7)
+
+    def block(x):
+        tracker = tp_random.get_cuda_rng_tracker()
+        with tracker.fork("default") as key:
+            mask = jax.random.bernoulli(key, 0.5, x.shape)
+        return jnp.sum(jnp.where(mask, x, 0.0) * x)
+
+    x = jax.random.normal(jax.random.key(0), (16,))
+    g_plain = jax.grad(block)(x)
+
+    tp_random.model_parallel_cuda_manual_seed(7)
+    g_ckpt = jax.grad(
+        lambda x: tensor_parallel.checkpoint(block, False, x))(x)
+    np.testing.assert_allclose(g_plain, g_ckpt)
+
+
+def test_fork_advances_between_callsites():
+    tracker = tp_random.RNGStatesTracker()
+    tracker.add("s", 5)
+    with tracker.fork("s") as k1, tracker.fork("s") as k2:
+        a = jax.random.uniform(k1, (4,))
+        b = jax.random.uniform(k2, (4,))
+    assert not np.allclose(a, b)
