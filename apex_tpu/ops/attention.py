@@ -1,0 +1,387 @@
+"""Fused (flash) attention — the TPU-native equivalent of the reference's
+fused-attention extensions.
+
+Reference surface being rebuilt (see SURVEY.md §2.3):
+
+* ``apex/contrib/csrc/fmha/`` (``fmhalib``): fused MHA fwd+bwd, fp16,
+  head_dim 64, seqlen ≤ 512 (FasterTransformer-derived fixed-shape kernels).
+* ``apex/contrib/csrc/multihead_attn/`` (``fast_multihead_attn``): fused
+  QKV GEMM → scaled masked softmax(+dropout) → AV → out-proj chains.
+* ``csrc/megatron/scaled_upper_triang_masked_softmax*``: the causal
+  softmax those attention stacks lean on.
+
+On TPU one blockwise-streaming kernel family covers all of them with no
+shape table: an online-softmax ("flash") attention in Pallas.  Scores for a
+(q-block, k-block) tile live in VMEM, softmax statistics (running max m and
+normalizer l) are carried across k-blocks in VMEM scratch, and the O(s²)
+score matrix never touches HBM — which is exactly the memory-traffic
+property the CUDA kernels buy, achieved compiler-portably.  Unlike
+``fmhalib`` there is no 512-token ceiling: block streaming scales to the
+16k+ sequences the reference's softmax kernels cap out at.
+
+The backward follows the standard flash decomposition: save only
+(out, logsumexp); recompute score tiles blockwise, producing dq in a
+q-major kernel and (dk, dv) in a k-major kernel.
+
+Oracle: :func:`mha_reference` (pure jnp, materializes the score matrix);
+tests assert kernel ≡ oracle, the reference's fused-vs-eager pattern.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils import cdiv, interpret_mode
+
+__all__ = ["flash_attention", "mha_reference"]
+
+_NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
+_LANES = 128              # TPU lane width; m/l scratch is lane-replicated
+
+
+def mha_reference(q, k, v, *, causal: bool = False, mask=None,
+                  sm_scale: Optional[float] = None):
+    """Pure-jnp oracle: softmax(scale·QKᵀ + mask)·V, fp32 accumulation.
+
+    ``mask`` is boolean, True = masked out (the reference's convention in
+    ``scaled_masked_softmax``), broadcastable to ``[b, h, sq, sk]``.
+    """
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(cm, s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# forward kernel: grid (bh, nq, nk), k innermost ("arbitrary"), online softmax
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(causal, off, scale, bq, bk, nk, masked,
+                q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: whole block above the diagonal contributes nothing — skip
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows + off >= cols, s, _NEG_INF)
+        if masked:
+            s = jnp.where(mask_ref[0], _NEG_INF, s)
+        m_prev = m_scr[...]                              # [bq, LANES]
+        m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)               # lane-replicated
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])    # [bq, 1]
+        p = jnp.exp(s - m_new[:, :1])                    # [bq, bk]
+        l_scr[...] = l_scr[...] * alpha + \
+            jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
+            p, v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        # fully-masked rows (l == 0) emit 0, not NaN — matches the oracle's
+        # softmax-of-all--inf convention closely enough for padding rows
+        o_ref[0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)
+                    ).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+
+
+def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = cdiv(sq, bq), cdiv(sk, bk)
+    masked = mask3 is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    if masked:
+        nmask = mask3.shape[0]
+        h_per = bh // nmask
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j: (b // h_per, i, j)))
+    base = functools.partial(_fwd_kernel, causal, sk - sq, scale, bq, bk, nk, masked)
+    kernel = base if masked else (
+        lambda q, k, v, o, lse, m, l, acc: base(q, k, v, None, o, lse,
+                                                m, l, acc))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(*([q3, k3, v3] + ([mask3] if masked else [])))
+    return out, lse[:, :, 0]
+
+
+# --------------------------------------------------------------------------
+# backward kernels (flash decomposition): recompute p blockwise from lse
+# --------------------------------------------------------------------------
+
+def _dq_kernel(causal, off, scale, bq, bk, nk, masked,
+               q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+               dq_ref, dq_scr):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows + off >= cols, s, _NEG_INF)
+        if masked:
+            s = jnp.where(mask_ref[0], _NEG_INF, s)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_scr[...] += scale * jax.lax.dot(
+            ds, kb, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(causal, off, scale, bq, bk, nq, masked,
+                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr):
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q * scale, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows + off >= cols, s, _NEG_INF)
+        if masked:
+            s = jnp.where(mask_ref[0], _NEG_INF, s)
+        p = jnp.exp(s - lse_ref[0][:, :1])                 # [bq, bk]
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # pᵀ @ do
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_scr[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # dsᵀ @ q
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = cdiv(sq, bq), cdiv(sk, bk)
+    masked = mask3 is not None
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)                               # [bh, sq]
+    lse2 = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
+    delta2 = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
+
+    h_per = bh // mask3.shape[0] if masked else 1
+    common = [q3, k3, v3, do3, lse2, delta2] + ([mask3] if masked else [])
+
+    dq_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+    ]
+    if masked:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j: (b // h_per, i, j)))
+
+    dq_base = functools.partial(_dq_kernel, causal, sk - sq, scale, bq, bk, nk, masked)
+    dq_kernel = dq_base if masked else (
+        lambda q, k, v, do, lse, dlt, dq, scr: dq_base(
+            q, k, v, do, lse, dlt, None, dq, scr))
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(*common)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+    ]
+    if masked:
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, j, i: (b // h_per, i, j)))
+
+    dkv_base = functools.partial(
+        _dkv_kernel, causal, sk - sq, scale, bq, bk, nq, masked)
+    dkv_kernel = dkv_base if masked else (
+        lambda q, k, v, do, lse, dlt, dk, dv, s1, s2: dkv_base(
+            q, k, v, do, lse, dlt, None, dk, dv, s1, s2))
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(*common)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public entry: custom VJP over the kernel pair, oracle fallback for odd shapes
+# --------------------------------------------------------------------------
+
+def _blocks_ok(sq: int, sk: int, bq: int, bk: int) -> bool:
+    return sq % bq == 0 and sk % bk == 0
+
+
+def flash_attention(q, k, v, *, causal: bool = False, mask=None,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused blockwise attention, ``[b, h, s, d]`` layout.
+
+    Drop-in fused path for the reference's ``fmhalib`` /
+    ``fast_multihead_attn`` forward+backward.  ``mask`` is boolean with
+    True = masked (broadcastable ``[b|1, 1, sq, sk]``).  Falls back to the
+    jnp oracle when the sequence doesn't tile (reference kernels instead
+    refuse such shapes).
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if not _blocks_ok(sq, sk, bq, bk):
+        return mha_reference(q, k, v, causal=causal, mask=mask,
+                             sm_scale=scale)
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    mask3 = None
+    if mask is not None:
+        if mask.ndim != 4:
+            raise ValueError("mask must be [b|1, h|1, sq, sk] boolean")
+        mb, mh = mask.shape[0], mask.shape[1]
+        if mh == 1:
+            mask3 = jnp.broadcast_to(
+                mask, (mb, 1, sq, sk)).reshape(mb, sq, sk)
+        else:           # per-head mask: materialize the full [b*h, sq, sk]
+            mask3 = jnp.broadcast_to(
+                mask, (b, h, sq, sk)).reshape(b * h, sq, sk)
+
+    @jax.custom_vjp
+    def run(q3, k3, v3):
+        out, _ = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk)
+        return out
+
+    def run_fwd(q3, k3, v3):
+        out, lse = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk)
+        return out, (q3, k3, v3, out, lse)
+
+    def run_bwd(res, do3):
+        q3, k3, v3, out, lse = res
+        return _bwd_impl(q3, k3, v3, mask3, out, lse, do3,
+                         causal, scale, bq, bk)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(q3, k3, v3).reshape(b, h, sq, d)

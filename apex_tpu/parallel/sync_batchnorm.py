@@ -129,13 +129,24 @@ def _convert_torch(module):
         # keep torch-side sync off (single-process CPU shim) but preserve
         # ALL state (params, running stats, num_batches_tracked) — the
         # conversion contract from the reference.  torch SyncBatchNorm maps
-        # to a plain BatchNorm of the same class layout (BatchNorm2d: its
-        # dominant conv use) so forward works without a process group.
+        # to a dimension-agnostic BatchNorm (SyncBatchNorm accepts 2D-5D
+        # input; every fixed-rank class would reject some of those).
+        # Subclasses with a nonstandard __init__ are passed through
+        # unchanged.
+        class _AnyDimBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+            def _check_input_dim(self, input):
+                if input.dim() < 2:
+                    raise ValueError(
+                        f"expected at least 2D input (got {input.dim()}D)")
+
         cls = type(module)
         if isinstance(module, torch.nn.SyncBatchNorm):
-            cls = torch.nn.BatchNorm2d
-        mod = cls(module.num_features, module.eps, module.momentum,
-                  module.affine, module.track_running_stats)
+            cls = _AnyDimBatchNorm
+        try:
+            mod = cls(module.num_features, module.eps, module.momentum,
+                      module.affine, module.track_running_stats)
+        except TypeError:
+            mod = module
         if module.affine:
             with torch.no_grad():
                 mod.weight = module.weight

@@ -55,7 +55,6 @@ def fused_apply_rotary_pos_emb_thd(t, cu_seqlens, freqs):
     """Packed varlen ([t, h, d] with cu_seqlens boundaries) variant:
     positions restart at each sequence start."""
     positions = jnp.arange(t.shape[0])
-    starts = jnp.zeros((t.shape[0],), cu_seqlens.dtype)
     # position within sequence = index - start of my sequence
     seq_id = jnp.searchsorted(cu_seqlens[1:], positions, side="right")
     starts = cu_seqlens[seq_id]

@@ -98,6 +98,10 @@ class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
             f"and start batch size ({start_batch_size}) to be divisible by "
             f"batch size increment ({batch_size_increment})")
         num_increments = diff_batch_size // batch_size_increment
+        assert num_increments > 0, (
+            f"batch-size rampup requires global batch size "
+            f"({global_batch_size}) > start batch size "
+            f"({start_batch_size}); use ConstantNumMicroBatches otherwise")
         self.ramup_samples = ramup_samples
         assert self.ramup_samples >= 0
         self.rampup_samples_per_increment = (

@@ -1,0 +1,109 @@
+"""Flash attention kernel vs jnp oracle.
+
+Mirrors the reference's fused-attention tests
+(``apex/contrib/test/fmha/test_fmha.py`` — fused vs python reference — and
+``tests/L0/run_transformer/test_fused_softmax.py``'s kernel-vs-fallback
+equality pattern).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.attention import flash_attention, mha_reference
+
+
+def _rand(key, *shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+def _qkv(seed, b, h, sq, sk, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (_rand(kq, b, h, sq, d, dtype=dtype),
+            _rand(kk, b, h, sk, d, dtype=dtype),
+            _rand(kv, b, h, sk, d, dtype=dtype))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (256, 256), (128, 256)])
+def test_forward_matches_oracle(causal, sq, sk):
+    # causal with sq != sk uses bottom-right diagonal alignment (decode with
+    # a KV cache), matching the oracle's tril(k=sk-sq)
+    q, k, v = _qkv(0, 2, 4, sq, sk, 64)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_padding_mask_matches_oracle():
+    b, h, s, d = 2, 4, 128, 64
+    q, k, v = _qkv(1, b, h, s, s, d)
+    # reference convention: True = masked out (scaled_masked_softmax)
+    lengths = jnp.array([96, 128])
+    mask = (jnp.arange(s)[None, :] >= lengths[:, None])  # [b, sk]
+    mask = mask[:, None, None, :]                        # [b, 1, 1, sk]
+    mask = jnp.broadcast_to(mask, (b, 1, s, s))
+    out = flash_attention(q, k, v, mask=mask)
+    ref = mha_reference(q, k, v, mask=mask)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_oracle(causal):
+    b, h, s, d = 1, 2, 128, 64
+    q, k, v = _qkv(2, b, h, s, s, d)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gk, gr):
+        np.testing.assert_allclose(a, b_, atol=1e-3, rtol=1e-3)
+
+
+def test_mask_grads_match_oracle():
+    b, h, s, d = 2, 2, 128, 64
+    q, k, v = _qkv(3, b, h, s, s, d)
+    lengths = jnp.array([64, 128])
+    mask = jnp.broadcast_to(
+        (jnp.arange(s)[None, :] >= lengths[:, None])[:, None, None, :],
+        (b, 1, s, s))
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask=mask) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, mask=mask) ** 2)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gk, gr):
+        np.testing.assert_allclose(a, b_, atol=1e-3, rtol=1e-3)
+
+
+def test_bf16_forward_close():
+    q, k, v = _qkv(4, 1, 2, 128, 128, 64, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                        v.astype(jnp.float32), causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(out.astype(jnp.float32), ref,
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_non_tiling_shape_falls_back():
+    q, k, v = _qkv(5, 1, 1, 100, 100, 64)
+    out = flash_attention(q, k, v)           # falls back to oracle
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_sm_scale_respected():
+    q, k, v = _qkv(6, 1, 2, 128, 128, 64)
+    out = flash_attention(q, k, v, sm_scale=0.05)
+    ref = mha_reference(q, k, v, sm_scale=0.05)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

@@ -1,0 +1,90 @@
+"""SyncBatchNorm tests.
+
+Mirrors the reference's ``tests/distributed/synced_batchnorm/`` pattern:
+stats computed across the data axis must equal single-device stats on the
+concatenated batch; plus the torch-module conversion contract
+(``apex.parallel.convert_syncbn_model``).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+
+def _mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def test_synced_stats_match_global_batch():
+    n_dev, b, h, w, c = 4, 2, 4, 4, 8
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_dev * b, h, w, c))
+    bn = SyncBatchNorm(num_features=c, axis_name="data")
+    vars_ = bn.init(jax.random.PRNGKey(1), x[:b])
+
+    mesh = _mesh(n_dev)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(P("data"),),
+        out_specs=(P("data"), P()), check_vma=False)
+    def run(xs):
+        y, new_vars = bn.apply(vars_, xs, mutable=["batch_stats"])
+        return y, new_vars["batch_stats"]
+
+    y_sync, stats_sync = run(x)
+
+    # single-device oracle: same module with no axis over the full batch
+    bn1 = SyncBatchNorm(num_features=c, axis_name=None)
+    y_ref, vars_ref = bn1.apply(vars_, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(y_sync, y_ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(stats_sync["running_mean"],
+                               vars_ref["batch_stats"]["running_mean"],
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(stats_sync["running_var"],
+                               vars_ref["batch_stats"]["running_var"],
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_eval_uses_running_stats():
+    c = 8
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 4, 4, c))
+    bn = SyncBatchNorm(num_features=c, axis_name=None)
+    vars_ = bn.init(jax.random.PRNGKey(3), x)
+    y = bn.apply(vars_, x, use_running_average=True)
+    # fresh stats: mean 0 var 1 -> identity modulo eps and affine init
+    np.testing.assert_allclose(y, x / np.sqrt(1 + 1e-5), atol=1e-5)
+
+
+class TestTorchConversion:
+    def test_sync_batchnorm_any_rank(self):
+        torch = pytest.importorskip("torch")
+        m = torch.nn.Sequential(
+            torch.nn.Linear(6, 6),
+            torch.nn.SyncBatchNorm(6),
+        )
+        with torch.no_grad():
+            m[1].weight.mul_(2.0).add_(0.5)
+            m[1].running_mean.add_(1.0)
+        conv = convert_syncbn_model(m)
+        # 2D and 3D inputs must both work (SyncBatchNorm accepts 2D-5D;
+        # the old BatchNorm2d mapping rejected them)
+        conv.train()
+        conv(torch.randn(4, 6))
+        conv(torch.randn(4, 6, 3).transpose(1, 2).reshape(12, 6))
+        assert torch.equal(conv[1].weight, m[1].weight)
+        assert conv[1].running_mean is m[1].running_mean
+
+    def test_batchnorm2d_preserved(self):
+        torch = pytest.importorskip("torch")
+        m = torch.nn.Sequential(torch.nn.BatchNorm2d(3))
+        conv = convert_syncbn_model(m)
+        y = conv(torch.randn(2, 3, 4, 4))
+        assert y.shape == (2, 3, 4, 4)
+
+    def test_flax_module_raises(self):
+        with pytest.raises(TypeError):
+            convert_syncbn_model(object())

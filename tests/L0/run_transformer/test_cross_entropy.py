@@ -1,7 +1,6 @@
 """Vocab-parallel CE vs local oracle (reference:
 tests/L0/run_transformer/test_cross_entropy.py)."""
 import functools
-import functools
 import jax
 import jax.numpy as jnp
 import numpy as np

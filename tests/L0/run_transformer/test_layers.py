@@ -2,7 +2,6 @@
 sharded layers must match a dense (unsharded) computation.
 """
 import functools
-import functools
 import flax.linen as nn
 import jax
 import jax.numpy as jnp

@@ -4,7 +4,6 @@ Each mapping is checked for BOTH directions of its contract: forward value
 and backward (custom-VJP) value, against the plain-numpy equivalent.
 """
 import functools
-import functools
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,6 +1,5 @@
 """Topology tests (reference: tests/L0/run_transformer/test_parallel_state.py)."""
 import functools
-import functools
 import jax
 import jax.numpy as jnp
 import numpy as np

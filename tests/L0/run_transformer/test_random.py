@@ -3,7 +3,6 @@ tests/L0/run_transformer/test_random.py): per-rank streams differ, default
 stream is shared, recompute replays dropout identically.
 """
 import functools
-import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
