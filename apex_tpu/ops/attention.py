@@ -338,7 +338,7 @@ def _blocks_ok(sq: int, sk: int, bq: int, bk: int) -> bool:
 
 def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 256):
     """Fused blockwise attention, ``[b, h, s, d]`` layout.
 
     Drop-in fused path for the reference's ``fmhalib`` /

@@ -28,8 +28,12 @@ Design (TPU-first, not a translation):
 * Every kernel has a pure-jnp oracle twin (``*_reference``) used as the test
   oracle and as the fallback for shapes the kernel does not accept.
 
-Flat buffers are viewed as (rows, 128) 2-D arrays — the VPU lane width — and
-processed in blocks of rows.
+Flat buffers are processed as 1-D arrays in blocks of ``_BLOCK`` elements;
+Pallas masks the partial tail block, so buffers of ANY length run with zero
+padding copies — the perf property of the reference's chunked launcher
+(``multi_tensor_apply.cuh`` chunks at arbitrary offsets).  Empty (length-0)
+buffers are handled at the wrapper level (the grid would be empty and the
+SMEM flag/accumulator initializers would never run).
 """
 from __future__ import annotations
 
@@ -40,10 +44,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.utils import interpret_mode, round_up
+from apex_tpu.utils import cdiv, interpret_mode
 
 __all__ = [
-    "as_flat2d",
     "fused_scale",
     "fused_axpby",
     "fused_l2norm",
@@ -57,42 +60,46 @@ __all__ = [
 ]
 
 _LANES = 128
-_BLOCK_ROWS = 512  # 512x128 fp32 = 256 KiB per operand tile
+_BLOCK = 512 * 128  # 1-D block: 256 KiB fp32 per operand tile
 
 ADAM_MODE_L2 = 0  # classic Adam: weight decay folded into the gradient
 ADAM_MODE_ADAMW = 1  # decoupled weight decay
 
 
-def as_flat2d(flat: jax.Array) -> tuple[jax.Array, int]:
-    """Pad a 1-D buffer and view it as (rows, 128); returns (view, orig_len)."""
-    n = flat.shape[0]
-    padded = round_up(max(n, 1), _LANES * _BLOCK_ROWS)
-    if padded != n:
-        flat = jnp.pad(flat, (0, padded - n))
-    return flat.reshape(-1, _LANES), n
+def _grid(x: jax.Array) -> int:
+    return cdiv(x.shape[0], _BLOCK)
 
 
-def _from_flat2d(x2: jax.Array, n: int) -> jax.Array:
-    return x2.reshape(-1)[:n]
-
-
-def _grid(x2: jax.Array) -> int:
-    return x2.shape[0] // _BLOCK_ROWS
-
-
-def _vspec(ndim_rows: int = _BLOCK_ROWS):
-    return pl.BlockSpec((ndim_rows, _LANES), lambda i: (i, 0))
+def _vspec():
+    return pl.BlockSpec((_BLOCK,), lambda i: (i,))
 
 
 def _sspec(n: int):
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def _tail_mask(i, n: int, x, fill):
+    """Zero/neutralize out-of-bounds lanes of the final partial block.
+    Elementwise kernels don't need this (OOB writes are dropped); reduction
+    and flag kernels must not read OOB garbage."""
+    if n % _BLOCK == 0:
+        return x
+    idx = i * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, (_BLOCK,), 0)
+    return jnp.where(idx < n, x, fill)
+
+
+# the flag/accumulator kernels carry SMEM state across grid steps and must
+# run sequentially; the elementwise update kernels are freely parallel
+# (Megacore can split their grid)
+_SEQ = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+_PAR = pltpu.CompilerParams(dimension_semantics=("parallel",))
+
+
 # ---------------------------------------------------------------------------
 # scale / axpby (the amp unscale path) with non-finite detection
 # ---------------------------------------------------------------------------
 
-def _scale_kernel(x_ref, hp_ref, o_ref, flag_ref):
+def _scale_kernel(n, x_ref, hp_ref, o_ref, flag_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -101,7 +108,8 @@ def _scale_kernel(x_ref, hp_ref, o_ref, flag_ref):
 
     x = x_ref[...].astype(jnp.float32)
     y = x * hp_ref[0]
-    bad = jnp.any(~jnp.isfinite(y)).astype(jnp.float32)
+    bad = jnp.any(~jnp.isfinite(_tail_mask(i, n, y, 0.0))
+                  ).astype(jnp.float32)
     flag_ref[0] = jnp.maximum(flag_ref[0], bad)
     o_ref[...] = y.astype(o_ref.dtype)
 
@@ -113,10 +121,12 @@ def fused_scale(flat: jax.Array, scale, out_dtype=None):
     the overflow buffer becomes a returned fp32 flag (0.0 clean, 1.0 inf/nan).
     """
     out_dtype = out_dtype or flat.dtype
-    x2, n = as_flat2d(flat)
+    x2, n = flat, flat.shape[0]
+    if n == 0:   # empty grid would leave the SMEM flag uninitialized
+        return flat.astype(out_dtype), jnp.float32(0.0)
     hp = jnp.asarray([scale], jnp.float32)
     out, flag = pl.pallas_call(
-        _scale_kernel,
+        functools.partial(_scale_kernel, n),
         grid=(_grid(x2),),
         in_specs=[_vspec(), _sspec(1)],
         out_specs=[_vspec(), pl.BlockSpec(memory_space=pltpu.SMEM)],
@@ -124,12 +134,13 @@ def fused_scale(flat: jax.Array, scale, out_dtype=None):
             jax.ShapeDtypeStruct(x2.shape, out_dtype),
             jax.ShapeDtypeStruct((1,), jnp.float32),
         ],
+        compiler_params=_SEQ,
         interpret=interpret_mode(),
     )(x2, hp)
-    return _from_flat2d(out, n), flag[0]
+    return out, flag[0]
 
 
-def _axpby_kernel(x_ref, y_ref, hp_ref, o_ref, flag_ref):
+def _axpby_kernel(n, x_ref, y_ref, hp_ref, o_ref, flag_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -139,7 +150,8 @@ def _axpby_kernel(x_ref, y_ref, hp_ref, o_ref, flag_ref):
     x = x_ref[...].astype(jnp.float32)
     y = y_ref[...].astype(jnp.float32)
     o = hp_ref[0] * x + hp_ref[1] * y
-    bad = jnp.any(~jnp.isfinite(o)).astype(jnp.float32)
+    bad = jnp.any(~jnp.isfinite(_tail_mask(i, n, o, 0.0))
+                  ).astype(jnp.float32)
     flag_ref[0] = jnp.maximum(flag_ref[0], bad)
     o_ref[...] = o.astype(o_ref.dtype)
 
@@ -150,11 +162,13 @@ def fused_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
     Parity: ``amp_C.multi_tensor_axpby`` (csrc/multi_tensor_axpby_kernel.cu).
     """
     out_dtype = out_dtype or x.dtype
-    x2, n = as_flat2d(x)
-    y2, _ = as_flat2d(y)
+    x2, n = x, x.shape[0]
+    y2 = y
+    if n == 0:   # empty grid would leave the SMEM flag uninitialized
+        return x.astype(out_dtype), jnp.float32(0.0)
     hp = jnp.asarray([a, b], jnp.float32)
     out, flag = pl.pallas_call(
-        _axpby_kernel,
+        functools.partial(_axpby_kernel, n),
         grid=(_grid(x2),),
         in_specs=[_vspec(), _vspec(), _sspec(2)],
         out_specs=[_vspec(), pl.BlockSpec(memory_space=pltpu.SMEM)],
@@ -162,23 +176,24 @@ def fused_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
             jax.ShapeDtypeStruct(x2.shape, out_dtype),
             jax.ShapeDtypeStruct((1,), jnp.float32),
         ],
+        compiler_params=_SEQ,
         interpret=interpret_mode(),
     )(x2, y2, hp)
-    return _from_flat2d(out, n), flag[0]
+    return out, flag[0]
 
 
 # ---------------------------------------------------------------------------
 # L2 norm (grad clipping, LAMB global norm)
 # ---------------------------------------------------------------------------
 
-def _l2norm_kernel(x_ref, acc_ref):
+def _l2norm_kernel(n, x_ref, acc_ref):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
         acc_ref[0] = jnp.float32(0.0)
 
-    x = x_ref[...].astype(jnp.float32)
+    x = _tail_mask(i, n, x_ref[...].astype(jnp.float32), 0.0)
     acc_ref[0] += jnp.sum(x * x)
 
 
@@ -187,13 +202,16 @@ def fused_l2norm(flat: jax.Array) -> jax.Array:
 
     Parity: ``amp_C.multi_tensor_l2norm`` (csrc/multi_tensor_l2norm_kernel.cu).
     """
-    x2, _ = as_flat2d(flat)
+    x2, n = flat, flat.shape[0]
+    if n == 0:   # empty grid would leave the SMEM accumulator uninitialized
+        return jnp.float32(0.0)
     acc = pl.pallas_call(
-        _l2norm_kernel,
+        functools.partial(_l2norm_kernel, n),
         grid=(_grid(x2),),
         in_specs=[_vspec()],
         out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((1,), jnp.float32),
+        compiler_params=_SEQ,
         interpret=interpret_mode(),
     )(x2)
     return jnp.sqrt(acc[0])
@@ -259,10 +277,10 @@ def fused_adam_flat(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay,
         jnp.asarray(noop_flag, jnp.float32),
         jnp.asarray(grad_scale, jnp.float32),
     ])
-    p2, n = as_flat2d(p)
-    g2, _ = as_flat2d(g)
-    m2, _ = as_flat2d(m)
-    v2, _ = as_flat2d(v)
+    p2, n = p, p.shape[0]
+    g2 = g
+    m2 = m
+    v2 = v
     po, mo, vo = pl.pallas_call(
         functools.partial(_adam_kernel, bool(adam_w_mode)),
         grid=(_grid(p2),),
@@ -274,9 +292,10 @@ def fused_adam_flat(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay,
             jax.ShapeDtypeStruct(v2.shape, v2.dtype),
         ],
         input_output_aliases={0: 0, 2: 1, 3: 2},
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(p2, g2, m2, v2, hp)
-    return (_from_flat2d(po, n), _from_flat2d(mo, n), _from_flat2d(vo, n))
+    return (po, mo, vo)
 
 
 def adam_reference(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay, step,
@@ -332,9 +351,9 @@ def fused_adagrad_flat(p, g, h, *, lr, eps, weight_decay, w_mode=False,
         jnp.asarray(noop_flag, jnp.float32),
         jnp.asarray(grad_scale, jnp.float32),
     ])
-    p2, n = as_flat2d(p)
-    g2, _ = as_flat2d(g)
-    h2, _ = as_flat2d(h)
+    p2, n = p, p.shape[0]
+    g2 = g
+    h2 = h
     po, ho = pl.pallas_call(
         functools.partial(_adagrad_kernel, bool(w_mode)),
         grid=(_grid(p2),),
@@ -345,9 +364,10 @@ def fused_adagrad_flat(p, g, h, *, lr, eps, weight_decay, w_mode=False,
             jax.ShapeDtypeStruct(h2.shape, h2.dtype),
         ],
         input_output_aliases={0: 0, 2: 1},
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(p2, g2, h2, hp)
-    return _from_flat2d(po, n), _from_flat2d(ho, n)
+    return po, ho
 
 
 # ---------------------------------------------------------------------------
@@ -392,9 +412,9 @@ def fused_sgd_flat(p, g, buf, *, lr, momentum, dampening, weight_decay,
         jnp.asarray(noop_flag, jnp.float32),
         jnp.asarray(grad_scale, jnp.float32),
     ])
-    p2, n = as_flat2d(p)
-    g2, _ = as_flat2d(g)
-    b2, _ = as_flat2d(buf)
+    p2, n = p, p.shape[0]
+    g2 = g
+    b2 = buf
     po, bo = pl.pallas_call(
         functools.partial(_sgd_kernel, bool(nesterov)),
         grid=(_grid(p2),),
@@ -405,9 +425,10 @@ def fused_sgd_flat(p, g, buf, *, lr, momentum, dampening, weight_decay,
             jax.ShapeDtypeStruct(b2.shape, b2.dtype),
         ],
         input_output_aliases={0: 0, 2: 1},
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(p2, g2, b2, hp)
-    return _from_flat2d(po, n), _from_flat2d(bo, n)
+    return po, bo
 
 
 # ---------------------------------------------------------------------------
@@ -454,10 +475,10 @@ def fused_lamb_phase1_flat(p, g, m, v, *, beta1, beta2, eps, weight_decay,
         jnp.asarray(inv_sqrt_bc2, jnp.float32),
         jnp.asarray(grad_scale, jnp.float32),
     ])
-    p2, n = as_flat2d(p)
-    g2, _ = as_flat2d(g)
-    m2, _ = as_flat2d(m)
-    v2, _ = as_flat2d(v)
+    p2, n = p, p.shape[0]
+    g2 = g
+    m2 = m
+    v2 = v
     mo, vo, u = pl.pallas_call(
         _lamb1_kernel,
         grid=(_grid(p2),),
@@ -469,6 +490,7 @@ def fused_lamb_phase1_flat(p, g, m, v, *, beta1, beta2, eps, weight_decay,
             jax.ShapeDtypeStruct(p2.shape, jnp.float32),
         ],
         input_output_aliases={2: 0, 3: 1},
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(p2, g2, m2, v2, hp)
-    return (_from_flat2d(mo, n), _from_flat2d(vo, n), _from_flat2d(u, n))
+    return (mo, vo, u)

@@ -1,0 +1,47 @@
+"""Shared distributed-test helpers.
+
+Reference: ``apex/transformer/testing/commons.py`` —
+``set_random_seed``, ``initialize_distributed``, ``IdentityLayer``.  The
+reference's ``initialize_distributed`` spawns NCCL process groups on one
+host; here the analog is forcing a multi-device CPU platform and building
+the mesh via ``parallel_state.initialize_model_parallel``.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import random as tp_random
+
+__all__ = ["set_random_seed", "initialize_distributed", "IdentityLayer"]
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Seed everything (reference seeds python/numpy/torch/cuda-tracker);
+    returns the root PRNG key and seeds the model-parallel tracker."""
+    np.random.seed(seed)
+    tp_random.model_parallel_seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def initialize_distributed(backend: str = "xla") -> None:
+    """Reference parity shim: NCCL/UCC init has no TPU analog — device
+    discovery is XLA's job.  Kept so ported test code runs unchanged;
+    asserts devices exist."""
+    assert len(jax.devices()) >= 1
+
+
+class IdentityLayer(nn.Module):
+    """A single learnable tensor behind ``__call__`` (reference:
+    ``IdentityLayer`` — used to give tests a differentiable leaf)."""
+    shape: tuple
+    init_scale: float = 1.0
+
+    @nn.compact
+    def __call__(self):
+        w = self.param(
+            "weight",
+            nn.initializers.normal(stddev=self.init_scale), self.shape)
+        return w

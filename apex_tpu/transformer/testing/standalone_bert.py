@@ -1,0 +1,148 @@
+"""Standalone Megatron-style BERT on the apex_tpu TP layers.
+
+Reference: ``apex/transformer/testing/standalone_bert.py`` — a minimal
+bidirectional encoder over the TP layers with an MLM head, the fixture for
+PP tests (``test_bert_minimal.py``) and the BERT-large+FusedLAMB flagship
+(BASELINE config 3).
+
+Shares the GPT building blocks (``ParallelTransformerLayer`` with
+``causal=False``); adds token-type embeddings, a padding attention mask
+(True = masked, the ``scaled_masked_softmax`` convention), the MLM
+transform head, and a binary (NSP) head.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.tensor_parallel import VocabParallelEmbedding
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (
+    GPTConfig,
+    ParallelTransformerLayer,
+)
+
+__all__ = ["BertConfig", "BertModel", "bert_model_provider"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    """BERT-large (BASELINE config 3): hidden 1024, layers 24, heads 16."""
+    vocab_size: int = 30592                  # divisible-by-TP padded vocab
+    hidden_size: int = 1024
+    ffn_hidden_size: Optional[int] = None
+    num_layers: int = 24
+    num_attention_heads: int = 16
+    max_seq_length: int = 512
+    num_token_types: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    params_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    remat: bool = False
+
+    def gpt_cfg(self) -> GPTConfig:
+        return GPTConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            ffn_hidden_size=self.ffn_hidden_size,
+            num_layers=self.num_layers,
+            num_attention_heads=self.num_attention_heads,
+            max_seq_length=self.max_seq_length,
+            hidden_dropout=self.hidden_dropout,
+            attention_dropout=self.attention_dropout,
+            params_dtype=self.params_dtype,
+            sequence_parallel=self.sequence_parallel,
+            remat=self.remat)
+
+
+class BertModel(nn.Module):
+    """Embeddings -> N bidirectional layers -> final LN -> MLM head with
+    tied vocab-parallel logits (+ optional NSP logits from pooled [CLS])."""
+    cfg: BertConfig
+    add_binary_head: bool = True
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None, attention_mask=None,
+                 lm_labels=None, deterministic: bool = True):
+        cfg = self.cfg
+        gcfg = self.cfg.gpt_cfg()
+        b, s = tokens.shape
+
+        word = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, params_dtype=cfg.params_dtype,
+            name="word_embeddings")(tokens)
+        pos = self.param(
+            "position_embeddings", nn.initializers.normal(stddev=0.02),
+            (cfg.max_seq_length, cfg.hidden_size), cfg.params_dtype)
+        h = word + pos[None, :s, :]
+        if token_types is not None:
+            tt = nn.Embed(cfg.num_token_types, cfg.hidden_size,
+                          param_dtype=cfg.params_dtype,
+                          name="tokentype_embeddings")(token_types)
+            h = h + tt
+        h = h.transpose(1, 0, 2)                       # [s, b, h]
+        if cfg.sequence_parallel:
+            h = mappings.scatter_to_sequence_parallel_region(h)
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=False)
+
+        # padding mask [b, s] (1 = keep) -> flash-attention boolean
+        # [b, 1, s, s] with True = masked
+        mask4 = None
+        if attention_mask is not None:
+            keep = attention_mask.astype(bool)
+            mask4 = ~keep[:, None, None, :]
+            mask4 = jnp.broadcast_to(mask4, (b, 1, s, s))
+
+        for i in range(cfg.num_layers):
+            h = ParallelTransformerLayer(
+                gcfg, causal=False, name=f"layer_{i}")(
+                    h, mask4, deterministic)
+        if cfg.sequence_parallel:
+            h = mappings.gather_from_sequence_parallel_region(
+                h, tensor_parallel_output_grad=False)
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                           name="final_layernorm")(h)
+
+        # MLM transform (reference: BertLMHead): dense + gelu + LN, then
+        # tied vocab-parallel logits
+        t = nn.Dense(cfg.hidden_size, param_dtype=cfg.params_dtype,
+                     name="lm_head_dense")(h)
+        t = jax.nn.gelu(t)
+        t = FusedLayerNorm(normalized_shape=cfg.hidden_size,
+                           name="lm_head_layernorm")(t)
+        emb_shard = self.variables["params"]["word_embeddings"]["weight"]
+        lm_logits = jnp.einsum("sbh,vh->sbv", t, emb_shard)
+
+        binary_logits = None
+        if self.add_binary_head:
+            pooled = jnp.tanh(nn.Dense(
+                cfg.hidden_size, param_dtype=cfg.params_dtype,
+                name="pooler")(h[0]))                   # [CLS] position
+            binary_logits = nn.Dense(
+                2, param_dtype=cfg.params_dtype, name="binary_head")(pooled)
+
+        if lm_labels is None:
+            return lm_logits, binary_logits
+        loss = vocab_parallel_cross_entropy(
+            lm_logits.astype(jnp.float32), lm_labels.T)
+        if attention_mask is not None:
+            w = attention_mask.T.astype(jnp.float32)
+            loss = (loss * w).sum() / jnp.maximum(w.sum(), 1.0)
+        else:
+            loss = loss.mean()
+        return loss, binary_logits
+
+
+def bert_model_provider(cfg: BertConfig = BertConfig(),
+                        add_binary_head: bool = True) -> BertModel:
+    """Reference: ``standalone_bert.py :: bert_model_provider``."""
+    return BertModel(cfg, add_binary_head=add_binary_head)

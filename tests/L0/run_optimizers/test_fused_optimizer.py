@@ -295,3 +295,36 @@ class TestMultiTensorApply:
         np.testing.assert_allclose(np.asarray(outs[0]), 0.5)
         np.testing.assert_allclose(np.asarray(outs[1]), 1.0)
         assert float(flag) == 0.0
+
+
+class TestEmptyBuffers:
+    """Zero-length flat buffers must not read uninitialized SMEM (the grid
+    would be empty, skipping the flag/accumulator init)."""
+
+    def test_fused_scale_empty(self):
+        from apex_tpu.ops.fused_update import fused_scale
+        out, flag = fused_scale(jnp.zeros((0,), jnp.float32), 2.0)
+        assert out.shape == (0,)
+        assert float(flag) == 0.0
+
+    def test_fused_axpby_empty(self):
+        from apex_tpu.ops.fused_update import fused_axpby
+        out, flag = fused_axpby(1.0, jnp.zeros((0,), jnp.float32),
+                                2.0, jnp.zeros((0,), jnp.float32))
+        assert out.shape == (0,)
+        assert float(flag) == 0.0
+
+    def test_fused_l2norm_empty(self):
+        from apex_tpu.ops.fused_update import fused_l2norm
+        assert float(fused_l2norm(jnp.zeros((0,), jnp.float32))) == 0.0
+
+    def test_odd_sizes_match_reference(self):
+        from apex_tpu.ops.fused_update import fused_l2norm, fused_scale
+        for n in (1, 127, 129, 65537):
+            x = jnp.arange(n, dtype=jnp.float32) % 13 - 6.0
+            np.testing.assert_allclose(
+                float(fused_l2norm(x)), float(jnp.linalg.norm(x)),
+                rtol=1e-5)
+            out, flag = fused_scale(x, 3.0)
+            np.testing.assert_allclose(out, x * 3.0, rtol=1e-6)
+            assert float(flag) == 0.0
