@@ -1,0 +1,14 @@
+"""apex_tpu.contrib — rebuilds of the reference's contrib islands
+(``apex/contrib/``), each a thin Python surface over a TPU-native core.
+
+Tier-1 islands (full behavior):
+
+* :mod:`clip_grad` — multi-tensor-kernel ``clip_grad_norm_``
+* :mod:`xentropy` — fused softmax cross-entropy (Pallas streaming lse)
+* :mod:`multihead_attn` — Self/Encdec fused attention modules (flash kernel)
+* :mod:`layer_norm` — ``FastLayerNorm`` (alias of the Pallas LN kernel; the
+  reference ships a second per-hidden-size tuned CUDA LN, one kernel covers
+  both here)
+* :mod:`optimizers` — ``DistributedFusedAdam``/``DistributedFusedLAMB``
+  (ZeRO-style reduce-scatter/shard-update/all-gather over the data axis)
+"""

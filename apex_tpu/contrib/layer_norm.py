@@ -1,0 +1,18 @@
+"""Reference: ``apex/contrib/layer_norm/layer_norm.py :: FastLayerNorm`` —
+hand-tuned per-hidden-size LN kernels (768..65536 table) over the
+``fast_layer_norm`` ext.
+
+On TPU one autotiled Pallas kernel (``apex_tpu.ops.layer_norm``) covers
+every hidden size, so ``FastLayerNorm`` is the same module as
+``FusedLayerNorm`` with the contrib class's restricted signature (no
+elementwise-affine toggle; hidden size only).
+"""
+from __future__ import annotations
+
+from apex_tpu.normalization import FusedLayerNorm as _FusedLayerNorm
+
+__all__ = ["FastLayerNorm"]
+
+
+class FastLayerNorm(_FusedLayerNorm):
+    pass

@@ -1,0 +1,131 @@
+"""Fused multi-head attention modules.
+
+Reference: ``apex/contrib/multihead_attn/`` — ``SelfMultiheadAttn`` /
+``EncdecMultiheadAttn`` over the ``fast_multihead_attn`` ext (fused
+QKV GEMM → scaled masked softmax(+dropout) → AV → out-proj, with
+``include_norm_add`` pre-LN + residual variants and ``impl='fast'|'default'``).
+
+TPU-native: the GEMM chain is XLA dots, the softmax·V core is the Pallas
+flash kernel (``apex_tpu.ops.attention``), and the norm-add variant is the
+fused Pallas LayerNorm + residual.  ``impl`` selects kernel vs jnp-oracle
+core (the reference's fast/default split).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.attention import flash_attention, mha_reference
+
+__all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]
+
+
+def _core(q, k, v, mask, impl):
+    if impl == "fast":
+        return flash_attention(q, k, v, mask=mask)
+    return mha_reference(q, k, v, mask=mask)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Self-attention with packed QKV projection (reference:
+    ``SelfMultiheadAttn(embed_dim, num_heads, dropout, bias,
+    include_norm_add, impl)``).  Layout ``[seq, batch, hidden]`` like the
+    reference; returns ``(output, attn_weights=None)``."""
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key_padding_mask=None, attn_mask=None,
+                 is_training: bool = True):
+        s, b, h = query.shape
+        nh = self.num_heads
+        hd = h // nh
+        residual = query
+        x = query
+        if self.include_norm_add:
+            x = FusedLayerNorm(normalized_shape=h, name="lyr_norm")(x)
+        qkv = nn.Dense(3 * h, use_bias=self.bias,
+                       param_dtype=self.params_dtype,
+                       name="qkv_proj")(x)
+        qkv = qkv.reshape(s, b, nh, 3 * hd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))  # [b,nh,s,d]
+        mask = None
+        if key_padding_mask is not None:
+            # [b, s] True = pad (reference convention)
+            mask = jnp.broadcast_to(
+                key_padding_mask[:, None, None, :].astype(bool),
+                (b, 1, s, s))
+        elif attn_mask is not None:
+            mask = jnp.broadcast_to(attn_mask.astype(bool)[None, None],
+                                    (1, 1, s, s))
+        ctx = _core(q, k, v, mask, self.impl)
+        if is_training and self.dropout > 0.0:
+            ctx = nn.Dropout(self.dropout)(ctx, deterministic=False)
+        out = ctx.transpose(2, 0, 1, 3).reshape(s, b, h)
+        out = nn.Dense(h, use_bias=self.bias,
+                       param_dtype=self.params_dtype,
+                       name="out_proj")(out)
+        if self.include_norm_add:
+            out = out + residual
+        return out, None
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Encoder-decoder attention: Q from the decoder stream, packed KV from
+    the encoder stream (reference: ``EncdecMultiheadAttn``)."""
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, key_padding_mask=None, attn_mask=None,
+                 is_training: bool = True):
+        sq, b, h = query.shape
+        sk = key.shape[0]
+        nh = self.num_heads
+        hd = h // nh
+        residual = query
+        x = query
+        if self.include_norm_add:
+            x = FusedLayerNorm(normalized_shape=h, name="lyr_norm")(x)
+        q = nn.Dense(h, use_bias=self.bias, param_dtype=self.params_dtype,
+                     name="q_proj")(x)
+        kv = nn.Dense(2 * h, use_bias=self.bias,
+                      param_dtype=self.params_dtype,
+                      name="kv_proj")(key)
+        kv = kv.reshape(sk, b, nh, 2 * hd)
+        k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(sq, b, nh, hd).transpose(1, 2, 0, 3)
+        k, v = (t.transpose(1, 2, 0, 3) for t in (k, v))
+        mask = None
+        if key_padding_mask is not None:
+            mask = jnp.broadcast_to(
+                key_padding_mask[:, None, None, :].astype(bool),
+                (b, 1, sq, sk))
+        elif attn_mask is not None:
+            mask = jnp.broadcast_to(attn_mask.astype(bool)[None, None],
+                                    (1, 1, sq, sk))
+        ctx = _core(q, k, v, mask, self.impl)
+        if is_training and self.dropout > 0.0:
+            ctx = nn.Dropout(self.dropout)(ctx, deterministic=False)
+        out = ctx.transpose(2, 0, 1, 3).reshape(sq, b, h)
+        out = nn.Dense(h, use_bias=self.bias,
+                       param_dtype=self.params_dtype,
+                       name="out_proj")(out)
+        if self.include_norm_add:
+            out = out + residual
+        return out, None

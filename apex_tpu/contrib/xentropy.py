@@ -1,0 +1,20 @@
+"""Reference: ``apex/contrib/xentropy/softmax_xentropy.py ::
+SoftmaxCrossEntropyLoss`` over the ``xentropy_cuda`` ext."""
+from __future__ import annotations
+
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+class SoftmaxCrossEntropyLoss:
+    """Class-shaped parity shim: the reference exposes an autograd Function
+    used as ``SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing,
+    padding_idx, half_to_float)``; here ``apply`` is the fused function."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=-100,
+              half_to_float=False):
+        return softmax_cross_entropy_loss(
+            logits, labels, smoothing=smoothing, padding_idx=padding_idx,
+            half_to_float=half_to_float)

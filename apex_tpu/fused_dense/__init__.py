@@ -1,0 +1,62 @@
+"""Fused dense layers (reference: ``apex/fused_dense/fused_dense.py`` over
+``fused_dense_cuda`` — cublasLt epilogue GEMMs: bias, gelu-aux).
+
+GEMM+bias(+GELU) is a native XLA epilogue fusion on TPU; the modules keep
+the reference's class surface (``FusedDense``, ``FusedDenseGeluDense``,
+``DenseNoBias``) and its functional forms.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FusedDense", "FusedDenseGeluDense", "DenseNoBias",
+           "fused_dense_function", "fused_dense_gelu_dense_function"]
+
+
+def fused_dense_function(x, weight, bias=None):
+    """y = x @ Wᵀ + b (parity: ``fused_dense_cuda.linear_bias_forward``)."""
+    y = x @ weight.T
+    return y if bias is None else y + bias
+
+
+def fused_dense_gelu_dense_function(x, w1, b1, w2, b2):
+    """x @ W1ᵀ + b1 → gelu → @ W2ᵀ + b2 (parity:
+    ``linear_gelu_linear_forward``)."""
+    h = jax.nn.gelu(x @ w1.T + b1)
+    return h @ w2.T + b2
+
+
+class FusedDense(nn.Module):
+    in_features: int
+    out_features: int
+    bias: bool = True
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.out_features, use_bias=self.bias,
+                        param_dtype=self.params_dtype, name="dense")(x)
+
+
+class DenseNoBias(FusedDense):
+    bias: bool = False
+
+
+class FusedDenseGeluDense(nn.Module):
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.intermediate_features, use_bias=self.bias,
+                     param_dtype=self.params_dtype, name="dense1")(x)
+        h = jax.nn.gelu(h)
+        return nn.Dense(self.out_features, use_bias=self.bias,
+                        param_dtype=self.params_dtype, name="dense2")(h)

@@ -1,0 +1,54 @@
+"""Fused MLP (reference: ``apex/mlp/mlp.py :: MLP`` over ``mlp_cuda`` —
+whole-MLP fwd/bwd as chained cuBLAS GEMMs with fused bias/ReLU epilogues).
+
+On TPU the GEMM+bias+activation chain is a single XLA fusion already (the
+property the CUDA ext exists to create), so the module is a flax chain with
+the reference's signature: ``MLP(mlp_sizes, bias=True, relu=True)``; the
+functional form takes the packed weight list like ``MlpFunction``.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["MLP", "mlp_function"]
+
+
+def mlp_function(x, weights: Sequence, biases: Sequence | None,
+                 activation: str = "relu"):
+    """Functional whole-MLP fwd (parity: ``mlp_cuda.forward`` /
+    ``MlpFunction.apply``); autodiff supplies the fused backward."""
+    h = x
+    for i, w in enumerate(weights):
+        h = h @ w.T
+        if biases is not None:
+            h = h + biases[i]
+        # activation after EVERY layer incl. the last (reference behavior)
+        if activation == "relu":
+            h = jax.nn.relu(h)
+        elif activation == "sigmoid":
+            h = jax.nn.sigmoid(h)
+    return h
+
+
+class MLP(nn.Module):
+    """Reference signature: ``MLP(mlp_sizes, bias=True, relu=True)`` where
+    ``mlp_sizes = [in, h1, ..., out]``; ReLU after every layer including
+    the last (the reference's behavior — it targets recommender stacks)."""
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    relu: bool = True
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(len(self.mlp_sizes) - 1):
+            x = nn.Dense(self.mlp_sizes[i + 1], use_bias=self.bias,
+                         param_dtype=self.params_dtype,
+                         name=f"layer_{i}")(x)
+            if self.relu:
+                x = jax.nn.relu(x)
+        return x

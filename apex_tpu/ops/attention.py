@@ -332,8 +332,15 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk):
 # public entry: custom VJP over the kernel pair, oracle fallback for odd shapes
 # --------------------------------------------------------------------------
 
-def _blocks_ok(sq: int, sk: int, bq: int, bk: int) -> bool:
-    return sq % bq == 0 and sk % bk == 0
+def _fit_block(s: int, preferred: int):
+    """Largest block <= preferred that divides s and is a lane multiple
+    (or s itself when s < 128); None -> fall back to the oracle."""
+    if s <= preferred:
+        return s
+    for cand in range(preferred, _LANES - 1, -_LANES):
+        if s % cand == 0:
+            return cand
+    return None
 
 
 def flash_attention(q, k, v, *, causal: bool = False, mask=None,
@@ -350,8 +357,9 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = (d ** -0.5) if sm_scale is None else sm_scale
-    bq, bk = min(block_q, sq), min(block_k, sk)
-    if not _blocks_ok(sq, sk, bq, bk):
+    bq = _fit_block(sq, block_q)
+    bk = _fit_block(sk, block_k)
+    if bq is None or bk is None:
         return mha_reference(q, k, v, causal=causal, mask=mask,
                              sm_scale=scale)
     q3 = q.reshape(b * h, sq, d)

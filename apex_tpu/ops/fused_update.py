@@ -74,7 +74,7 @@ def _vspec():
     return pl.BlockSpec((_BLOCK,), lambda i: (i,))
 
 
-def _sspec(n: int):
+def _sspec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
@@ -128,7 +128,7 @@ def fused_scale(flat: jax.Array, scale, out_dtype=None):
     out, flag = pl.pallas_call(
         functools.partial(_scale_kernel, n),
         grid=(_grid(x2),),
-        in_specs=[_vspec(), _sspec(1)],
+        in_specs=[_vspec(), _sspec()],
         out_specs=[_vspec(), pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_shape=[
             jax.ShapeDtypeStruct(x2.shape, out_dtype),
@@ -170,7 +170,7 @@ def fused_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
     out, flag = pl.pallas_call(
         functools.partial(_axpby_kernel, n),
         grid=(_grid(x2),),
-        in_specs=[_vspec(), _vspec(), _sspec(2)],
+        in_specs=[_vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_shape=[
             jax.ShapeDtypeStruct(x2.shape, out_dtype),
@@ -284,7 +284,7 @@ def fused_adam_flat(p, g, m, v, *, lr, beta1, beta2, eps, weight_decay,
     po, mo, vo = pl.pallas_call(
         functools.partial(_adam_kernel, bool(adam_w_mode)),
         grid=(_grid(p2),),
-        in_specs=[_vspec(), _vspec(), _vspec(), _vspec(), _sspec(9)],
+        in_specs=[_vspec(), _vspec(), _vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), _vspec(), _vspec()],
         out_shape=[
             jax.ShapeDtypeStruct(p2.shape, p2.dtype),
@@ -357,7 +357,7 @@ def fused_adagrad_flat(p, g, h, *, lr, eps, weight_decay, w_mode=False,
     po, ho = pl.pallas_call(
         functools.partial(_adagrad_kernel, bool(w_mode)),
         grid=(_grid(p2),),
-        in_specs=[_vspec(), _vspec(), _vspec(), _sspec(5)],
+        in_specs=[_vspec(), _vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), _vspec()],
         out_shape=[
             jax.ShapeDtypeStruct(p2.shape, p2.dtype),
@@ -418,7 +418,7 @@ def fused_sgd_flat(p, g, buf, *, lr, momentum, dampening, weight_decay,
     po, bo = pl.pallas_call(
         functools.partial(_sgd_kernel, bool(nesterov)),
         grid=(_grid(p2),),
-        in_specs=[_vspec(), _vspec(), _vspec(), _sspec(7)],
+        in_specs=[_vspec(), _vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), _vspec()],
         out_shape=[
             jax.ShapeDtypeStruct(p2.shape, p2.dtype),
@@ -482,7 +482,7 @@ def fused_lamb_phase1_flat(p, g, m, v, *, beta1, beta2, eps, weight_decay,
     mo, vo, u = pl.pallas_call(
         _lamb1_kernel,
         grid=(_grid(p2),),
-        in_specs=[_vspec(), _vspec(), _vspec(), _vspec(), _sspec(7)],
+        in_specs=[_vspec(), _vspec(), _vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), _vspec(), _vspec()],
         out_shape=[
             jax.ShapeDtypeStruct(m2.shape, m2.dtype),

@@ -169,6 +169,19 @@ class ParallelTransformerLayer(nn.Module):
         return x + h
 
 
+class _ScanBlock(nn.Module):
+    """nn.scan adapter: lax.scan bodies return (carry, out)."""
+    cfg: GPTConfig
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, h, attention_mask=None, deterministic: bool = True):
+        h = ParallelTransformerLayer(
+            self.cfg, causal=self.causal, name="layer")(
+                h, attention_mask, deterministic)
+        return h, None
+
+
 class GPTEmbedding(nn.Module):
     """Vocab-parallel word embedding + learned positions (reference:
     Megatron Embedding)."""
@@ -203,10 +216,10 @@ class GPTModel(nn.Module):
         cfg = self.cfg
         self.embedding = GPTEmbedding(cfg, name="embedding")
         if cfg.scan_layers:
-            block = ParallelTransformerLayer
+            block = _ScanBlock          # returns the (carry, out) pair
             if cfg.remat:
                 block = nn.remat(
-                    block, static_argnums=(2,),
+                    block, static_argnums=(3,),   # deterministic
                     policy=jax.checkpoint_policies.nothing_saveable)
             self.layers = nn.scan(
                 block,
