@@ -119,8 +119,9 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked,
         lse_ref[0] = (m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l)))
 
 
-def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk):
+def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk, out_dtype=None):
     bh, sq, d = q3.shape
+    out_dtype = out_dtype or q3.dtype
     sk = k3.shape[1]
     nq, nk = cdiv(sq, bq), cdiv(sk, bk)
     masked = mask3 is not None
@@ -147,7 +148,7 @@ def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk):
             pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, d), out_dtype),
             jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
@@ -247,7 +248,8 @@ def _dkv_kernel(causal, off, scale, bq, bk, nq, masked,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk):
+def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
+              out_dtype=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = cdiv(sq, bq), cdiv(sk, bk)
@@ -281,7 +283,7 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk):
         grid=(bh, nq, nk),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q3.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -314,8 +316,8 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v3.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),

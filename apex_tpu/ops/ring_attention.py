@@ -1,0 +1,175 @@
+"""Ring attention — context-parallel exact attention for long sequences.
+
+The reference has NO context parallelism (SURVEY.md §2.4: its longest-
+sequence tool is Megatron sequence parallelism; fused softmax caps at 16k,
+fmha at 512).  The task spec makes long-context first-class, so this is the
+designed-for-TPU extension: shard the sequence over the ``context`` mesh
+axis and keep attention EXACT by rotating K/V shards around the ring with
+``jax.lax.ppermute`` (ICI neighbor traffic), combining per-shard partial
+attention with the same online-softmax algebra the flash kernel uses
+(RingAttention, Liu et al. 2023; the blockwise-parallel formulation).
+
+Each of the cp steps runs the local Pallas flash kernel (which returns
+(out, lse)); partials merge in log-space:
+
+    m   = max(lse_a, lse_b)
+    out = (out_a·e^{lse_a−m} + out_b·e^{lse_b−m}) / (e^{lse_a−m}+e^{lse_b−m})
+
+Causal masking across shards: with sequence shard i holding tokens
+[i·S, (i+1)·S), a K/V shard j is fully visible when j < i, invisible when
+j > i, and diagonal (locally causal) when j == i — handled per step with a
+static switch on the rotation index (the ring order is known at trace
+time), so no cross-shard index arithmetic reaches the kernel.
+
+Composes under ``shard_map`` with the ``context`` axis of
+``parallel_state``'s mesh; cp=1 degrades to plain flash attention.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import _bwd_impl, _fwd, _fit_block, mha_reference
+from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
+
+__all__ = ["ring_attention", "ring_attention_reference"]
+
+
+def ring_attention_reference(q, k, v, *, causal=False,
+                             sm_scale: Optional[float] = None):
+    """Oracle: plain attention on the FULL (already gathered) sequence."""
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _local_flash(q3, k3, v3, causal, scale, bq, bk):
+    """One shard-pair partial: (out [bh,s,d] fp32, lse [bh,s]) — partials
+    stay fp32 so the cp-step ring accumulation doesn't round through the
+    input dtype at every merge."""
+    return _fwd(q3, k3, v3, None, causal, scale, bq, bk,
+                out_dtype=jnp.float32)
+
+
+def _merge(out_a, lse_a, out_b, lse_b):
+    """Log-space combine of two attention partials over the same queries."""
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)[..., None]
+    wb = jnp.exp(lse_b - m)[..., None]
+    out = (out_a * wa + out_b * wb) / (wa + wb)
+    return out, m + jnp.log(wa[..., 0] + wb[..., 0])
+
+
+def ring_attention(q, k, v, *, causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   axis_name: str = CONTEXT_AXIS,
+                   block_q: int = 512, block_k: int = 256):
+    """Exact attention over a context-sharded sequence.
+
+    ``q, k, v``: ``[b, h, s_local, d]`` — this rank's sequence shard (rank
+    i holds tokens ``[i*s_local, (i+1)*s_local)``).  Must run inside
+    ``shard_map`` binding ``axis_name``; returns the local output shard.
+    """
+    b, h, s_local, d = q.shape
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    cp = jax.lax.axis_size(axis_name) if axis_name else 1
+    if cp == 1:
+        from apex_tpu.ops.attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale,
+                               block_q=block_q, block_k=block_k)
+
+    bq = _fit_block(s_local, block_q)
+    bk = _fit_block(s_local, block_k)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"ring_attention local shard length {s_local} must tile into "
+            f"lane-multiple blocks")
+
+    q3 = q.reshape(b * h, s_local, d)
+    k3in = k.reshape(b * h, s_local, d)
+    v3in = v.reshape(b * h, s_local, d)
+    # rotation: at step t this rank holds K/V shard (my - t) mod cp.
+    # Causal visibility is static-per-step: shard src = (my-t) mod cp is
+    # src <= my  ⟺  my >= t, and the diagonal (src == my) ⟺ t == 0 — so
+    # step 0 runs the locally-causal kernel, later steps run the full
+    # kernel with validity masked by the traced (my >= t).
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def rot(x):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    @jax.custom_vjp
+    def run(q3, k3in, v3in):
+        out, _ = _ring_fwd(q3, k3in, v3in)
+        return out
+
+    def _ring_fwd(q3, k3in, v3in):
+        my = jax.lax.axis_index(axis_name)
+        out = jnp.zeros((b * h, s_local, d), jnp.float32)
+        lse = jnp.full((b * h, s_local), -1e30, jnp.float32)
+        kv = (k3in, v3in)
+        for t in range(cp):
+            k3, v3 = kv
+            if causal and t > 0:
+                # invisible shards: skip the kernel entirely (lax.cond on
+                # the traced rank): no wasted FLOPs, and no exp(s - lse)
+                # overflow from scores the global lse never bounded
+                o_t, l_t = jax.lax.cond(
+                    my >= t,
+                    lambda k3=k3, v3=v3: _local_flash(
+                        q3, k3, v3, False, scale, bq, bk),
+                    lambda: (jnp.zeros((b * h, s_local, d), jnp.float32),
+                             jnp.full((b * h, s_local), -1e30,
+                                      jnp.float32)))
+            else:
+                o_t, l_t = _local_flash(q3, k3, v3, causal and t == 0,
+                                        scale, bq, bk)
+            out, lse = _merge(out, lse, o_t, l_t)
+            if t < cp - 1:
+                kv = jax.tree.map(rot, kv)
+        return out.astype(q3.dtype), lse
+
+    def run_fwd(q3, k3in, v3in):
+        out, lse = _ring_fwd(q3, k3in, v3in)
+        return out, (q3, k3in, v3in, out, lse)
+
+    def run_bwd(res, do3):
+        # flash decomposition per shard pair with the GLOBAL lse: p =
+        # exp(s - lse) is the true global softmax for that pair, so each
+        # pair contributes its exact dq/dk/dv.  dk/dv accumulators travel
+        # WITH their K/V shard; after the final step one more rotation
+        # brings every shard (and its grads) home.
+        q3, k3in, v3in, out, lse = res
+        my = jax.lax.axis_index(axis_name)
+        dq = jnp.zeros_like(q3, dtype=jnp.float32)
+        kv_dkv = (k3in, v3in,
+                  jnp.zeros_like(k3in, dtype=jnp.float32),
+                  jnp.zeros_like(v3in, dtype=jnp.float32))
+        zeros3 = lambda: (jnp.zeros_like(q3, dtype=jnp.float32),
+                          jnp.zeros_like(k3in, dtype=jnp.float32),
+                          jnp.zeros_like(v3in, dtype=jnp.float32))
+        for t in range(cp):
+            k3, v3, dk_acc, dv_acc = kv_dkv
+            if causal and t > 0:
+                # skip invisible pairs (see forward): avoids inf partials
+                # from exp(s - lse) on unbounded scores AND the FLOPs
+                dq_t, dk_t, dv_t = jax.lax.cond(
+                    my >= t,
+                    lambda k3=k3, v3=v3: _bwd_impl(
+                        q3, k3, v3, None, out, lse, do3, False, scale,
+                        bq, bk, out_dtype=jnp.float32),
+                    zeros3)
+            else:
+                dq_t, dk_t, dv_t = _bwd_impl(
+                    q3, k3, v3, None, out, lse, do3,
+                    causal and t == 0, scale, bq, bk,
+                    out_dtype=jnp.float32)
+            dq = dq + dq_t
+            kv_dkv = (k3, v3, dk_acc + dk_t, dv_acc + dv_t)
+            kv_dkv = jax.tree.map(rot, kv_dkv)   # cp rotations total
+        _, _, dk, dv = kv_dkv
+        return (dq.astype(q3.dtype), dk.astype(k3in.dtype),
+                dv.astype(v3in.dtype))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(q3, k3in, v3in).reshape(b, h, s_local, d)
