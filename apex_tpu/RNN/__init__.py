@@ -1,0 +1,15 @@
+"""apex.RNN — DEPRECATED in the reference (``apex/RNN``: fused LSTM/GRU
+cells predating cuDNN RNNs; upstream docs mark the module deprecated and
+unmaintained).  Kept as an explicit tombstone so imports fail with
+guidance rather than ImportError (SURVEY.md §2.1 recommends noting the
+deprecation instead of rebuilding)."""
+
+
+def _deprecated(*_a, **_k):
+    raise NotImplementedError(
+        "apex.RNN was deprecated/unmaintained in the reference and is not "
+        "rebuilt; use flax.linen.LSTMCell/GRUCell (XLA fuses the cell "
+        "math) or jax.experimental recurrent primitives.")
+
+
+LSTM = GRU = ReLU = Tanh = mLSTM = _deprecated

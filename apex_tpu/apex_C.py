@@ -1,0 +1,64 @@
+"""apex_C — tensor-level flat-buffer pack/unpack (reference:
+``csrc/flatten_unflatten.cpp``: ``apex_C.flatten(tensors) -> flat``,
+``apex_C.unflatten(flat, tensors) -> list`` wrapping torch's
+``_flatten_dense_tensors``/``_unflatten_dense_tensors`` for DDP buckets).
+
+Dispatch order:
+1. torch tensors -> the compiled ``apex_tpu._apex_C`` C extension
+   (byte-level memcpy pack over the buffer protocol; built with
+   ``APEX_TPU_CPP_EXT=1``), falling back to ``torch._utils``;
+2. jax arrays -> ``jax.flatten_util.ravel_pytree`` (device-side concat —
+   packing happens on-chip, there is no host memcpy to replace).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["flatten", "unflatten", "HAVE_CPP_EXT"]
+
+try:
+    from apex_tpu import _apex_C
+    HAVE_CPP_EXT = True
+except ImportError:  # pragma: no cover - built only with APEX_TPU_CPP_EXT=1
+    _apex_C = None
+    HAVE_CPP_EXT = False
+
+
+def _is_torch(x) -> bool:
+    m = type(x).__module__
+    return m == "torch" or m.startswith("torch.")
+
+
+def flatten(tensors: Sequence):
+    """Concatenate same-dtype tensors into one flat 1-D tensor."""
+    first = tensors[0]
+    if _is_torch(first):
+        import torch
+        # the C ext path needs the buffer protocol; torch bf16 (the amp
+        # half dtype here) has no numpy view, so it falls through
+        numpy_ok = first.dtype not in (torch.bfloat16,)
+        if HAVE_CPP_EXT and first.device.type == "cpu" and numpy_ok:
+            total = sum(t.numel() for t in tensors)
+            out = torch.empty(total, dtype=first.dtype)
+            _apex_C.flatten_into(
+                [t.detach().contiguous().view(-1).numpy() for t in tensors],
+                out.numpy())
+            return out
+        from torch._utils import _flatten_dense_tensors
+        return _flatten_dense_tensors(tuple(tensors))
+    import jax.numpy as jnp
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat, tensors: Sequence) -> List:
+    """Split ``flat`` back into views/arrays shaped like ``tensors``."""
+    if _is_torch(flat):
+        from torch._utils import _unflatten_dense_tensors
+        return list(_unflatten_dense_tensors(flat, tuple(tensors)))
+    outs = []
+    off = 0
+    for t in tensors:
+        n = t.size
+        outs.append(flat[off:off + n].reshape(t.shape))
+        off += n
+    return outs

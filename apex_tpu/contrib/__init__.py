@@ -11,4 +11,14 @@ Tier-1 islands (full behavior):
   both here)
 * :mod:`optimizers` — ``DistributedFusedAdam``/``DistributedFusedLAMB``
   (ZeRO-style reduce-scatter/shard-update/all-gather over the data axis)
+
+Tier-2 islands:
+
+* :mod:`group_norm` — NHWC GroupNorm (+fused silu)
+* :mod:`groupbn` — ``BatchNorm2d_NHWC`` (+fused add/relu, mesh group stats)
+* :mod:`focal_loss`, :mod:`index_mul_2d` — small fusions (XLA-native)
+* :mod:`sparsity` — ASP 2:4 structured sparsity masks
+* :mod:`transducer` — RNN-T joint + scan-based forward-backward loss
+* :mod:`bottleneck` — ResNet bottleneck + spatial parallelism via
+  ppermute halo exchange
 """

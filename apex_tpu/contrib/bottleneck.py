@@ -1,0 +1,160 @@
+"""ResNet bottleneck block + spatial parallelism with halo exchange.
+
+Reference: ``apex/contrib/bottleneck/bottleneck.py`` (``Bottleneck``,
+``SpatialBottleneck`` over the ``fast_bottleneck`` cuDNN fusion ext) and
+``halo_exchangers.py`` (``HaloExchangerPeer``/``HaloExchangerNCCL`` pushing
+1-row halos through CUDA IPC peer memory / raw NCCL p2p).
+
+TPU-native: conv+bn+relu fusion is XLA's job (NHWC convs on the MXU); the
+peer-memory/NCCL halo machinery collapses to ``jax.lax.ppermute`` on a mesh
+axis — ICI *is* peer memory on TPU.  The spatial variant shards H across
+the axis, exchanges 1-row halos with neighbors, and runs the 3x3 conv
+VALID over the haloed slab so results equal the unsharded conv.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]
+
+
+def halo_exchange(x, axis_name: Optional[str], halo: int = 1):
+    """Exchange ``halo`` edge rows (dim 1 = H) with ring neighbors.
+
+    Returns x padded to ``H + 2*halo`` with the neighbors' rows (zeros at
+    the global top/bottom edge).  Reference: ``HaloExchangerPeer.
+    left_right_halo_exchange`` — here a pair of ppermutes over ICI.
+    ``axis_name=None`` (or an unbound axis, e.g. during ``init``) degrades
+    to plain zero halos — the unsharded SAME-padding behavior.
+    """
+    if axis_name is not None:
+        try:
+            n = jax.lax.axis_size(axis_name)
+        except NameError:       # unbound (e.g. during init outside a mesh)
+            axis_name = None
+    if axis_name is None:
+        z = jnp.zeros_like(x[:, :halo])
+        return jnp.concatenate([z, x, z], axis=1)
+    idx = jax.lax.axis_index(axis_name)
+    top = x[:, :halo]          # my first rows -> previous rank's bottom halo
+    bot = x[:, -halo:]         # my last rows  -> next rank's top halo
+    up = [(i, (i - 1) % n) for i in range(n)]     # send to rank-1
+    down = [(i, (i + 1) % n) for i in range(n)]   # send to rank+1
+    from_next = jax.lax.ppermute(top, axis_name, up)    # next's top rows
+    from_prev = jax.lax.ppermute(bot, axis_name, down)  # prev's bottom rows
+    # zero the wrap-around at the global edges
+    from_prev = jnp.where(idx == 0, jnp.zeros_like(from_prev), from_prev)
+    from_next = jnp.where(idx == n - 1, jnp.zeros_like(from_next),
+                          from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=1)
+
+
+class _ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    params_dtype: Any = jnp.float32
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    param_dtype=self.params_dtype, name="conv")(x)
+        return nn.BatchNorm(use_running_average=self.use_running_average,
+                            param_dtype=self.params_dtype, name="bn")(x)
+
+
+class Bottleneck(nn.Module):
+    """NHWC bottleneck: 1x1 -> 3x3 -> 1x1 convs with BN+ReLU and residual
+    (reference: ``Bottleneck(in_channels, bottleneck_channels,
+    out_channels, stride)``)."""
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    params_dtype: Any = jnp.float32
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        s = (self.stride, self.stride)
+        idt = x
+        if self.stride != 1 or self.in_channels != self.out_channels:
+            idt = _ConvBN(self.out_channels, (1, 1), s,
+                          params_dtype=self.params_dtype,
+                          use_running_average=self.use_running_average,
+                          name="downsample")(x)
+        h = jax.nn.relu(_ConvBN(self.bottleneck_channels, (1, 1),
+                                params_dtype=self.params_dtype,
+                                use_running_average=self.use_running_average,
+                                name="conv1")(x))
+        h = jax.nn.relu(_ConvBN(self.bottleneck_channels, (3, 3), s,
+                                params_dtype=self.params_dtype,
+                                use_running_average=self.use_running_average,
+                                name="conv2")(h))
+        h = _ConvBN(self.out_channels, (1, 1),
+                    params_dtype=self.params_dtype,
+                    use_running_average=self.use_running_average,
+                    name="conv3")(h)
+        return jax.nn.relu(h + idt)
+
+
+class SpatialBottleneck(nn.Module):
+    """Bottleneck with H sharded over ``axis_name``: the 3x3 conv sees
+    1-row halos from neighbors (reference: ``SpatialBottleneck`` +
+    ``HaloExchanger*``; stride-1 spatial groups).
+
+    Output equals the unsharded Bottleneck on the gathered input; in
+    training mode this relies on BatchNorm stats being psum'd over the
+    spatial axis (``sync_bn=True``, the default — the reference's
+    ``SpatialBottleneck`` likewise group-syncs its BNs)."""
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    axis_name: str = "data"
+    params_dtype: Any = jnp.float32
+    use_running_average: bool = False
+    sync_bn: bool = True      # psum BN stats over axis_name in training
+
+    def _bn_axis(self):
+        if not self.sync_bn or self.axis_name is None:
+            return None
+        try:
+            jax.lax.axis_size(self.axis_name)
+        except NameError:
+            return None
+        return self.axis_name
+
+    @nn.compact
+    def __call__(self, x):
+        bn_axis = None if self.use_running_average else self._bn_axis()
+
+        def conv_bn(feat, kern, name, padding="SAME"):
+            def f(h):
+                h = nn.Conv(feat, kern, padding=padding, use_bias=False,
+                            param_dtype=self.params_dtype,
+                            name=f"{name}_conv")(h)
+                return nn.BatchNorm(
+                    use_running_average=self.use_running_average,
+                    axis_name=bn_axis, param_dtype=self.params_dtype,
+                    name=f"{name}_bn")(h)
+            return f
+
+        idt = x
+        if self.in_channels != self.out_channels:
+            idt = conv_bn(self.out_channels, (1, 1), "downsample")(x)
+        h = jax.nn.relu(conv_bn(self.bottleneck_channels, (1, 1),
+                                "conv1")(x))
+        # halo exchange, then VALID 3x3 over the haloed slab: rows at the
+        # global edge see zeros, exactly like SAME padding unsharded
+        h = halo_exchange(h, self.axis_name, halo=1)
+        h = jax.nn.relu(conv_bn(self.bottleneck_channels, (3, 3), "conv2",
+                                padding=((0, 0), (1, 1)))(h))
+        h = conv_bn(self.out_channels, (1, 1), "conv3")(h)
+        return jax.nn.relu(h + idt)

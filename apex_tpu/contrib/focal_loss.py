@@ -1,0 +1,56 @@
+"""Focal loss (reference: ``apex/contrib/focal_loss/focal_loss.py`` over
+``focal_loss_cuda`` — fused sigmoid focal loss for dense detection heads,
+label smoothing included).
+
+One fused XLA expression; autodiff supplies the backward the CUDA ext
+hand-writes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["focal_loss", "FocalLoss"]
+
+
+def focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+               num_real_classes: int, alpha: float = 0.25,
+               gamma: float = 2.0, label_smoothing: float = 0.0):
+    """Sigmoid focal loss, detection convention (reference signature).
+
+    ``cls_output``: [..., num_anchors, num_classes_padded] logits.
+    ``cls_targets_at_level``: [..., num_anchors] int class ids, -1 =
+    background, -2 = ignore.
+    Returns the scalar loss normalized by ``num_positives_sum``.
+    """
+    t = cls_targets_at_level
+    c = cls_output.shape[-1]
+    onehot = jax.nn.one_hot(jnp.clip(t, 0, None), c,
+                            dtype=cls_output.dtype)
+    onehot = jnp.where((t >= 0)[..., None], onehot, 0.0)
+    if label_smoothing:
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / 2.0
+    x = cls_output.astype(jnp.float32)
+    y = onehot.astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    # standard numerically-stable BCE-with-logits
+    bce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * y + (1.0 - p) * (1.0 - y)
+    a_t = alpha * y + (1.0 - alpha) * (1.0 - y)
+    loss = a_t * jnp.power(1.0 - p_t, gamma) * bce
+    # ignore entries (-2) and classes beyond num_real_classes contribute 0
+    loss = jnp.where((t != -2)[..., None], loss, 0.0)
+    if num_real_classes < c:
+        loss = loss.at[..., num_real_classes:].set(0.0)
+    return jnp.sum(loss) / num_positives_sum
+
+
+class FocalLoss:
+    """Autograd-Function-shaped shim (reference exposes ``.apply``)."""
+
+    @staticmethod
+    def apply(cls_output, cls_targets_at_level, num_positives_sum,
+              num_real_classes, alpha, gamma, label_smoothing=0.0):
+        return focal_loss(cls_output, cls_targets_at_level,
+                          num_positives_sum, num_real_classes, alpha,
+                          gamma, label_smoothing)

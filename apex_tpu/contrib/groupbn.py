@@ -1,0 +1,63 @@
+"""NHWC BatchNorm with fused add+ReLU (reference: ``apex/contrib/groupbn``
+— ``BatchNorm2d_NHWC(planes, fuse_relu, bn_group)`` over the ``bnp`` ext:
+NHWC BN with cross-GPU group stats via CUDA IPC).
+
+TPU-native: NHWC is the default layout; group stats map to
+``SyncBatchNorm``'s psum over the data axis (``bn_group`` ≡ syncing across
+the mesh instead of an IPC clique); the add+relu epilogue is fused by XLA.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """NHWC BN (+optional residual add and fused ReLU).
+
+    ``bn_group > 1`` syncs stats over ``axis_name`` (the reference's
+    multi-GPU BN group); 1 keeps stats local.
+    """
+    planes: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = "data"
+    eps: float = 1e-5
+    momentum: float = 0.1
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: bool = False):
+        groups = None
+        axis = self.axis_name if self.bn_group > 1 else None
+        if axis is not None:
+            # reference semantics: stats sync within cliques of bn_group
+            # consecutive ranks, not the whole axis
+            try:
+                n = jax.lax.axis_size(axis)
+            except NameError:
+                n = None
+            if n is not None and self.bn_group < n:
+                if n % self.bn_group:
+                    raise ValueError(
+                        f"bn_group ({self.bn_group}) must divide the "
+                        f"'{axis}' axis size ({n})")
+                groups = [list(range(i, i + self.bn_group))
+                          for i in range(0, n, self.bn_group)]
+        bn = SyncBatchNorm(
+            num_features=self.planes, eps=self.eps, momentum=self.momentum,
+            axis_name=axis, axis_index_groups=groups,
+            channel_last=True, name="bn")
+        y = bn(x, use_running_average=use_running_average)
+        if z is not None:                     # fused residual add (bn_add_relu)
+            y = y + z
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y

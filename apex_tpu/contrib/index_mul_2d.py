@@ -1,0 +1,18 @@
+"""index_mul_2d (reference: ``apex/contrib/index_mul_2d`` over
+``fused_index_mul_2d`` — fused ``out = in1[idx] * in2`` used by OpenFold;
+the CUDA ext fuses the gather with the multiply and hand-writes the
+scatter-add backward).
+
+XLA fuses gather+multiply natively and autodiff emits the scatter-add, so
+the functional form is the whole implementation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+def index_mul_2d(in1, in2, idx1):
+    """``out[i, :] = in1[idx1[i], :] * in2[i, :]`` (2-D rows)."""
+    return jnp.take(in1, idx1, axis=0) * in2

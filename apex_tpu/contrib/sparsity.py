@@ -1,0 +1,104 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference: ``apex/contrib/sparsity/asp.py :: ASP`` + ``sparse_masklib`` —
+computes 2:4 magnitude masks for weights (and optimizer state), patches the
+optimizer so masks are re-applied after every step, with CUDA permutation-
+search kernels for better mask quality.
+
+Functional TPU rebuild: masks are a pytree of 0/1 arrays; the core mask
+rule (``m4n2_1d``: per group of 4 along the input dim keep the 2 largest
+|w|) is a vectorized jnp expression.  Permutation search is channel
+reordering ahead of masking — an offline quality refinement, deliberately
+out of scope (documented, like the reference's non-default strategies).
+
+``ASP`` keeps the reference's classmethod surface where it maps: compute
+masks, apply masks, and a functional "masked step" hook in place of
+optimizer monkey-patching.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mask_2to4_1d", "compute_sparse_masks", "apply_masks", "ASP"]
+
+
+def mask_2to4_1d(w):
+    """2:4 mask along the LAST dim (reference: ``mn_1d_best`` with m=4,
+    n=2): in every contiguous group of 4, keep the 2 largest magnitudes.
+
+    Last dim must be divisible by 4 (the reference rejects such layers
+    too; caller filters).
+    """
+    *lead, n = w.shape
+    g = w.reshape(*lead, n // 4, 4)
+    mag = jnp.abs(g)
+    # rank within each group of 4; keep top-2
+    order = jnp.argsort(mag, axis=-1)          # ascending
+    rank = jnp.argsort(order, axis=-1)
+    mask = (rank >= 2).astype(w.dtype)
+    return mask.reshape(*lead, n)
+
+
+def _maskable(path: tuple, leaf) -> bool:
+    """Weights with >= 2 dims and last dim % 4 == 0 (reference:
+    ``eligible_modules`` — Linear/Conv weights, not biases/norms)."""
+    name = "/".join(str(p) for p in path).lower()
+    if "bias" in name or "norm" in name or "embed" in name:
+        return False
+    return leaf.ndim >= 2 and leaf.shape[-1] % 4 == 0
+
+
+def compute_sparse_masks(params, allowed_fn: Optional[Callable] = None):
+    """Mask pytree: 2:4 masks for eligible leaves, ones elsewhere
+    (reference: ``ASP.compute_sparse_masks``)."""
+    allowed = allowed_fn or _maskable
+
+    def per_leaf(path, leaf):
+        if allowed(path, leaf):
+            return mask_2to4_1d(leaf)
+        return jnp.ones_like(leaf)
+
+    return jax.tree_util.tree_map_with_path(per_leaf, params)
+
+
+def apply_masks(params, masks):
+    """Prune: elementwise multiply (reference: in-place ``mul_(mask)``)."""
+    return jax.tree.map(lambda p, m: p * m, params, masks)
+
+
+class ASP:
+    """Classmethod surface parity with ``apex.contrib.sparsity.ASP``.
+
+    Functional usage::
+
+        masks = ASP.compute_sparse_masks(params)
+        params = ASP.prune_trained_model(params, masks)
+        # in the train loop, after every optimizer step:
+        params = ASP.apply_masks(params, masks)
+    """
+
+    _masks = None
+
+    @classmethod
+    def compute_sparse_masks(cls, params, allowed_fn=None):
+        cls._masks = compute_sparse_masks(params, allowed_fn)
+        return cls._masks
+
+    @classmethod
+    def apply_masks(cls, params, masks=None):
+        return apply_masks(params, masks if masks is not None else cls._masks)
+
+    @classmethod
+    def prune_trained_model(cls, params, masks=None):
+        """Reference: ``ASP.prune_trained_model(model, optimizer)`` —
+        compute + apply in one call for post-training pruning."""
+        if masks is None:
+            masks = cls.compute_sparse_masks(params)
+        return apply_masks(params, masks)
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        return cls._masks is not None

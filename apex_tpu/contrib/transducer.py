@@ -1,0 +1,127 @@
+"""RNN-T transducer joint + loss (reference: ``apex/contrib/transducer``
+over ``transducer_joint_cuda``/``transducer_loss_cuda``).
+
+* ``TransducerJoint``: f[B,T,H] + g[B,U,H] broadcast-add (the CUDA ext's
+  fused add+optional relu/dropout+packing); one XLA fusion here.
+* ``TransducerLoss``: the RNN-T forward-backward loss.  The CUDA ext
+  hand-writes alpha/beta kernels and the analytic gradient; here the alpha
+  recursion is a ``lax.scan`` over time (log-space) and autodiff of the
+  scan IS the beta pass (reverse-mode replays the recursion backward), so
+  the gradient is exact without hand-written kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_joint",
+           "transducer_loss"]
+
+
+def transducer_joint(f, g, f_len=None, g_len=None, *, relu=False,
+                     dropout_rate: float = 0.0, key=None):
+    """h[b,t,u,:] = f[b,t,:] + g[b,u,:] (+relu, +dropout)."""
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout_rate > 0.0:
+        if key is None:
+            raise ValueError(
+                "transducer_joint: dropout_rate > 0 requires an explicit "
+                "PRNG key (JAX has no global RNG; silently skipping "
+                "dropout would lose regularization)")
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+    return h
+
+
+def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
+    """RNN-T negative log-likelihood.
+
+    ``log_probs``: [B, T, U+1, V] log-softmax over vocab; ``labels``:
+    [B, U] int targets; ``f_len``: [B] valid frames; ``y_len``: [B] valid
+    label lengths.  Returns per-sample loss [B].
+    """
+    b, t_max, u_max1, v = log_probs.shape
+    u_max = u_max1 - 1
+    # blank/emit transition log-probs
+    blank_lp = log_probs[..., blank_idx]                     # [B,T,U+1]
+    lbl = jnp.broadcast_to(jnp.clip(labels, 0, v - 1)[:, None, :],
+                           (b, t_max, u_max))
+    emit_lp = jnp.take_along_axis(
+        log_probs[:, :, :u_max, :], lbl[..., None], axis=-1)[..., 0]
+    # alpha recursion over t (log-space); u handled vectorized with a
+    # cumulative "emit along u" inner scan expressed as associative ops
+
+    def t_step(alpha_prev, inputs):
+        blank_t, emit_t = inputs                 # [B,U+1], [B,U]
+        # vertical: blank from t-1
+        from_blank = alpha_prev + blank_t        # arrive at (t, u)
+        # chain emissions within this t? RNN-T allows multiple emits per
+        # frame boundary: alpha[t,u] = logaddexp(alpha[t-1,u]+blank,
+        #                                        alpha[t,u-1]+emit)
+        def chain(carry, x):
+            fb, em = x
+            val = jnp.logaddexp(fb, carry + em)
+            return val, val
+        first = from_blank[:, 0]                 # u=0: only blank path
+        _, rest = jax.lax.scan(
+            chain,
+            first,
+            (from_blank[:, 1:].T, emit_t.T))
+        alpha = jnp.concatenate([first[:, None], rest.T], axis=1)
+        return alpha, alpha
+
+    # alpha[0]: t=0 row — emits only
+    def chain0(carry, em):
+        val = carry + em
+        return val, val
+    a00 = jnp.zeros((b,), jnp.float32)
+    _, row0 = jax.lax.scan(chain0, a00, emit_lp[:, 0, :].T)
+    alpha0 = jnp.concatenate([a00[:, None], row0.T], axis=1)  # [B,U+1]
+
+    _, alphas = jax.lax.scan(
+        t_step, alpha0,
+        (blank_lp[:, :-1].transpose(1, 0, 2),
+         emit_lp[:, 1:].transpose(1, 0, 2)))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,U+1]
+
+    # final: alpha[f_len-1, y_len] + blank(f_len-1, y_len)
+    t_idx = jnp.clip(f_len - 1, 0, t_max - 1)
+    u_idx = jnp.clip(y_len, 0, u_max)
+    a_fin = alphas[t_idx, jnp.arange(b), u_idx]
+    lp_blank_fin = blank_lp[jnp.arange(b), t_idx, u_idx]
+    return -(a_fin + lp_blank_fin)
+
+
+class TransducerJoint:
+    """Parity shim (reference: ``TransducerJoint(pack_output=...,
+    relu=..., dropout=...)`` module with ``forward(f, g, f_len, g_len)``)."""
+
+    def __init__(self, pack_output: bool = False, relu: bool = False,
+                 dropout: bool = False, dropout_prob: float = 0.0,
+                 **_parity):
+        if pack_output:
+            raise NotImplementedError(
+                "packed output layout is a CUDA memory-format "
+                "optimization; dense [B,T,U,H] is the TPU-native layout")
+        self.relu = relu
+        self.dropout_prob = dropout_prob if dropout else 0.0
+
+    def __call__(self, f, g, f_len=None, g_len=None, key=None):
+        return transducer_joint(f, g, f_len, g_len, relu=self.relu,
+                                dropout_rate=self.dropout_prob, key=key)
+
+
+class TransducerLoss:
+    """Parity shim (reference: ``TransducerLoss()(x, label, f_len, y_len,
+    blank_idx)``); expects log-probs input like the reference's
+    ``packed_input=False`` path."""
+
+    def __init__(self, fuse_softmax_backward: bool = True, **_parity):
+        self.fuse_softmax_backward = fuse_softmax_backward
+
+    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

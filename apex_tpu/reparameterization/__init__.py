@@ -1,0 +1,25 @@
+"""apex.reparameterization — DEPRECATED in the reference
+(``apex/reparameterization``: weight-norm reparameterization; upstream
+marks it deprecated).  ``weight_norm`` is provided as a thin functional
+equivalent; the hook-based module wrapper is not rebuilt."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["weight_norm", "WeightNorm"]
+
+
+def weight_norm(v, g, dim: int = 0, eps: float = 1e-12):
+    """w = g * v / ||v|| with the norm over all dims except ``dim``
+    (torch ``weight_norm`` semantics the reference wraps)."""
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True) + eps)
+    return g.reshape([-1 if i == dim else 1 for i in range(v.ndim)]) \
+        * v / norm
+
+
+class WeightNorm:
+    def __init__(self, *_a, **_k):
+        raise NotImplementedError(
+            "the hook-based WeightNorm wrapper was deprecated in the "
+            "reference; use the functional weight_norm(v, g, dim) instead")

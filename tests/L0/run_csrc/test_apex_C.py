@@ -1,0 +1,53 @@
+"""apex_C flatten/unflatten parity tests (reference: the DDP bucket
+pack/unpack contract of csrc/flatten_unflatten.cpp)."""
+import numpy as np
+import pytest
+
+from apex_tpu import apex_C
+
+
+def test_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    ts = [torch.randn(3, 4), torch.randn(7), torch.randn(2, 2, 2)]
+    flat = apex_C.flatten(ts)
+    assert flat.shape == (3 * 4 + 7 + 8,)
+    outs = apex_C.unflatten(flat, ts)
+    for o, t in zip(outs, ts):
+        assert o.shape == t.shape
+        np.testing.assert_allclose(o.numpy(), t.numpy())
+
+
+def test_torch_matches_torch_utils():
+    torch = pytest.importorskip("torch")
+    from torch._utils import _flatten_dense_tensors
+    ts = [torch.arange(6, dtype=torch.float32).reshape(2, 3),
+          torch.ones(5)]
+    np.testing.assert_allclose(
+        apex_C.flatten(ts).numpy(),
+        _flatten_dense_tensors(tuple(ts)).numpy())
+
+
+def test_jax_roundtrip():
+    import jax.numpy as jnp
+    ts = [jnp.arange(6.0).reshape(2, 3), jnp.ones((5,))]
+    flat = apex_C.flatten(ts)
+    assert flat.shape == (11,)
+    outs = apex_C.unflatten(flat, ts)
+    for o, t in zip(outs, ts):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(t))
+
+
+@pytest.mark.skipif(not apex_C.HAVE_CPP_EXT,
+                    reason="C extension not built (APEX_TPU_CPP_EXT=1)")
+def test_cpp_ext_raw_buffers():
+    from apex_tpu import _apex_C
+    a = np.arange(5, dtype=np.float32)
+    b = np.arange(3, dtype=np.float32) + 10
+    packed = _apex_C.flatten([a, b])
+    got = np.frombuffer(bytes(packed), dtype=np.float32)
+    np.testing.assert_allclose(got, np.concatenate([a, b]))
+    # flatten_into a preallocated buffer
+    dst = np.zeros(8, dtype=np.float32)
+    n = _apex_C.flatten_into([a, b], dst)
+    assert n == 32
+    np.testing.assert_allclose(dst, np.concatenate([a, b]))

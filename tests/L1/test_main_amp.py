@@ -1,0 +1,39 @@
+"""L1 integration: the imagenet entry point runs at every opt level and the
+loss decreases (reference: ``tests/L1/common/main_amp.py`` + the
+cross-product runner).  BASELINE config 0 is the O0 row.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from examples.imagenet.main_amp import main
+
+
+def _run(opt_level, extra=()):
+    argv = ["--synthetic", "--arch", "resnet18", "-b", "8",
+            "--iters", "6", "--epochs", "4", "--image-size", "32",
+            "--num-classes", "8", "--lr", "0.02", "--print-freq", "100",
+            "--opt-level", opt_level, *extra]
+    return main(argv)
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+def test_loss_decreases(opt_level):
+    extra = ()
+    if opt_level == "O3":
+        extra = ("--keep-batchnorm-fp32", "True")
+    losses = _run(opt_level, extra)
+    first = np.mean(losses[:6])
+    last = np.mean(losses[-6:])
+    assert last < first, (opt_level, first, last)
+    assert np.all(np.isfinite(losses))
+
+
+def test_static_loss_scale_runs():
+    losses = _run("O2", ("--loss-scale", "128.0"))
+    assert np.all(np.isfinite(losses))
