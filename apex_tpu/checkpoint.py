@@ -1,0 +1,61 @@
+"""Checkpoint/resume for train state incl. amp scaler.
+
+Reference contract (SURVEY.md §5): model/optimizer checkpointing is
+``torch.save/load`` + ``amp.state_dict()`` persisting the loss-scaler
+state, with ``tests/L0/run_amp/test_checkpointing.py`` pinning "resume ⇒
+identical continuation".
+
+TPU-native: one orbax-backed (with a numpy fallback) pytree checkpoint
+holding params, optimizer state (the fused optimizers' ``state_dict()``),
+and scaler scale/growth counters.  Everything is a pytree of arrays, so
+one ``save``/``restore`` pair covers the whole train state.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(path: str, state: Any) -> None:
+    """Persist a pytree train state (params / optimizer ``state_dict()`` /
+    amp ``state_dict()`` / step counters).
+
+    Uses orbax when available (sharded-array aware), else a plain
+    numpy-pickle of the host-transferred tree.
+    """
+    path = os.path.abspath(path)
+    try:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, _to_host(state), force=True)
+    except Exception:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(_to_host(state), f)
+
+
+def load_checkpoint(path: str, like: Optional[Any] = None) -> Any:
+    """Restore the pytree saved by :func:`save_checkpoint`.
+
+    ``like`` (optional) provides the target structure/dtypes for orbax
+    restoration; without it the raw stored tree is returned.
+    """
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        if like is not None:
+            return ckptr.restore(path, item=_to_host(like))
+        return ckptr.restore(path)
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,44 @@
+"""Fused conv+bias(+relu/+mask) (reference: ``apex/contrib/conv_bias_relu``
+over cuDNN-frontend fusion descriptors).  XLA fuses conv+bias+relu
+epilogues natively on TPU; these functional forms keep the contrib names.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ConvBiasReLU", "ConvBias", "ConvBiasMaskReLU", "ConvFrozenScaleBiasReLU"]
+
+
+def _conv_nhwc(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class _Fun:
+    def __init__(self, f):
+        self._f = f
+
+    def apply(self, *args):
+        return self._f(*args)
+
+    __call__ = apply
+
+
+ConvBias = _Fun(lambda x, w, b, pad, stride:
+                _conv_nhwc(x, w, stride, [(pad, pad), (pad, pad)])
+                + b.reshape(1, 1, 1, -1))
+
+ConvBiasReLU = _Fun(lambda x, w, b, pad, stride: jax.nn.relu(
+    _conv_nhwc(x, w, stride, [(pad, pad), (pad, pad)])
+    + b.reshape(1, 1, 1, -1)))
+
+ConvBiasMaskReLU = _Fun(lambda x, w, b, mask, pad, stride: jax.nn.relu(
+    (_conv_nhwc(x, w, stride, [(pad, pad), (pad, pad)])
+     + b.reshape(1, 1, 1, -1)) * mask))
+
+ConvFrozenScaleBiasReLU = _Fun(lambda x, w, scale, b, pad, stride:
+                               jax.nn.relu(
+    _conv_nhwc(x, w, stride, [(pad, pad), (pad, pad)])
+    * scale.reshape(1, 1, 1, -1) + b.reshape(1, 1, 1, -1)))

@@ -1,0 +1,36 @@
+"""Group BatchNorm via backend API (reference: ``apex/contrib/cudnn_gbn ::
+GroupBatchNorm2d`` over ``cudnn_gbn_lib`` — the cuDNN-backend flavor of
+``groupbn``'s NHWC group BN).
+
+On TPU both contrib BN islands collapse onto the same mesh-synced BN; this
+class keeps the cudnn_gbn constructor (``group_size``/``group_rank`` naming
+instead of ``bn_group``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+__all__ = ["GroupBatchNorm2d"]
+
+
+class GroupBatchNorm2d(nn.Module):
+    """Parity: ``GroupBatchNorm2d(num_features, group_size, ...)``."""
+    num_features: int
+    group_size: int = 1
+    eps: float = 1e-5
+    momentum: float = 0.1
+    axis_name: Optional[str] = "data"
+    params_dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        import jax.numpy as jnp
+        return BatchNorm2d_NHWC(
+            planes=self.num_features, fuse_relu=False,
+            bn_group=self.group_size, axis_name=self.axis_name,
+            eps=self.eps, momentum=self.momentum,
+            params_dtype=self.params_dtype or jnp.float32,
+            name="bn")(x, use_running_average=use_running_average)

@@ -1,0 +1,62 @@
+"""FMHA — fixed-shape fused attention (reference: ``apex/contrib/fmha/
+fmha.py :: FMHAFun`` over ``fmhalib``: packed-QKV fp16 attention for
+seqlen ≤ 512, head dim 64, varlen via cu_seqlens).
+
+The Pallas flash kernel (``apex_tpu.ops.attention``) subsumes the fixed
+shape table; this shim keeps the reference's packed-QKV varlen calling
+convention: ``qkv [total_tokens, 3, h, d]`` + ``cu_seqlens [b+1]``.
+Varlen is expressed as a padding mask over the repacked dense batch —
+XLA/Pallas prefer static shapes, so the dense layout IS the fast path on
+TPU (the CUDA varlen packing exists to dodge padding waste on ragged
+batches; with a mask the flash kernel skips no work either way).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+
+__all__ = ["FMHAFun", "fmha_packed"]
+
+
+def fmha_packed(qkv, cu_seqlens, max_s: int, *, is_training: bool = True,
+                p_dropout: float = 0.0):
+    """Packed-varlen attention (reference: ``fmhalib.fwd`` signature).
+
+    ``qkv``: [total, 3, h, d]; ``cu_seqlens``: [b+1] token offsets.
+    Returns [total, h, d] context in the packed layout.
+    """
+    total, three, h, d = qkv.shape
+    b = cu_seqlens.shape[0] - 1
+    # unpack to dense [b, max_s] with a validity mask
+    starts = cu_seqlens[:-1]
+    lens = cu_seqlens[1:] - starts
+    pos = jnp.arange(max_s)
+    token_idx = jnp.clip(starts[:, None] + pos[None, :], 0, total - 1)
+    valid = pos[None, :] < lens[:, None]                     # [b, max_s]
+    dense = jnp.take(qkv, token_idx.reshape(-1), axis=0).reshape(
+        b, max_s, 3, h, d)
+    q, k, v = (dense[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    mask = jnp.broadcast_to((~valid)[:, None, None, :],
+                            (b, 1, max_s, max_s))
+    ctx = flash_attention(q, k, v, mask=mask)                # [b,h,s,d]
+    ctx = ctx.transpose(0, 2, 1, 3)                          # [b,s,h,d]
+    # repack: scatter each valid dense token to its packed offset; invalid
+    # positions index `total`, which mode="drop" discards
+    dense_pos = starts[:, None] + pos[None, :]               # [b, max_s]
+    out = jnp.zeros((total, h, d), ctx.dtype).at[
+        jnp.where(valid, dense_pos, total)].set(
+        jnp.where(valid[..., None, None], ctx, 0.0),
+        mode="drop")
+    return out
+
+
+class FMHAFun:
+    """Autograd-Function-shaped shim (reference exposes ``FMHAFun.apply``)."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens, seqlens, p_dropout, max_s, is_training,
+              zero_tensors=False):
+        return fmha_packed(qkv, cu_seqlens, max_s,
+                           is_training=is_training, p_dropout=p_dropout)

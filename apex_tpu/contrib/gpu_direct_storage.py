@@ -1,0 +1,48 @@
+"""GDS file I/O (reference: ``apex/contrib/gpu_direct_storage`` over
+cuFile — direct storage<->GPU DMA for torch tensors).
+
+TPU has no user-visible direct-storage path (transfers stage through host
+RAM under XLA's control), so the equivalent capability is overlap: async
+host-side file I/O feeding ``jax.device_put``.  ``load_data``/``save_data``
+keep the reference's names; the async variants return futures.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+
+import jax
+import numpy as np
+
+__all__ = ["load_data", "save_data", "load_data_async", "save_data_async"]
+
+_POOL = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+
+
+def save_data(t, filename: str, offset: int = 0):
+    """Write a device array's bytes to file (reference:
+    ``gds.save_data(tensor, filename)``)."""
+    arr = np.asarray(t)
+    mode = "r+b" if os.path.exists(filename) else "wb"
+    with open(filename, mode) as f:
+        f.seek(offset)
+        f.write(arr.tobytes())
+
+
+def load_data(t, filename: str, offset: int = 0):
+    """Read bytes into a NEW device array shaped/typed like ``t``
+    (functional: JAX arrays are immutable; the reference fills in place)."""
+    like = np.asarray(t)
+    with open(filename, "rb") as f:
+        f.seek(offset)
+        buf = f.read(like.nbytes)
+    arr = np.frombuffer(buf, dtype=like.dtype).reshape(like.shape)
+    return jax.device_put(arr)
+
+
+def save_data_async(t, filename: str, offset: int = 0):
+    return _POOL.submit(save_data, t, filename, offset)
+
+
+def load_data_async(t, filename: str, offset: int = 0):
+    return _POOL.submit(load_data, t, filename, offset)

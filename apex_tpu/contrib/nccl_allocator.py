@@ -1,0 +1,23 @@
+"""NCCL window allocator (reference: ``apex/contrib/nccl_allocator`` — a
+torch pluggable allocator over ``ncclMemAlloc`` so comm buffers live in
+NVLS-registered windows).
+
+ABSORBED on TPU: the XLA runtime owns all device buffers and collectives
+run over ICI with no user-registered windows, so there is nothing to
+allocate.  ``nccl_mem`` is a no-op context manager and ``init`` a no-op,
+keeping ported call sites working (SURVEY.md §2.3 maps this ext to "n/a —
+document as absorbed")."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["init", "nccl_mem"]
+
+
+def init(*_a, **_k) -> None:
+    return None
+
+
+@contextlib.contextmanager
+def nccl_mem(*_a, **_k):
+    yield

@@ -1,0 +1,28 @@
+"""Raw p2p send/recv (reference: ``apex/contrib/csrc/nccl_p2p`` — grouped
+``ncclSend``/``ncclRecv`` used by halo exchange and pipeline stages).
+
+TPU-native equivalent: ``jax.lax.ppermute`` over a mesh axis (a
+collective-permute rides ICI).  These wrappers keep the left/right halo
+call shapes."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["left_right_halo_exchange", "ppermute_send"]
+
+
+def ppermute_send(x, axis_name: str, perm):
+    """Direct parity for grouped send/recv: one collective-permute."""
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def left_right_halo_exchange(top_halo, btm_halo, axis_name: str):
+    """Send my top row up and bottom row down; receive neighbors'
+    (reference: ``nccl_p2p_cuda.left_right_halo_exchange``).  Wrap-around
+    entries are the callers' concern (the reference zeroes them too)."""
+    n = jax.lax.axis_size(axis_name)
+    up = [(i, (i - 1) % n) for i in range(n)]
+    down = [(i, (i + 1) % n) for i in range(n)]
+    from_next = jax.lax.ppermute(top_halo, axis_name, up)
+    from_prev = jax.lax.ppermute(btm_halo, axis_name, down)
+    return from_prev, from_next

@@ -1,0 +1,13 @@
+"""OpenFold Triton kernels (reference: ``apex/contrib/openfold_triton`` —
+Triton implementations of OpenFold's MHA/layernorm, CUDA-only).
+
+Not rebuilt as a distinct island: Triton does not target TPU, and every
+kernel in it is covered by this package's Pallas equivalents —
+``apex_tpu.ops.attention`` (MHA) and ``apex_tpu.ops.layer_norm`` — which
+is where OpenFold-on-TPU should route."""
+
+
+def __getattr__(name):
+    raise NotImplementedError(
+        f"openfold_triton.{name}: Triton is CUDA-only; use "
+        "apex_tpu.ops.attention / apex_tpu.ops.layer_norm (Pallas) instead")

@@ -43,8 +43,11 @@ class _DistributedOptimizerBase:
 
     def _shard_grads(self, grads):
         """ravel + reduce-scatter: returns (grad shard [n_pad/dp], n,
-        unravel)."""
+        unravel).  ``unravel`` expects the ravel dtype (bf16 for
+        homogeneous-bf16 trees) — ``_gather_params`` casts the fp32 master
+        back before unraveling so params keep their construction dtypes."""
         gflat, unravel = tree_ravel(grads)
+        self._flat_dtype = gflat.dtype
         n = gflat.shape[0]
         pad = self._padded(n) - n
         if pad:
@@ -58,10 +61,10 @@ class _DistributedOptimizerBase:
 
     def _gather_params(self, pshard, n, unravel):
         if self.dp == 1:
-            return unravel(pshard[:n])
+            return unravel(pshard[:n].astype(self._flat_dtype))
         pfull = jax.lax.all_gather(
             pshard, self.axis_name, axis=0, tiled=True)[:n]
-        return unravel(pfull)
+        return unravel(pfull.astype(self._flat_dtype))
 
     def init_state(self, params) -> dict:
         """Build the sharded state for my rank (call inside shard_map)."""

@@ -1,0 +1,51 @@
+"""Peer-memory halo exchange (reference: ``apex/contrib/peer_memory ::
+PeerMemoryPool`` + ``PeerHaloExchanger1d`` over ``peer_memory_cuda`` —
+CUDA IPC VMM pools for direct cross-GPU halo pushes).
+
+On TPU, ICI *is* peer memory: a ``ppermute`` neighbor exchange moves data
+chip-to-chip without host involvement, and XLA owns the buffers (there is
+nothing to pool).  ``PeerMemoryPool`` is therefore a no-op allocator kept
+for API shape; the halo exchange maps to
+``apex_tpu.contrib.bottleneck.halo_exchange``.
+"""
+from __future__ import annotations
+
+from apex_tpu.contrib.bottleneck import halo_exchange
+
+__all__ = ["PeerMemoryPool", "PeerHaloExchanger1d", "halo_exchange"]
+
+
+class PeerMemoryPool:
+    """No-op pool (reference: raw/static VMM allocations per peer group).
+    XLA's runtime owns device buffers; allocation knobs are accepted and
+    ignored."""
+
+    def __init__(self, static_size: int = 0, dynamic_size: int = 0,
+                 peer_ranks=None):
+        self.static_size = static_size
+        self.dynamic_size = dynamic_size
+        self.peer_ranks = peer_ranks
+
+    def allocate_peer_tensors(self, shape, dtype, channels_last,
+                              dynamic):  # pragma: no cover - parity stub
+        raise NotImplementedError(
+            "explicit peer tensors have no TPU analog; use "
+            "halo_exchange()/ppermute — buffers are XLA-managed")
+
+
+class PeerHaloExchanger1d:
+    """Parity: ``PeerHaloExchanger1d(ranks, rank_in_group, pool,
+    half_halo)``; call performs the neighbor exchange over the mesh axis."""
+
+    def __init__(self, ranks=None, rank_in_group=None, peer_pool=None,
+                 half_halo: int = 1, axis_name: str = "data"):
+        self.half_halo = half_halo
+        self.axis_name = axis_name
+
+    def __call__(self, x, H_split: bool = True):
+        if not H_split:
+            x = x.swapaxes(1, 2)
+        out = halo_exchange(x, self.axis_name, halo=self.half_halo)
+        if not H_split:
+            out = out.swapaxes(1, 2)
+        return out

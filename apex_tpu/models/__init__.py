@@ -1,0 +1,27 @@
+"""apex_tpu.models — the flagship model zoo.
+
+Re-exports the standalone Megatron-style models built on the transformer
+toolkit (reference: ``apex/transformer/testing/standalone_{gpt,bert}.py``
+— in the reference these live under testing because Apex is a library;
+here they double as the benchmark/flagship models, so they get a stable
+top-level home too).
+"""
+from apex_tpu.transformer.testing.standalone_bert import (
+    BertConfig,
+    BertModel,
+    bert_model_provider,
+)
+from apex_tpu.transformer.testing.standalone_gpt import (
+    GPTConfig,
+    GPTModel,
+    gpt_model_provider,
+)
+
+__all__ = [
+    "BertConfig",
+    "BertModel",
+    "bert_model_provider",
+    "GPTConfig",
+    "GPTModel",
+    "gpt_model_provider",
+]

@@ -40,6 +40,11 @@ class _Group:
         # a single fp32 leaf can alias the caller's param array.
         self.master = jnp.array(flat, dtype=jnp.float32, copy=True)
         self.unravel = unravel
+        # ravel_pytree's unravel expects the ravel dtype (result_type of the
+        # leaves): fp32 for mixed trees, the low precision itself for
+        # homogeneous bf16 trees — cast the fp32 master back before
+        # unraveling so step() returns params in the construction dtypes
+        self.flat_dtype = flat.dtype
         self.sizes = _leaf_sizes(params)
         self.shapes = tuple(tuple(x.shape)
                             for x in jax.tree_util.tree_leaves(params))
@@ -53,7 +58,7 @@ class _Group:
         self.state: dict[str, jax.Array] = {}
 
     def params(self):
-        return self.unravel(self.master)
+        return self.unravel(self.master.astype(self.flat_dtype))
 
     def ravel_grads(self, grads):
         gflat, _ = tree_ravel(grads)

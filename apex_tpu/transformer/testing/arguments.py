@@ -1,0 +1,78 @@
+"""Megatron-style test-only argument parser (reference:
+``apex/transformer/testing/arguments.py :: parse_args`` — the trimmed
+Megatron-LM arg surface used by the standalone GPT/BERT fixtures and
+global_vars; test-only in the reference and here).
+"""
+from __future__ import annotations
+
+import argparse
+
+__all__ = ["parse_args", "core_transformer_config_from_args"]
+
+
+def parse_args(extra_args_provider=None, defaults=None,
+               ignore_unknown_args=True, args=None):
+    p = argparse.ArgumentParser(description="apex_tpu testing arguments")
+    g = p.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=2)
+    g.add_argument("--hidden-size", type=int, default=64)
+    g.add_argument("--num-attention-heads", type=int, default=4)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--seq-length", type=int, default=64)
+    g.add_argument("--max-position-embeddings", type=int, default=64)
+    g.add_argument("--padded-vocab-size", "--vocab-size", type=int,
+                   dest="padded_vocab_size", default=128)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+
+    g = p.add_argument_group("training")
+    g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--global-batch-size", type=int, default=8)
+    g.add_argument("--rampup-batch-size", nargs="*", default=None)
+    g.add_argument("--seed", type=int, default=1234)
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+
+    g = p.add_argument_group("parallel")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--use-cpu-initialization", action="store_true")
+
+    if extra_args_provider is not None:
+        p = extra_args_provider(p)
+    ns, _unknown = (p.parse_known_args(args) if ignore_unknown_args
+                    else (p.parse_args(args), None))
+    if defaults:
+        for k, v in defaults.items():
+            if getattr(ns, k, None) is None:
+                setattr(ns, k, v)
+    if ns.ffn_hidden_size is None:
+        ns.ffn_hidden_size = 4 * ns.hidden_size
+    ns.world_size = (ns.tensor_model_parallel_size
+                     * ns.pipeline_model_parallel_size
+                     * ns.context_parallel_size)
+    return ns
+
+
+def core_transformer_config_from_args(args):
+    """Build a GPTConfig from parsed args (reference builds Megatron's
+    TransformerConfig)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer.testing.standalone_gpt import GPTConfig
+    dtype = jnp.bfloat16 if (args.bf16 or args.fp16) else jnp.float32
+    return GPTConfig(
+        vocab_size=args.padded_vocab_size,
+        hidden_size=args.hidden_size,
+        ffn_hidden_size=args.ffn_hidden_size,
+        num_layers=args.num_layers,
+        num_attention_heads=args.num_attention_heads,
+        max_seq_length=args.max_position_embeddings,
+        hidden_dropout=args.hidden_dropout,
+        attention_dropout=args.attention_dropout,
+        params_dtype=dtype,
+        sequence_parallel=args.sequence_parallel)

@@ -1,0 +1,72 @@
+"""Megatron-style global args/state for tests (reference:
+``apex/transformer/testing/global_vars.py`` — ``get_args``,
+``set_global_variables``, the global microbatch calculator; test-only).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+from apex_tpu.transformer.testing.arguments import parse_args
+
+__all__ = [
+    "get_args",
+    "set_global_variables",
+    "get_current_global_batch_size",
+    "get_num_microbatches",
+    "update_num_microbatches",
+    "destroy_global_vars",
+]
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def _ensure(obj, name):
+    assert obj is not None, f"{name} is not initialized"
+    return obj
+
+
+def get_args():
+    return _ensure(_GLOBAL_ARGS, "args")
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         ignore_unknown_args=True, args=None):
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    assert _GLOBAL_ARGS is None, "args already initialized"
+    _GLOBAL_ARGS = parse_args(extra_args_provider, args_defaults,
+                              ignore_unknown_args, args)
+    a = _GLOBAL_ARGS
+    dp = max(1, a.world_size // (a.tensor_model_parallel_size
+                                 * a.pipeline_model_parallel_size
+                                 * a.context_parallel_size))
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = \
+        build_num_microbatches_calculator(
+            rank=0, rampup_batch_size=a.rampup_batch_size,
+            global_batch_size=a.global_batch_size,
+            micro_batch_size=a.micro_batch_size,
+            data_parallel_size=dp)
+    return _GLOBAL_ARGS
+
+
+def get_current_global_batch_size():
+    return _ensure(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                   "microbatch calculator").get_current_global_batch_size()
+
+
+def get_num_microbatches():
+    return _ensure(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                   "microbatch calculator").get()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    _ensure(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+            "microbatch calculator").update(consumed_samples,
+                                            consistency_check)
+
+
+def destroy_global_vars():
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None

@@ -104,3 +104,14 @@ def test_dist_adam_overflow_skip():
         new_params, params)
     # moments untouched too
     np.testing.assert_allclose(state2["exp_avg"], state["exp_avg"], atol=0)
+
+
+def test_dist_adam_preserves_bf16_dtypes():
+    params = {"w": jnp.ones((37, 13), jnp.bfloat16),
+              "b": jnp.zeros((13,), jnp.bfloat16)}
+    g = jax.tree.map(lambda x: jnp.full_like(x, 0.1), params)
+    opt = DistributedFusedAdam(1, lr=1e-3)
+    state = opt.init_state(params)
+    new_params, _ = opt.step(state, g)
+    assert new_params["w"].dtype == jnp.bfloat16
+    assert new_params["b"].dtype == jnp.bfloat16
