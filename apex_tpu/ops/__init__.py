@@ -21,6 +21,8 @@ from .fused_update import (
     fused_lamb_phase1_flat,
     adam_reference,
 )
+from .attention import flash_attention, mha_reference
+from .xentropy import softmax_cross_entropy_loss, xentropy_reference
 
 __all__ = [
     "layer_norm",
@@ -35,4 +37,8 @@ __all__ = [
     "fused_sgd_flat",
     "fused_lamb_phase1_flat",
     "adam_reference",
+    "flash_attention",
+    "mha_reference",
+    "softmax_cross_entropy_loss",
+    "xentropy_reference",
 ]

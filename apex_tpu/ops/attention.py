@@ -347,7 +347,7 @@ def _fit_block(s: int, preferred: int):
 
 def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 256):
+                    block_q: int = 512, block_k: int = 512):
     """Fused blockwise attention, ``[b, h, s, d]`` layout.
 
     Drop-in fused path for the reference's ``fmhalib`` /

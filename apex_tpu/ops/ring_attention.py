@@ -63,7 +63,7 @@ def _merge(out_a, lse_a, out_b, lse_b):
 def ring_attention(q, k, v, *, causal: bool = False,
                    sm_scale: Optional[float] = None,
                    axis_name: str = CONTEXT_AXIS,
-                   block_q: int = 512, block_k: int = 256):
+                   block_q: int = 512, block_k: int = 512):
     """Exact attention over a context-sharded sequence.
 
     ``q, k, v``: ``[b, h, s_local, d]`` — this rank's sequence shard (rank

@@ -1,0 +1,37 @@
+"""Transport-availability shim (reference: ``apex/transformer/_ucc_util.py``
+— ``HAS_UCC`` detection so tests can pick NCCL vs UCC backends).
+
+On TPU the transports are ICI (intra-slice) and DCN (cross-slice), both
+owned by XLA: there is no user-selectable backend, so ``HAS_UCC`` is False
+and both "backends" resolve to XLA collectives.  Multi-host setup maps to
+``jax.distributed.initialize`` (the NCCL/UCC init analog), wrapped here.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["HAS_UCC", "initialize_distributed_backend"]
+
+HAS_UCC = False
+
+
+def initialize_distributed_backend(
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+        backend: str = "xla") -> None:
+    """Multi-host init (reference: ``torch.distributed.init_process_group``
+    with nccl/ucc).  ``backend`` is accepted for parity; XLA owns
+    transport.  No-op when already initialized or single-process."""
+    if num_processes in (None, 0, 1) and coordinator_address is None:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except RuntimeError as e:          # already initialized
+        if "already" not in str(e).lower():
+            raise

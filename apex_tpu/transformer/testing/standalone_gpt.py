@@ -64,6 +64,7 @@ class GPTConfig:
     attention_dropout: float = 0.1
     params_dtype: Any = jnp.float32
     sequence_parallel: bool = False
+    context_parallel: bool = False             # ring attention over 'context'
     remat: bool = False                        # jax.checkpoint per layer
     scan_layers: bool = False                  # lax.scan over layers
 
@@ -75,6 +76,12 @@ class GPTConfig:
 def _tp() -> int:
     if parallel_state.model_parallel_is_initialized():
         return parallel_state.get_tensor_model_parallel_world_size()
+    return 1
+
+
+def _cp() -> int:
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_context_parallel_world_size()
     return 1
 
 
@@ -128,8 +135,17 @@ class ParallelAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # [s, b, n, d] -> [b, n, s, d]
         q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
-        ctx = flash_attention(q, k, v, causal=self.causal,
-                              mask=attention_mask)
+        if cfg.context_parallel and _cp() > 1:
+            # sequence sharded over the context axis: exact attention via
+            # the K/V ring (apex_tpu.ops.ring_attention); padding masks
+            # are a CP=1 feature for now
+            assert attention_mask is None, \
+                "context_parallel supports causal masking only"
+            from apex_tpu.ops.ring_attention import ring_attention
+            ctx = ring_attention(q, k, v, causal=self.causal)
+        else:
+            ctx = flash_attention(q, k, v, causal=self.causal,
+                                  mask=attention_mask)
         if not deterministic and cfg.attention_dropout > 0.0:
             # reference applies dropout on probs inside the kernel; the
             # flash path applies it on the context (same expectation), the
@@ -198,7 +214,14 @@ class GPTEmbedding(nn.Module):
             "position_embeddings", nn.initializers.normal(stddev=0.02),
             (cfg.max_seq_length, cfg.hidden_size), cfg.params_dtype)
         s = tokens.shape[1]
-        h = emb + pos[None, :s, :]
+        if cfg.context_parallel and _cp() > 1:
+            # tokens are my context shard: positions start at rank * s
+            off = jax.lax.axis_index(
+                parallel_state.CONTEXT_AXIS) * s
+            h = emb + jax.lax.dynamic_slice_in_dim(
+                pos, off, s, axis=0)[None]
+        else:
+            h = emb + pos[None, :s, :]
         h = h.transpose(1, 0, 2)                 # [s, b, h]
         if cfg.sequence_parallel:
             h = mappings.scatter_to_sequence_parallel_region(h)

@@ -175,3 +175,49 @@ def test_scan_layers_matches_loop():
     m_rs = gpt_model_provider(_gpt_cfg(scan_layers=True, remat=True))
     loss2 = jax.jit(lambda p: m_rs.apply(p, tokens, labels))(p)
     np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
+
+
+def test_context_parallel_matches_cp1():
+    """CP=4 ring-attention GPT loss == CP=1 full-sequence loss with the
+    same params (context parallelism is exact)."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+
+    seq = 64   # 16 tokens per CP rank
+    cfg1 = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                     num_layers=LAYERS, num_attention_heads=HEADS,
+                     max_seq_length=seq, hidden_dropout=0.0,
+                     attention_dropout=0.0)
+    cfg_cp = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                       num_layers=LAYERS, num_attention_heads=HEADS,
+                       max_seq_length=seq, hidden_dropout=0.0,
+                       attention_dropout=0.0, context_parallel=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (BATCH, seq),
+                                0, VOCAB)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    # CP=1 oracle
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    m1 = gpt_model_provider(cfg1)
+    params = m1.init(jax.random.PRNGKey(7), tokens, labels)
+    loss1 = float(jax.jit(lambda p: m1.apply(p, tokens, labels))(params))
+    parallel_state.destroy_model_parallel()
+
+    # CP=4: tokens/labels sharded on the seq dim over the context axis
+    parallel_state.initialize_model_parallel(context_parallel_size_=4)
+    mesh = parallel_state.get_mesh()
+    m_cp = gpt_model_provider(cfg_cp)
+
+    def body(tokens, labels):
+        # per-rank mean over the local shard; equal shard sizes -> global
+        # mean is the pmean
+        loss = m_cp.apply(params, tokens, labels)
+        return jax.lax.pmean(loss, "context")
+
+    loss_cp = float(jax.jit(functools.partial(
+        jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P(None, "context"), P(None, "context")),
+        out_specs=P()))(tokens, labels))
+    parallel_state.destroy_model_parallel()
+    np.testing.assert_allclose(loss_cp, loss1, rtol=2e-5, atol=2e-6)
