@@ -13,6 +13,15 @@ from apex_tpu.amp.frontend import (
     opt_levels,
     state_dict,
 )
+from apex_tpu.amp.amp import (
+    float_function,
+    half_function,
+    init,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
 from apex_tpu.amp.handle import scale_loss
 from apex_tpu.amp.scaler import (
     LossScaler,
@@ -28,4 +37,7 @@ __all__ = [
     "master_params", "opt_levels", "state_dict", "scale_loss",
     "LossScaler", "LossScaleState", "init_loss_scale", "scale_loss_value",
     "unscale_grads", "update_scale",
+    "init", "half_function", "float_function", "promote_function",
+    "register_half_function", "register_float_function",
+    "register_promote_function",
 ]

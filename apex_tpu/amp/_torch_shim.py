@@ -56,6 +56,11 @@ class _TorchScaler:
         self.found_inf = found
 
     def update(self):
+        # one iteration boundary: drop the O1 weight-cast cache (reference:
+        # handle._clear_cache() on every scaler update)
+        from apex_tpu.amp import amp as _amp_mod
+        if _amp_mod.current_handle() is not None:
+            _amp_mod.current_handle()._clear_cache()
         if not self.dynamic:
             self.found_inf = False
             return
@@ -200,7 +205,14 @@ def initialize_torch(model, optimizer, props, num_losses=1,
                           max_scale=max_loss_scale)
 
     if opt_level == "O1":
-        _wrap_forward_autocast(model, torch.bfloat16)
+        # O1 = patch the torch/Tensor/functional namespaces with the cast
+        # lists (reference: amp.init + lists/*); patch_torch_functions=False
+        # degrades to the autocast wrap.
+        if getattr(props, "patch_torch_functions", True):
+            from apex_tpu.amp import amp as amp_mod
+            amp_mod.init()
+        else:
+            _wrap_forward_autocast(model, torch.bfloat16)
     elif opt_level in ("O2", "O3"):
         keep_bn = bool(props.keep_batchnorm_fp32) and opt_level == "O2"
         _cast_module(model, torch.bfloat16, keep_bn)

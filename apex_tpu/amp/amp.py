@@ -1,0 +1,183 @@
+"""O1 patch registry (reference: ``apex/amp/amp.py``).
+
+``init()`` monkeypatches the functions named in ``apex_tpu.amp.lists``
+(torch, torch.Tensor, torch.nn.functional) with cast wrappers and returns
+an :class:`AmpHandle` owning the per-iteration weight-cast cache.
+``half_function`` / ``float_function`` / ``promote_function`` are the user
+decorators (work on torch AND jax functions — the cast helpers dispatch on
+array type); ``register_*_function(module, name)`` queues extra patches
+applied at the next ``init()`` (the reference's pre-``initialize``
+registration API).
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from apex_tpu.amp.wrap import (
+    make_cast_wrapper,
+    make_promote_wrapper,
+    make_sequence_promote_wrapper,
+)
+
+__all__ = [
+    "init", "AmpHandle",
+    "half_function", "float_function", "promote_function",
+    "register_half_function", "register_float_function",
+    "register_promote_function",
+]
+
+# queued (module, fn_name, category) from register_* calls
+_USER_REGISTRY: list = []
+
+_current_handle: Optional["AmpHandle"] = None
+
+
+def current_handle():
+    return _current_handle
+
+
+def _is_active() -> bool:
+    return _current_handle is not None and _current_handle.is_active
+
+
+def _get_cache():
+    return _current_handle.cache if _current_handle is not None else None
+
+
+class AmpHandle:
+    """Owns the patch set + the per-iteration cast cache (reference:
+    ``apex/amp/handle.py :: AmpHandle``)."""
+
+    def __init__(self, verbose: bool = False):
+        self.is_active = True
+        self.cache: dict = {}
+        self._patches: list = []          # (obj, name, original)
+        self.verbose = verbose
+
+    # reference: handle._clear_cache(), called when the scaler updates
+    def _clear_cache(self) -> None:
+        self.cache.clear()
+
+    def _patch(self, obj, name: str, wrapper) -> None:
+        self._patches.append((obj, name, getattr(obj, name)))
+        setattr(obj, name, wrapper)
+
+    def _deactivate(self) -> None:
+        """Restore every patched function (reference: ``handle._deactivate``)."""
+        global _current_handle
+        for obj, name, orig in reversed(self._patches):
+            setattr(obj, name, orig)
+        self._patches.clear()
+        self.is_active = False
+        if _current_handle is self:
+            _current_handle = None
+
+    def wrap_optimizer(self, optimizer, num_loss: int = 1):
+        # parity shim: the torch shim patches optimizers directly
+        return optimizer
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._deactivate()
+
+
+def _apply_lists(handle: AmpHandle, obj, lists_mod) -> None:
+    for name in getattr(lists_mod, "FP16_FUNCS", []):
+        if hasattr(obj, name):
+            handle._patch(obj, name, make_cast_wrapper(
+                getattr(obj, name), True, _get_cache, _is_active))
+    for name in getattr(lists_mod, "FP32_FUNCS", []):
+        if hasattr(obj, name):
+            handle._patch(obj, name, make_cast_wrapper(
+                getattr(obj, name), False, _get_cache, _is_active))
+    for name in getattr(lists_mod, "CASTS", []):
+        if hasattr(obj, name):
+            handle._patch(obj, name, make_promote_wrapper(
+                getattr(obj, name), _is_active))
+    for name in getattr(lists_mod, "SEQUENCE_CASTS", []):
+        if hasattr(obj, name):
+            handle._patch(obj, name, make_sequence_promote_wrapper(
+                getattr(obj, name), _is_active))
+
+
+def init(enabled: bool = True, verbose: bool = False) -> AmpHandle:
+    """Apply the O1 patch lists; returns the handle (reference:
+    ``amp.init``).  Re-entrant: a live handle is deactivated first."""
+    global _current_handle
+    if _current_handle is not None:
+        _current_handle._deactivate()
+    handle = AmpHandle(verbose=verbose)
+    if not enabled:
+        handle.is_active = False
+        return handle
+
+    try:
+        import torch
+        import torch.nn.functional as F
+
+        from apex_tpu.amp.lists import (
+            functional_overrides,
+            tensor_overrides,
+            torch_overrides,
+        )
+
+        _apply_lists(handle, torch, torch_overrides)
+        _apply_lists(handle, torch.Tensor, tensor_overrides)
+        _apply_lists(handle, F, functional_overrides)
+
+        for module, name, category in _USER_REGISTRY:
+            if isinstance(module, str):
+                module = importlib.import_module(module)
+            if not hasattr(module, name):
+                continue
+            orig = getattr(module, name)
+            if category == "half":
+                handle._patch(module, name, make_cast_wrapper(
+                    orig, True, _get_cache, _is_active))
+            elif category == "float":
+                handle._patch(module, name, make_cast_wrapper(
+                    orig, False, _get_cache, _is_active))
+            else:
+                handle._patch(module, name, make_promote_wrapper(
+                    orig, _is_active))
+    except Exception:
+        # failed half-way: restore everything, don't leak a live handle
+        handle._deactivate()
+        raise
+    # publish only once fully patched
+    _current_handle = handle
+    return handle
+
+
+# ---- user decorators (usable on torch or jax functions) -------------------
+
+def half_function(fn):
+    """Run ``fn`` with all floating args cast to bf16 while amp is active."""
+    return make_cast_wrapper(fn, True, _get_cache, _is_active)
+
+
+def float_function(fn):
+    """Run ``fn`` with all floating args cast to fp32 while amp is active."""
+    return make_cast_wrapper(fn, False, _get_cache, _is_active)
+
+
+def promote_function(fn):
+    """Run ``fn`` with floating args promoted to their widest dtype."""
+    return make_promote_wrapper(fn, _is_active)
+
+
+# ---- pre-initialize registration (reference API) --------------------------
+
+def register_half_function(module, name: str) -> None:
+    _USER_REGISTRY.append((module, name, "half"))
+
+
+def register_float_function(module, name: str) -> None:
+    _USER_REGISTRY.append((module, name, "float"))
+
+
+def register_promote_function(module, name: str) -> None:
+    _USER_REGISTRY.append((module, name, "promote"))

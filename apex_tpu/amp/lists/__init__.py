@@ -1,0 +1,20 @@
+"""Per-namespace O1 cast lists (reference: ``apex/amp/lists/``).
+
+Three categories per namespace, mirroring the reference's registry:
+
+* ``FP16_FUNCS`` — run in the 16-bit type (bf16 on TPU/CPU): the
+  MXU-friendly matmul/conv family where reduced precision is free accuracy
+  and maximal throughput.
+* ``FP32_FUNCS`` — numerically sensitive ops (transcendentals, softmax,
+  norms, losses, big reductions) always run fp32.
+* ``CASTS`` — multi-arg ops promoted to the widest floating dtype among
+  their args; ``SEQUENCE_CASTS`` take a sequence first-arg (cat/stack).
+
+Names are strings resolved with ``hasattr`` at patch time so the lists
+stay valid across torch versions.
+"""
+from apex_tpu.amp.lists import (  # noqa: F401
+    functional_overrides,
+    tensor_overrides,
+    torch_overrides,
+)

@@ -1,0 +1,27 @@
+"""O1 cast lists for ``torch.nn.functional`` (reference:
+``apex/amp/lists/functional_overrides.py``)."""
+
+MODULE = "torch.nn.functional"
+
+FP16_FUNCS = [
+    "conv1d", "conv2d", "conv3d",
+    "conv_transpose1d", "conv_transpose2d", "conv_transpose3d",
+    "conv_tbc",
+    "linear",
+]
+
+FP32_FUNCS = [
+    "softmax", "log_softmax",
+    "layer_norm", "group_norm", "local_response_norm", "normalize",
+    "softplus", "softmin", "gelu", "tanh",
+    "cosine_similarity",
+    "poisson_nll_loss", "cosine_embedding_loss", "cross_entropy",
+    "hinge_embedding_loss", "kl_div", "l1_loss", "mse_loss",
+    "margin_ranking_loss", "multilabel_margin_loss", "multi_margin_loss",
+    "nll_loss", "smooth_l1_loss", "soft_margin_loss",
+    "triplet_margin_loss",
+]
+
+CASTS = []
+
+SEQUENCE_CASTS = []

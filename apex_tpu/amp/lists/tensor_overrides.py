@@ -1,0 +1,29 @@
+"""O1 cast lists for ``torch.Tensor`` methods (reference:
+``apex/amp/lists/tensor_overrides.py``)."""
+
+FP16_FUNCS = [
+    "__matmul__",
+    "matmul", "mm", "mv", "bmm",
+    "addmm", "addmv", "addr", "addbmm", "baddbmm",
+]
+
+FP32_FUNCS = [
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1",
+    "log", "log10", "log1p", "log2", "reciprocal", "rsqrt",
+    "sinh", "tan",
+    "pow", "__pow__", "__rpow__",
+    "softmax", "log_softmax",
+    "cumprod", "cumsum", "prod", "sum",
+    "dist", "norm", "renorm",
+]
+
+CASTS = [
+    "__add__", "__div__", "__eq__", "__ge__", "__gt__", "__iadd__",
+    "__idiv__", "__imul__", "__isub__", "__itruediv__", "__le__",
+    "__lt__", "__mul__", "__ne__", "__radd__", "__rdiv__", "__rmul__",
+    "__rsub__", "__rtruediv__", "__sub__", "__truediv__",
+    "add", "addcdiv", "addcmul", "atan2", "div", "dot", "fmod", "mul",
+    "sub",
+]
+
+SEQUENCE_CASTS = []

@@ -1,0 +1,35 @@
+"""O1 cast lists for the ``torch`` namespace (reference:
+``apex/amp/lists/torch_overrides.py``)."""
+
+# matmul/conv family -> 16-bit (MXU-shaped work)
+FP16_FUNCS = [
+    "conv1d", "conv2d", "conv3d",
+    "conv_transpose1d", "conv_transpose2d", "conv_transpose3d",
+    "conv_tbc",
+    "matmul", "mm", "mv", "bmm",
+    "addmm", "addmv", "addr", "addbmm", "baddbmm",
+    "prelu",
+]
+
+# precision-sensitive -> fp32
+FP32_FUNCS = [
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1",
+    "log", "log10", "log1p", "log2", "reciprocal", "rsqrt",
+    "sinh", "tan",
+    "pow",
+    "softmax", "log_softmax",
+    "cumprod", "cumsum", "prod", "sum",
+    "dist", "norm", "renorm",
+    "cosine_similarity",
+]
+
+# multi-arg ops -> widest dtype among the args
+CASTS = [
+    "add", "addcdiv", "addcmul", "atan2", "bilinear", "cross", "div",
+    "dot", "fmod", "mul", "sub",
+    "eq", "equal", "ge", "gt", "le", "lt", "ne",
+    "min", "max",
+]
+
+# first arg is a sequence of tensors, promoted together
+SEQUENCE_CASTS = ["cat", "stack"]

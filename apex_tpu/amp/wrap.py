@@ -1,0 +1,155 @@
+"""O1 function-wrapping machinery (reference: ``apex/amp/wrap.py``).
+
+Works over BOTH torch tensors (the CPU parity shim) and jax arrays (the
+TPU path): the cast helpers dispatch on type.  The weight-cast cache is
+the reference's ``cached_cast`` — casting an fp32 *leaf* (parameter) to
+bf16 is memoized per iteration so every consumer of the same weight in a
+step reuses one cast (and one autograd cast-node); the handle clears the
+cache when the loss scaler updates.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+__all__ = [
+    "cached_cast", "make_cast_wrapper", "make_promote_wrapper",
+    "make_sequence_promote_wrapper",
+]
+
+
+def _torch():
+    import torch
+    return torch
+
+
+def _is_fp_tensor(x) -> bool:
+    try:
+        torch = _torch()
+        if isinstance(x, torch.Tensor):
+            return x.is_floating_point()
+    except ImportError:  # pragma: no cover
+        pass
+    import jax.numpy as jnp
+    return hasattr(x, "dtype") and hasattr(x, "ndim") and \
+        jnp.issubdtype(getattr(x, "dtype", None), jnp.floating)
+
+
+def _to_dtype(x, want_half: bool):
+    """Cast a floating tensor/array to the 16-bit or fp32 type."""
+    try:
+        torch = _torch()
+        if isinstance(x, torch.Tensor):
+            return x.to(torch.bfloat16 if want_half else torch.float32)
+    except ImportError:  # pragma: no cover
+        pass
+    import jax.numpy as jnp
+    return x.astype(jnp.bfloat16 if want_half else jnp.float32)
+
+
+def _is_half(x) -> bool:
+    try:
+        torch = _torch()
+        if isinstance(x, torch.Tensor):
+            return x.dtype in (torch.bfloat16, torch.float16)
+    except ImportError:  # pragma: no cover
+        pass
+    import jax.numpy as jnp
+    return x.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def cached_cast(x, want_half: bool, cache: Optional[dict]):
+    """Cast one tensor, memoizing leaf-parameter casts in ``cache``
+    (reference: ``wrap.py :: cached_cast``).  Cache hits verify identity —
+    a replaced parameter with a recycled ``id`` misses cleanly."""
+    if not _is_fp_tensor(x):
+        return x
+    if _is_half(x) == want_half:
+        return x
+    cacheable = False
+    try:
+        torch = _torch()
+        cacheable = (cache is not None and isinstance(x, torch.Tensor)
+                     and x.requires_grad and x.is_leaf)
+    except ImportError:  # pragma: no cover
+        pass
+    if cacheable:
+        key = id(x)
+        hit = cache.get(key)
+        if hit is not None and hit[0] is x:
+            return hit[1]
+        y = _to_dtype(x, want_half)
+        cache[key] = (x, y)
+        return y
+    return _to_dtype(x, want_half)
+
+
+def _map_structure(obj, fn):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_map_structure(o, fn) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _map_structure(v, fn) for k, v in obj.items()}
+    return fn(obj)
+
+
+def make_cast_wrapper(orig, want_half: bool, get_cache, is_active):
+    """Wrap ``orig`` to cast all floating args to bf16 (half list) or
+    fp32 (float list) while amp is active."""
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if not is_active():
+            return orig(*args, **kwargs)
+        cache = get_cache()
+        cast = lambda x: cached_cast(x, want_half, cache)  # noqa: E731
+        args = _map_structure(list(args), cast)
+        kwargs = _map_structure(kwargs, cast)
+        return orig(*args, **kwargs)
+
+    wrapper._amp_original = orig
+    return wrapper
+
+
+def _widest_is_fp32(tensors) -> bool:
+    return any(not _is_half(t) for t in tensors)
+
+
+def make_promote_wrapper(orig, is_active):
+    """Wrap a multi-arg op to promote every floating arg to the widest
+    floating dtype among them (reference promote semantics: any fp32
+    operand promotes the op to fp32)."""
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if not is_active():
+            return orig(*args, **kwargs)
+        fps = [a for a in args if _is_fp_tensor(a)]
+        fps += [v for v in kwargs.values() if _is_fp_tensor(v)]
+        if len(fps) < 2 or not _widest_is_fp32(fps):
+            return orig(*args, **kwargs)
+        cast = lambda x: cached_cast(x, False, None)  # noqa: E731
+        args = _map_structure(list(args), cast)
+        kwargs = _map_structure(kwargs, cast)
+        return orig(*args, **kwargs)
+
+    wrapper._amp_original = orig
+    return wrapper
+
+
+def make_sequence_promote_wrapper(orig, is_active):
+    """Wrap cat/stack-style ops: promote the tensors INSIDE the first
+    (sequence) argument together."""
+
+    @functools.wraps(orig)
+    def wrapper(seq, *args, **kwargs):
+        if not is_active():
+            return orig(seq, *args, **kwargs)
+        tensors = [t for t in seq if _is_fp_tensor(t)]
+        if tensors and _widest_is_fp32(tensors):
+            seq = type(seq)(
+                cached_cast(t, False, None) if _is_fp_tensor(t) else t
+                for t in seq)
+        return orig(seq, *args, **kwargs)
+
+    wrapper._amp_original = orig
+    return wrapper

@@ -138,9 +138,11 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
     norms for the trust ratio, phase-2 scaled apply, then all-gather.
 
     The reference computes exact per-tensor norms across shards
-    (``multi_tensor_l2norm`` + group allreduce); here the shard-local
-    sums-of-squares are psum'd over the data axis — same math, one
-    collective.
+    (``multi_tensor_l2norm`` + group allreduce); here each shard computes
+    per-tensor partial sums of squares with a segment-sum over the leaf
+    layout (segment ids derived on device via ``searchsorted`` on the
+    static leaf offsets — no O(n) host arrays), psum'd over the data axis
+    — same math, one collective, EXACT per-tensor trust ratios.
     """
 
     _state_keys = ("exp_avg", "exp_avg_sq")
@@ -149,7 +151,8 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
                  bias_correction: bool = True, betas=(0.9, 0.999),
                  eps: float = 1e-6, weight_decay: float = 0.01,
                  max_grad_norm: float = 1.0, axis_name: str = "data",
-                 grad_average: bool = True, **_parity_kwargs):
+                 grad_average: bool = True, use_nvlamb: bool = False,
+                 **_parity_kwargs):
         super().__init__(shard_size_divisor, axis_name)
         self.lr = lr
         self.bias_correction = bias_correction
@@ -158,9 +161,26 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
         self.weight_decay = weight_decay
         self.max_grad_norm = max_grad_norm
         self.grad_average = grad_average
+        self.use_nvlamb = use_nvlamb
+
+    def _shard_segment_ids(self, leaves, n: int):
+        """Per-element tensor ids for MY shard of the padded flat buffer.
+
+        Leaf boundaries are static; my shard's offset is dynamic
+        (axis_index), so ids come from ``searchsorted`` of the positions
+        against the cumulative leaf ends.  Padding tail gets id
+        ``n_tensors`` (an extra dropped segment)."""
+        sizes = [int(l.size) for l in leaves]
+        ends = jnp.asarray(
+            [sum(sizes[:i + 1]) for i in range(len(sizes))], jnp.int32)
+        shard_len = self._padded(n) // self.dp
+        idx = jax.lax.axis_index(self.axis_name) if self.dp > 1 else 0
+        pos = idx * shard_len + jnp.arange(shard_len, dtype=jnp.int32)
+        return jnp.searchsorted(ends, pos, side="right"), len(sizes)
 
     def step(self, state: dict, grads, *, lr: Optional[float] = None,
              noop_flag=0.0, grad_scale=1.0):
+        leaves = jax.tree.leaves(grads)
         gshard, n, unravel = self._shard_grads(grads)
         if self.grad_average and self.dp > 1:
             gshard = gshard / self.dp
@@ -169,7 +189,9 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
         if self.dp > 1:
             sq = jax.lax.psum(sq, self.axis_name)
         gnorm = jnp.sqrt(sq)
-        clip = jnp.minimum(self.max_grad_norm / (gnorm + 1e-6), 1.0) \
+        # same formula as optimizers.FusedLAMB._lamb_step for equivalence
+        clip = jnp.where(gnorm > self.max_grad_norm,
+                         self.max_grad_norm / (gnorm + 1e-6), 1.0) \
             if self.max_grad_norm else 1.0
         step = state["step"] + 1
         m, v, u = fused_lamb_phase1_flat(
@@ -177,19 +199,25 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
             state["exp_avg_sq"], beta1=self.betas[0], beta2=self.betas[1],
             eps=self.eps, weight_decay=self.weight_decay, step=step,
             bias_correction=self.bias_correction, grad_scale=grad_scale)
-        # trust ratio on the FLAT shard: ||p|| and ||u|| psum'd globally.
-        # (The reference applies per-tensor ratios; the flat-global ratio is
-        # the documented difference — per-tensor requires the leaf layout,
-        # available via apex_tpu.optimizers.FusedLAMB for the non-ZeRO path.)
+        # EXACT per-tensor trust ratios (reference: multi_tensor_l2norm per
+        # tensor + group allreduce): shard-local per-tensor partial sq-sums
+        # via segment_sum, psum over dp, ratio gathered back per element.
         p32 = state["master"]
-        psq = jnp.sum(jnp.square(p32))
-        usq = jnp.sum(jnp.square(u))
+        seg, n_tensors = self._shard_segment_ids(leaves, n)
+        psq = jax.ops.segment_sum(jnp.square(p32), seg,
+                                  num_segments=n_tensors + 1)
+        usq = jax.ops.segment_sum(jnp.square(u), seg,
+                                  num_segments=n_tensors + 1)
         if self.dp > 1:
             psq = jax.lax.psum(psq, self.axis_name)
             usq = jax.lax.psum(usq, self.axis_name)
         pnorm, unorm = jnp.sqrt(psq), jnp.sqrt(usq)
-        trust = jnp.where((pnorm > 0) & (unorm > 0), pnorm / unorm, 1.0)
-        lr_t = (self.lr if lr is None else lr) * trust
+        if self.use_nvlamb:
+            trust = pnorm / jnp.maximum(unorm, 1e-12)
+        else:
+            trust = jnp.where((pnorm > 0) & (unorm > 0), pnorm / unorm, 1.0)
+        trust = trust.at[n_tensors].set(1.0)   # padding segment
+        lr_t = (self.lr if lr is None else lr) * trust[seg]
         p = p32 - lr_t * u
         skip = jnp.asarray(noop_flag, jnp.float32) > 0
         p = jnp.where(skip, p32, p)

@@ -70,7 +70,17 @@ def mha_reference(q, k, v, *, causal: bool = False, mask=None,
 # forward kernel: grid (bh, nq, nk), k innermost ("arbitrary"), online softmax
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(causal, off, scale, bq, bk, nk, masked,
+def _valid_mask(s, valid, qi, ki, bq, bk):
+    """Mask scores outside the (q_len, k_len) valid region to _NEG_INF —
+    used when the sequence was padded up to a lane multiple."""
+    if valid is None:
+        return s
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where((rows < valid[0]) & (cols < valid[1]), s, _NEG_INF)
+
+
+def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid,
                 q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr):
     ki = pl.program_id(2)
@@ -97,6 +107,7 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked,
             s = jnp.where(rows + off >= cols, s, _NEG_INF)
         if masked:
             s = jnp.where(mask_ref[0], _NEG_INF, s)
+        s = _valid_mask(s, valid, qi, ki, bq, bk)
         m_prev = m_scr[...]                              # [bq, LANES]
         m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)               # lane-replicated
@@ -119,10 +130,12 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked,
         lse_ref[0] = (m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l)))
 
 
-def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk, out_dtype=None):
+def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk, out_dtype=None,
+         causal_off=None, valid=None):
     bh, sq, d = q3.shape
     out_dtype = out_dtype or q3.dtype
     sk = k3.shape[1]
+    off = (sk - sq) if causal_off is None else causal_off
     nq, nk = cdiv(sq, bq), cdiv(sk, bk)
     masked = mask3 is not None
     in_specs = [
@@ -135,7 +148,8 @@ def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk, out_dtype=None):
         h_per = bh // nmask
         in_specs.append(pl.BlockSpec(
             (1, bq, bk), lambda b, i, j: (b // h_per, i, j)))
-    base = functools.partial(_fwd_kernel, causal, sk - sq, scale, bq, bk, nk, masked)
+    base = functools.partial(_fwd_kernel, causal, off, scale, bq, bk, nk,
+                             masked, valid)
     kernel = base if masked else (
         lambda q, k, v, o, lse, m, l, acc: base(q, k, v, None, o, lse,
                                                 m, l, acc))
@@ -167,7 +181,7 @@ def _fwd(q3, k3, v3, mask3, causal, scale, bq, bk, out_dtype=None):
 # backward kernels (flash decomposition): recompute p blockwise from lse
 # --------------------------------------------------------------------------
 
-def _dq_kernel(causal, off, scale, bq, bk, nk, masked,
+def _dq_kernel(causal, off, scale, bq, bk, nk, masked, valid,
                q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                dq_ref, dq_scr):
     ki = pl.program_id(2)
@@ -191,6 +205,7 @@ def _dq_kernel(causal, off, scale, bq, bk, nk, masked,
             s = jnp.where(rows + off >= cols, s, _NEG_INF)
         if masked:
             s = jnp.where(mask_ref[0], _NEG_INF, s)
+        s = _valid_mask(s, valid, qi, ki, bq, bk)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
@@ -204,7 +219,7 @@ def _dq_kernel(causal, off, scale, bq, bk, nk, masked,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(causal, off, scale, bq, bk, nq, masked,
+def _dkv_kernel(causal, off, scale, bq, bk, nq, masked, valid,
                 q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr):
     qi = pl.program_id(2)
@@ -229,6 +244,7 @@ def _dkv_kernel(causal, off, scale, bq, bk, nq, masked,
             s = jnp.where(rows + off >= cols, s, _NEG_INF)
         if masked:
             s = jnp.where(mask_ref[0], _NEG_INF, s)
+        s = _valid_mask(s, valid, qi, ki, bq, bk)
         p = jnp.exp(s - lse_ref[0][:, :1])                 # [bq, bk]
         do = do_ref[0].astype(jnp.float32)
         dv_scr[...] += jax.lax.dot_general(
@@ -249,9 +265,10 @@ def _dkv_kernel(causal, off, scale, bq, bk, nq, masked,
 
 
 def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
-              out_dtype=None):
+              out_dtype=None, causal_off=None, valid=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
+    off = (sk - sq) if causal_off is None else causal_off
     nq, nk = cdiv(sq, bq), cdiv(sk, bk)
     masked = mask3 is not None
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
@@ -274,7 +291,8 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
         dq_in_specs.append(pl.BlockSpec(
             (1, bq, bk), lambda b, i, j: (b // h_per, i, j)))
 
-    dq_base = functools.partial(_dq_kernel, causal, sk - sq, scale, bq, bk, nk, masked)
+    dq_base = functools.partial(_dq_kernel, causal, off, scale, bq, bk, nk,
+                                masked, valid)
     dq_kernel = dq_base if masked else (
         lambda q, k, v, do, lse, dlt, dq, scr: dq_base(
             q, k, v, do, lse, dlt, None, dq, scr))
@@ -303,7 +321,7 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
             (1, bq, bk), lambda b, j, i: (b // h_per, i, j)))
 
     dkv_base = functools.partial(
-        _dkv_kernel, causal, sk - sq, scale, bq, bk, nq, masked)
+        _dkv_kernel, causal, off, scale, bq, bk, nq, masked, valid)
     dkv_kernel = dkv_base if masked else (
         lambda q, k, v, do, lse, dlt, dk, dv, s1, s2: dkv_base(
             q, k, v, do, lse, dlt, None, dk, dv, s1, s2))
@@ -336,13 +354,23 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
 
 def _fit_block(s: int, preferred: int):
     """Largest block <= preferred that divides s and is a lane multiple
-    (or s itself when s < 128); None -> fall back to the oracle."""
+    (or s itself when s < 128); None -> needs padding."""
     if s <= preferred:
         return s
     for cand in range(preferred, _LANES - 1, -_LANES):
         if s % cand == 0:
             return cand
     return None
+
+
+def _plan_block(s: int, preferred: int):
+    """(block, padded_len) — pad s up to the next lane multiple when no
+    lane-multiple block divides it (e.g. s=1000 -> 1024, block 512)."""
+    b = _fit_block(s, preferred)
+    if b is not None:
+        return b, s
+    s_pad = cdiv(s, _LANES) * _LANES
+    return _fit_block(s_pad, preferred), s_pad
 
 
 def flash_attention(q, k, v, *, causal: bool = False, mask=None,
@@ -352,18 +380,22 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
 
     Drop-in fused path for the reference's ``fmhalib`` /
     ``fast_multihead_attn`` forward+backward.  ``mask`` is boolean with
-    True = masked (broadcastable ``[b|1, 1, sq, sk]``).  Falls back to the
-    jnp oracle when the sequence doesn't tile (reference kernels instead
-    refuse such shapes).
+    True = masked (broadcastable ``[b|1, 1, sq, sk]``).  Sequences that
+    don't tile to the 128-lane grid are padded up to the next lane
+    multiple and masked inside the kernel — the kernel path is taken for
+    EVERY shape (the reference kernels instead refuse such shapes; the
+    old behavior here was a silent O(s²) oracle fallback).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
     scale = (d ** -0.5) if sm_scale is None else sm_scale
-    bq = _fit_block(sq, block_q)
-    bk = _fit_block(sk, block_k)
-    if bq is None or bk is None:
-        return mha_reference(q, k, v, causal=causal, mask=mask,
-                             sm_scale=scale)
+    bq, sq_pad = _plan_block(sq, block_q)
+    bk, sk_pad = _plan_block(sk, block_k)
+    padded = (sq_pad != sq) or (sk_pad != sk)
+    # real-length causal offset / validity window, pre-padding
+    causal_off = sk - sq
+    valid = (sq, sk) if padded else None
+
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
@@ -378,20 +410,33 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
         else:           # per-head mask: materialize the full [b*h, sq, sk]
             mask3 = jnp.broadcast_to(
                 mask, (b, h, sq, sk)).reshape(b * h, sq, sk)
+    if padded:
+        q3 = jnp.pad(q3, ((0, 0), (0, sq_pad - sq), (0, 0)))
+        k3 = jnp.pad(k3, ((0, 0), (0, sk_pad - sk), (0, 0)))
+        v3 = jnp.pad(v3, ((0, 0), (0, sk_pad - sk), (0, 0)))
+        if mask3 is not None:   # padding handled by the validity window
+            mask3 = jnp.pad(
+                mask3, ((0, 0), (0, sq_pad - sq), (0, sk_pad - sk)))
 
     @jax.custom_vjp
     def run(q3, k3, v3):
-        out, _ = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk)
+        out, _ = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk,
+                      causal_off=causal_off, valid=valid)
         return out
 
     def run_fwd(q3, k3, v3):
-        out, lse = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk)
+        out, lse = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk,
+                        causal_off=causal_off, valid=valid)
         return out, (q3, k3, v3, out, lse)
 
     def run_bwd(res, do3):
         q3, k3, v3, out, lse = res
         return _bwd_impl(q3, k3, v3, mask3, out, lse, do3,
-                         causal, scale, bq, bk)
+                         causal, scale, bq, bk,
+                         causal_off=causal_off, valid=valid)
 
     run.defvjp(run_fwd, run_bwd)
-    return run(q3, k3, v3).reshape(b, h, sq, d)
+    out = run(q3, k3, v3)
+    if padded:
+        out = out[:, :sq, :]
+    return out.reshape(b, h, sq, d)

@@ -4,6 +4,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
+    embedding_grads_all_reduce,
 )
 from apex_tpu.transformer.pipeline_parallel import p2p_communication
 from apex_tpu.transformer.pipeline_parallel.utils import (
@@ -20,6 +21,7 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
+    "embedding_grads_all_reduce",
     "p2p_communication",
     "setup_microbatch_calculator",
     "get_num_microbatches",

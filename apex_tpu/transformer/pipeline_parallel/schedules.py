@@ -46,7 +46,30 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
+    "embedding_grads_all_reduce",
 ]
+
+
+def embedding_grads_all_reduce(embed_grads, *, axis_name: str = PIPE_AXIS):
+    """Tied input/output embedding gradient reduction (reference:
+    ``allreduce_word_embedding_grads`` over ``get_embedding_group()`` —
+    the NCCL group containing only the first and last pipeline stages).
+
+    Mesh-native: a masked psum over the pipe axis — only the first and
+    last stages contribute their local embedding grad; every stage
+    receives the sum (intermediate stages' results are unused, matching
+    the reference where they are not group members).  With pp == 1 (or
+    untied embeddings) this is the identity.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return embed_grads
+    stage = jax.lax.axis_index(axis_name)
+    member = (stage == 0) | (stage == n - 1)
+    return jax.tree.map(
+        lambda g: jax.lax.psum(
+            jnp.where(member, g, jnp.zeros_like(g)), axis_name),
+        embed_grads)
 
 
 def get_forward_backward_func(
@@ -73,6 +96,30 @@ def _microbatch(batch, idx):
     return jax.tree.map(lambda x: x[idx], batch)
 
 
+def _normalize_loss_fn(loss_fn):
+    """Loss contract: ``loss_fn(y, mb)`` or ``loss_fn(y, mb, params)``.
+
+    The 3-arg form is how parameterized heads (e.g. the TIED word
+    embedding projecting hidden->logits on the last stage) receive
+    gradients: params referenced through a Python closure are NOT
+    grad-tracked inputs of the executor's vjp and would silently get zero
+    grads.  Returns a uniform ``f(y, mb, params)`` plus whether params
+    gradients must be threaded."""
+    import inspect
+    try:
+        sig = inspect.signature(loss_fn)
+        # only REQUIRED positional params count: loss_fn(y, mb, w=None) or
+        # (y, mb, *, s=0.1) stay on the 2-arg contract
+        n = sum(1 for p in sig.parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty)
+    except (TypeError, ValueError):  # builtins/partials without signature
+        n = 2
+    if n >= 3:
+        return loss_fn, True
+    return (lambda y, mb, params: loss_fn(y, mb)), False
+
+
 def forward_backward_no_pipelining(
         stage_fn: Callable, loss_fn: Callable, params, batch, *,
         num_microbatches: int, input_fn: Callable = None,
@@ -83,9 +130,10 @@ def forward_backward_no_pipelining(
     sync to the last microbatch; here grads are accumulated locally in the
     scan and reduced once by the caller — same traffic."""
     input_fn = input_fn or (lambda mb: mb)
+    lf, _ = _normalize_loss_fn(loss_fn)
 
     def one_loss(p, mb):
-        return loss_fn(stage_fn(p, input_fn(mb), mb), mb)
+        return lf(stage_fn(p, input_fn(mb), mb), mb, p)
 
     if forward_only:
         def tick(acc, idx):
@@ -119,6 +167,7 @@ def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
     stage = jax.lax.axis_index(axis_name)
     n_ticks = num_microbatches + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    lf, _ = _normalize_loss_fn(loss_fn)
 
     mb0 = _microbatch(batch, 0)
     hidden0 = input_fn(mb0)
@@ -136,7 +185,7 @@ def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
             input_fn(mb), state)
         y = stage_fn(params, x, mb)
         # last stage emits microbatch t-(n_stages-1)
-        loss = loss_fn(y, mb)
+        loss = lf(y, mb, params)
         valid = (stage == n_stages - 1) & (t - stage >= 0)
         loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
         state = jax.tree.map(
@@ -148,28 +197,193 @@ def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
     return loss_acc / num_microbatches
 
 
+def _residual_layout(stage_fn, loss_fn, input_fn, params, batch):
+    """Trace one stage forward+vjp OUTSIDE the tick scan to learn the
+    residual structure: which vjp residuals are the params themselves
+    (tick-invariant — substituted at backward time, never buffered) and
+    the shapes/dtypes of the rest (stored in the circular buffer).
+
+    ``jax.closure_convert`` hoists the opaque ``jax.vjp`` closure into a
+    pure function + concrete residual arrays; identity against the params
+    leaves finds the invariant ones.  The traced forward's outputs are
+    unused, so XLA dead-code-eliminates the probe.
+    """
+    mb0 = _microbatch(batch, 0)
+    x0 = input_fn(mb0)
+    y0, vjp0 = jax.vjp(lambda p, xx: stage_fn(p, xx, mb0), params, x0)
+    _, consts0 = jax.closure_convert(vjp0, y0)
+    p_leaves = jax.tree.leaves(params)
+    pid = {id(l): j for j, l in enumerate(p_leaves)}
+    inv_map = tuple(pid.get(id(c), -1) for c in consts0)
+    buf_shapes = tuple((c.shape, c.dtype)
+                       for c, j in zip(consts0, inv_map) if j < 0)
+    return inv_map, buf_shapes, x0
+
+
+def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
+                         num_microbatches: int, axis_name: str):
+    """True-1F1B pipelined forward+backward with bounded live activations
+    (reference: ``fwd_bwd_pipelining_without_interleaving.py``'s
+    warmup / steady-1F1B / cooldown schedule).
+
+    One ``lax.scan`` over ``num_microbatches + 2*(pp-1)`` ticks.  Each
+    tick, every stage runs one forward (microbatch ``t - s``) and one
+    backward (microbatch ``t - 2*(pp-1) + s``), hand-pairing ``jax.vjp``
+    per microbatch: forward residuals live in a circular buffer of
+    ``D = 2*(pp-1)+1`` slots — the 1F1B bounded-memory profile (O(pp)
+    in-flight microbatches, INDEPENDENT of num_microbatches), vs. the
+    grad-of-scan GPipe executor that stashes ``n + pp - 1`` ticks.
+    Bubble is the same 2*(pp-1) ticks as the reference's warmup+cooldown.
+
+    Residuals that are literally the params (weights captured by matmul
+    VJPs) are recognised by identity and substituted at backward time
+    instead of being buffered — the buffer holds only activation-derived
+    residuals, matching the reference's ~pp activation stash.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n = num_microbatches
+    depth = 2 * (n_stages - 1) + 1
+    n_ticks = n + 2 * (n_stages - 1)
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    lf, loss_has_params = _normalize_loss_fn(loss_fn)
+
+    inv_map, buf_shapes, x0 = _residual_layout(
+        stage_fn, loss_fn, input_fn, params, batch)
+    p_leaves = jax.tree.leaves(params)
+
+    buf0 = [jnp.zeros((depth,) + shape, dtype)
+            for shape, dtype in buf_shapes]
+    fwd_msg0 = jax.tree.map(jnp.zeros_like, x0)
+    bwd_msg0 = jax.tree.map(jnp.zeros_like, x0)
+    grad0 = jax.tree.map(jnp.zeros_like, params)
+
+    def tick(carry, t):
+        buf, xbuf, fwd_msg, bwd_msg, grad_acc, loss_acc = carry
+        last = stage == n_stages - 1
+
+        # ---- forward half: microbatch t - stage --------------------------
+        f_pos = t - stage
+        f_valid = (f_pos >= 0) & (f_pos < n)
+        mb = _microbatch(batch, jnp.clip(f_pos, 0, n - 1))
+        x = jax.tree.map(
+            lambda inj, msg: jnp.where(stage == 0, inj, msg),
+            input_fn(mb), fwd_msg)
+        y, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, mb), params, x)
+        _, consts = jax.closure_convert(vjp, y)
+        assert len(consts) == len(inv_map), (
+            "vjp residual structure diverged between probe and scan body "
+            f"({len(consts)} vs {len(inv_map)})")
+
+        # loss + its input cotangent (meaningful on the last stage only;
+        # other stages compute it masked — lockstep SPMD).  A 3-arg
+        # loss_fn(y, mb, params) is differentiated wrt params too — the
+        # tied-embedding / parameterized-head path.
+        if loss_has_params:
+            loss, lvjp = jax.vjp(lambda p_, yy: lf(yy, mb, p_), params, y)
+            dp_loss, dy_local = lvjp(jnp.asarray(1.0 / n, loss.dtype))
+        else:
+            loss, lvjp = jax.vjp(lambda yy: lf(yy, mb, None), y)
+            (dy_local,) = lvjp(jnp.asarray(1.0 / n, loss.dtype))
+            dp_loss = None
+        loss_acc = loss_acc + jnp.where(f_valid & last, loss, 0.0)
+        if dp_loss is not None:
+            grad_acc = jax.tree.map(
+                lambda a, d: a + jnp.where(f_valid & last, d,
+                                           jnp.zeros_like(d)),
+                grad_acc, dp_loss)
+
+        # stash hoisted (inexact) residuals + the stage input at slot
+        # t % depth
+        buffered = [c for c, j in zip(consts, inv_map) if j < 0]
+        buf = [b.at[t % depth].set(c) for b, c in zip(buf, buffered)]
+        xbuf = jax.tree.map(lambda b, c: b.at[t % depth].set(c), xbuf, x)
+
+        # ---- backward half: microbatch t - 2*(pp-1) + stage --------------
+        b_pos = t - 2 * (n_stages - 1) + stage
+        b_valid = (b_pos >= 0) & (b_pos < n)
+        # that microbatch's forward ran at tick f = b_pos + stage, i.e.
+        # slot (t + 1 + 2*stage) % depth; on the last stage this IS the
+        # slot written above (gap 0), already holding this tick's consts.
+        slot_r = (t + 1 + 2 * stage) % depth
+        # Rebuild the vjp STRUCTURE from microbatch b's own (x, mb):
+        # closure_convert hoists only inexact-dtype residuals — integer /
+        # bool residuals (gather indices, masks) stay baked in the
+        # converted function, so they MUST be derived from the microbatch
+        # being differentiated, not from this tick's forward.  Hoisted
+        # float residuals are substituted from the circular buffer, so the
+        # rebuilt forward's float compute is dead code XLA eliminates —
+        # only int/bool-residual-producing prefixes (if any) recompute.
+        mb_b = _microbatch(batch, jnp.clip(b_pos, 0, n - 1))
+        x_b = jax.tree.map(lambda b: b[slot_r], xbuf)
+        y_b, vjp_b = jax.vjp(
+            lambda p, xx: stage_fn(p, xx, mb_b), params, x_b)
+        vjp_fn_b, _ = jax.closure_convert(vjp_b, y_b)
+        consts_b, bi = [], 0
+        for j in inv_map:
+            if j >= 0:
+                consts_b.append(p_leaves[j])
+            else:
+                consts_b.append(buf[bi][slot_r])
+                bi += 1
+        dy = jax.tree.map(
+            lambda dl, msg: jnp.where(last, dl, msg), dy_local, bwd_msg)
+        dparams, dx = vjp_fn_b(dy, *consts_b)
+        grad_acc = jax.tree.map(
+            lambda a, d: a + jnp.where(b_valid, d, jnp.zeros_like(d)),
+            grad_acc, dparams)
+
+        # ---- ring messages ----------------------------------------------
+        fwd_msg = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, fwd_perm), y)
+        bwd_msg = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, bwd_perm), dx)
+        return (buf, xbuf, fwd_msg, bwd_msg, grad_acc, loss_acc), None
+
+    xbuf0 = jax.tree.map(
+        lambda a: jnp.zeros((depth,) + a.shape, a.dtype), x0)
+    (_, _, _, _, grads, loss_acc), _ = jax.lax.scan(
+        tick,
+        (buf0, xbuf0, fwd_msg0, bwd_msg0, grad0,
+         jnp.zeros((), jnp.float32)),
+        jnp.arange(n_ticks))
+    return loss_acc / n, grads
+
+
 def forward_backward_pipelining_without_interleaving(
         stage_fn: Callable, loss_fn: Callable, params, batch, *,
         num_microbatches: int, input_fn: Callable = None,
         forward_only: bool = False, axis_name: str = PIPE_AXIS,
-        **_parity_kwargs):
-    """1F1B-equivalent pipelined executor (reference:
+        use_1f1b: bool = True, **_parity_kwargs):
+    """1F1B pipelined executor (reference:
     ``fwd_bwd_pipelining_without_interleaving.py``).
 
     Params leaves are this rank's stage slice (leading stage dim consumed
     by shard_map).  The loss value is psum'd over the pipe axis for
-    reporting (it lives on the last stage); grads come from plain
-    ``jax.grad`` of the local loss — ppermute transposition carries
-    cotangents back through the stages.
+    reporting (it lives on the last stage).
+
+    The backward is the hand-paired 1F1B schedule of
+    ``_pipeline_1f1b_local`` (bounded O(pp) activation memory).  Pass
+    ``use_1f1b=False`` for the differentiate-the-forward-scan GPipe
+    executor (stashes ``n + pp - 1`` activation ticks; useful as an
+    oracle — the two produce identical losses and grads).
     """
     input_fn = input_fn or (lambda mb: mb)
-    local = functools.partial(
-        _pipeline_local_loss, stage_fn, loss_fn, input_fn,
-        num_microbatches=num_microbatches, axis_name=axis_name)
     if forward_only:
-        loss = local(params, batch)
+        loss = _pipeline_local_loss(
+            stage_fn, loss_fn, input_fn, params, batch,
+            num_microbatches=num_microbatches, axis_name=axis_name)
         return jax.lax.psum(loss, axis_name), None
-    loss, grads = jax.value_and_grad(local)(params, batch)
+    if use_1f1b:
+        loss, grads = _pipeline_1f1b_local(
+            stage_fn, loss_fn, input_fn, params, batch,
+            num_microbatches=num_microbatches, axis_name=axis_name)
+    else:
+        local = functools.partial(
+            _pipeline_local_loss, stage_fn, loss_fn, input_fn,
+            num_microbatches=num_microbatches, axis_name=axis_name)
+        loss, grads = jax.value_and_grad(local)(params, batch)
     return jax.lax.psum(loss, axis_name), grads
 
 
@@ -197,6 +411,8 @@ def forward_backward_pipelining_with_interleaving(
         v = (parallel_state.get_virtual_pipeline_model_parallel_world_size()
              or jax.tree.leaves(params)[0].shape[0])
 
+    lf, _ = _normalize_loss_fn(loss_fn)
+
     def local(params, batch):
         # laps 1..v-1 consume the previous lap's last-stage output stream as
         # stage-0 input while loss_fn still sees the ORIGINAL microbatches
@@ -206,8 +422,8 @@ def forward_backward_pipelining_with_interleaving(
         def lap_input_fn(mb):
             return mb["hidden"]
 
-        def lap_loss_fn(y, mb):
-            return loss_fn(y, mb["orig"])
+        def lap_loss_fn(y, mb, p):
+            return lf(y, mb["orig"], p)
 
         chunk0 = jax.tree.map(lambda x: x[0], params)
         if v == 1:

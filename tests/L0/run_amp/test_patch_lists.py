@@ -1,0 +1,211 @@
+"""O1 patch-list machinery tests (reference:
+``tests/L0/run_amp/test_basic_casts.py``, ``test_promotion.py``,
+``test_cache.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.amp import amp as amp_mod
+
+
+@pytest.fixture
+def handle():
+    h = amp_mod.init()
+    yield h
+    h._deactivate()
+
+
+# ---- test_basic_casts analogs ---------------------------------------------
+
+def test_mm_runs_half(handle):
+    a = torch.randn(4, 4)
+    b = torch.randn(4, 4)
+    assert torch.mm(a, b).dtype == torch.bfloat16
+
+
+def test_functional_linear_runs_half(handle):
+    x = torch.randn(2, 8)
+    w = torch.randn(4, 8)
+    assert torch.nn.functional.linear(x, w).dtype == torch.bfloat16
+
+
+def test_tensor_matmul_runs_half(handle):
+    a = torch.randn(4, 4)
+    b = torch.randn(4, 4)
+    assert (a @ b).dtype == torch.bfloat16
+
+
+def test_exp_runs_float(handle):
+    x = torch.randn(8).to(torch.bfloat16)
+    assert torch.exp(x).dtype == torch.float32
+
+
+def test_softmax_runs_float(handle):
+    x = torch.randn(4, 4).to(torch.bfloat16)
+    assert torch.softmax(x, dim=-1).dtype == torch.float32
+
+
+def test_patches_restored_after_deactivate():
+    orig_mm = torch.mm
+    h = amp_mod.init()
+    assert torch.mm is not orig_mm
+    h._deactivate()
+    assert torch.mm is orig_mm
+    a = torch.randn(4, 4)
+    assert torch.mm(a, a).dtype == torch.float32
+
+
+def test_inactive_handle_is_passthrough():
+    h = amp_mod.init(enabled=False)
+    a = torch.randn(4, 4)
+    assert torch.mm(a, a).dtype == torch.float32
+    h._deactivate()
+
+
+# ---- test_promotion analogs -----------------------------------------------
+
+def test_add_promotes_mixed_to_float(handle):
+    half = torch.randn(8).to(torch.bfloat16)
+    full = torch.randn(8)
+    assert torch.add(half, full).dtype == torch.float32
+    assert (half + full).dtype == torch.float32
+
+
+def test_add_same_dtype_untouched(handle):
+    half = torch.randn(8).to(torch.bfloat16)
+    assert torch.add(half, half).dtype == torch.bfloat16
+    full = torch.randn(8)
+    assert torch.add(full, full).dtype == torch.float32
+
+
+def test_cat_promotes_sequence(handle):
+    half = torch.randn(4).to(torch.bfloat16)
+    full = torch.randn(4)
+    assert torch.cat([half, full]).dtype == torch.float32
+    assert torch.cat([half, half]).dtype == torch.bfloat16
+
+
+def test_mul_inplace_promotion(handle):
+    half = torch.randn(8).to(torch.bfloat16)
+    full = torch.randn(8)
+    out = half * full
+    assert out.dtype == torch.float32
+
+
+# ---- test_cache analogs ---------------------------------------------------
+
+def test_weight_cast_is_cached(handle):
+    w = torch.randn(4, 4, requires_grad=True)
+    x = torch.randn(4, 4)
+    y1 = torch.mm(w, x)
+    assert len(handle.cache) == 1
+    y2 = torch.mm(w, x)
+    assert len(handle.cache) == 1          # same weight: one cast
+    (y1.float().sum() + y2.float().sum()).backward()
+    # both uses flow grads through the SAME cast node back to the leaf
+    assert w.grad is not None and w.grad.dtype == torch.float32
+
+
+def test_cache_cleared_on_scaler_update(handle):
+    from apex_tpu.amp._torch_shim import _TorchScaler
+    w = torch.randn(4, 4, requires_grad=True)
+    torch.mm(w, torch.randn(4, 4))
+    assert len(handle.cache) == 1
+    _TorchScaler("dynamic").update()
+    assert len(handle.cache) == 0
+
+
+def test_cache_miss_on_recycled_id(handle):
+    w = torch.randn(4, 4, requires_grad=True)
+    key = id(w)
+    handle.cache[key] = (torch.randn(4, 4), torch.randn(4, 4))  # stale alias
+    y = torch.mm(w, torch.randn(4, 4))
+    assert y.dtype == torch.bfloat16
+    assert handle.cache[key][0] is w       # stale entry replaced
+
+
+def test_activations_not_cached(handle):
+    x = torch.randn(4, 4)                  # no requires_grad: activation
+    torch.mm(x, x)
+    assert len(handle.cache) == 0
+
+
+# ---- user decorators / registration (torch + jax) --------------------------
+
+def test_half_function_decorator_torch(handle):
+    @amp_mod.half_function
+    def f(x):
+        return x
+    assert f(torch.randn(4)).dtype == torch.bfloat16
+
+
+def test_half_function_decorator_jax(handle):
+    @amp_mod.half_function
+    def f(x):
+        return x
+    assert f(jnp.ones((4,), jnp.float32)).dtype == jnp.bfloat16
+
+
+def test_float_function_decorator_jax(handle):
+    @amp_mod.float_function
+    def f(x):
+        return x
+    assert f(jnp.ones((4,), jnp.bfloat16)).dtype == jnp.float32
+
+
+def test_promote_function_decorator_jax(handle):
+    @amp_mod.promote_function
+    def f(a, b):
+        return a, b
+    a, b = f(jnp.ones((4,), jnp.bfloat16), jnp.ones((4,), jnp.float32))
+    assert a.dtype == jnp.float32 and b.dtype == jnp.float32
+
+
+def test_register_half_function_applied_at_init():
+    import types
+    m = types.SimpleNamespace(myfn=lambda x: x)
+    amp_mod.register_half_function(m, "myfn")
+    h = amp_mod.init()
+    try:
+        assert m.myfn(torch.randn(4)).dtype == torch.bfloat16
+    finally:
+        h._deactivate()
+        amp_mod._USER_REGISTRY.clear()
+    assert m.myfn(torch.randn(4)).dtype == torch.float32
+
+
+def test_decorators_passthrough_when_inactive():
+    @amp_mod.half_function
+    def f(x):
+        return x
+    assert f(torch.randn(4)).dtype == torch.float32
+
+
+def test_o1_initialize_end_to_end():
+    """O1 via amp.initialize: patches applied, training decreases loss."""
+    from apex_tpu import amp
+
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(16, 32), torch.nn.ReLU(),
+                                torch.nn.Linear(32, 4))
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level="O1")
+    try:
+        X = torch.randn(64, 16)
+        Y = X @ torch.randn(16, 4)
+        losses = []
+        for _ in range(20):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X).float(), Y)
+            with amp.scale_loss(loss, opt) as scaled:
+                scaled.backward()
+            opt.step()
+            losses.append(loss.item())
+        assert losses[-1] < losses[0] * 0.7
+        # the patched mm really produced bf16 inside the model
+        assert torch.mm(torch.randn(2, 2),
+                        torch.randn(2, 2)).dtype == torch.bfloat16
+    finally:
+        if amp_mod.current_handle() is not None:
+            amp_mod.current_handle()._deactivate()

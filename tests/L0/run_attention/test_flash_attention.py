@@ -97,9 +97,56 @@ def test_bf16_forward_close():
 
 def test_non_tiling_shape_falls_back():
     q, k, v = _qkv(5, 1, 1, 100, 100, 64)
-    out = flash_attention(q, k, v)           # falls back to oracle
+    out = flash_attention(q, k, v)           # s < 128: single block
     ref = mha_reference(q, k, v)
     np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(1000, 1000), (700, 1000)])
+def test_non_tiling_long_shape_pads_to_kernel(causal, sq, sk, monkeypatch):
+    """s=1000-style shapes must take the PADDED KERNEL path, not the
+    O(s²) oracle (old silent fallback).  mha_reference is poisoned to
+    prove the kernel ran."""
+    import apex_tpu.ops.attention as attn_mod
+
+    q, k, v = _qkv(7, 1, 2, sq, sk, 64)
+    ref = mha_reference(q, k, v, causal=causal)
+
+    def _boom(*a, **kw):
+        raise AssertionError("oracle fallback taken for padded shape")
+
+    monkeypatch.setattr(attn_mod, "mha_reference", _boom)
+    out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_padded_shape_grads_match_oracle():
+    b, h, s, d = 1, 2, 384 + 128 + 64, 64   # 576: no 128-multiple divisor
+    q, k, v = _qkv(8, b, h, s, s, d)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gk, gr):
+        np.testing.assert_allclose(a, b_, atol=1e-3, rtol=1e-3)
+
+
+def test_padded_shape_with_mask_matches_oracle():
+    b, h, s, d = 2, 2, 700, 64               # 700 > 512, pads to 768
+    q, k, v = _qkv(9, b, h, s, s, d)
+    lengths = jnp.array([500, 700])
+    mask = jnp.broadcast_to(
+        (jnp.arange(s)[None, :] >= lengths[:, None])[:, None, None, :],
+        (b, 1, s, s))
+    out = flash_attention(q, k, v, mask=mask)
+    ref = mha_reference(q, k, v, mask=mask)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
 def test_sm_scale_respected():

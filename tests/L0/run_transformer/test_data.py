@@ -1,0 +1,71 @@
+"""broadcast_data semantics (reference:
+``tests/L0/run_transformer/test_data.py``): all TP ranks must see
+TP-rank-0's data even when each rank was fed different arrays."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data
+
+TP = 4
+
+
+@pytest.fixture
+def mesh():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=TP)
+    yield parallel_state.get_mesh()
+    parallel_state.destroy_model_parallel()
+
+
+def test_broadcast_data_all_ranks_see_rank0(mesh):
+    # per-rank distinct payloads, leading dim = tp rank
+    per_rank = {
+        "tokens": jnp.arange(TP * 6, dtype=jnp.int32).reshape(TP, 6),
+        "labels": 100 + jnp.arange(TP * 6, dtype=jnp.int32).reshape(TP, 6),
+    }
+
+    def body(data):
+        mine = jax.tree.map(lambda x: x[0], data)
+        out = broadcast_data(["tokens", "labels"], mine)
+        return jax.tree.map(lambda x: x[None], out)
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P("tensor"),), out_specs=P("tensor")))(per_rank)
+    # every rank's result equals rank 0's input
+    for k in ("tokens", "labels"):
+        for r in range(TP):
+            np.testing.assert_array_equal(out[k][r], per_rank[k][0])
+
+
+def test_broadcast_data_dtype_conversion(mesh):
+    per_rank = {"x": jnp.arange(TP * 4, dtype=jnp.int64.dtype if hasattr(
+        jnp.int64, "dtype") else jnp.int32).reshape(TP, 4)}
+
+    def body(data):
+        mine = jax.tree.map(lambda x: x[0], data)
+        out = broadcast_data(["x"], mine, datatype=jnp.int32)
+        return jax.tree.map(lambda x: x[None], out)
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P("tensor"),), out_specs=P("tensor")))(per_rank)
+    assert out["x"].dtype == jnp.int32
+
+
+def test_broadcast_data_tp1_identity():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=1)
+    try:
+        data = {"a": jnp.arange(5)}
+        out = broadcast_data(["a"], data)
+        np.testing.assert_array_equal(out["a"], data["a"])
+    finally:
+        parallel_state.destroy_model_parallel()

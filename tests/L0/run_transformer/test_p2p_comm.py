@@ -1,0 +1,113 @@
+"""p2p_communication semantics (reference:
+``tests/L0/run_transformer/test_p2p_comm.py``): every wrapper must move
+payloads exactly one stage forward/backward along the pipe ring."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+PP = 4
+
+
+@pytest.fixture
+def mesh():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(pipeline_model_parallel_size_=PP)
+    yield parallel_state.get_mesh()
+    parallel_state.destroy_model_parallel()
+
+
+def _run(mesh, fn, payload):
+    """Run fn over the pipe mesh; payload has leading stage dim."""
+    def body(x):
+        out = fn(jax.tree.map(lambda a: a[0], x))
+        return jax.tree.map(lambda a: a[None], out)
+    return jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("pipe"),), out_specs=P("pipe")))(
+        payload)
+
+
+def test_send_forward_recv_forward_rotates_up(mesh):
+    payload = jnp.arange(PP, dtype=jnp.float32)[:, None] * jnp.ones((1, 8))
+    out = _run(mesh, p2p.send_forward_recv_forward, payload)
+    # stage s now holds what stage s-1 sent (ring wrap: stage 0 holds PP-1)
+    expect = jnp.roll(jnp.arange(PP, dtype=jnp.float32), 1)
+    np.testing.assert_allclose(out[:, 0], expect)
+
+
+def test_send_backward_recv_backward_rotates_down(mesh):
+    payload = jnp.arange(PP, dtype=jnp.float32)[:, None] * jnp.ones((1, 8))
+    out = _run(mesh, p2p.send_backward_recv_backward, payload)
+    expect = jnp.roll(jnp.arange(PP, dtype=jnp.float32), -1)
+    np.testing.assert_allclose(out[:, 0], expect)
+
+
+def test_individual_halves_match_fused(mesh):
+    payload = jax.random.normal(jax.random.PRNGKey(0), (PP, 8))
+    fused = _run(mesh, p2p.send_forward_recv_forward, payload)
+    send = _run(mesh, p2p.send_forward, payload)
+    recv = _run(mesh, p2p.recv_forward, payload)
+    np.testing.assert_allclose(send, fused)
+    np.testing.assert_allclose(recv, fused)
+    fusedb = _run(mesh, p2p.send_backward_recv_backward, payload)
+    np.testing.assert_allclose(_run(mesh, p2p.send_backward, payload),
+                               fusedb)
+    np.testing.assert_allclose(_run(mesh, p2p.recv_backward, payload),
+                               fusedb)
+
+
+def test_steady_state_pair_moves_both_directions(mesh):
+    acts = jnp.arange(PP, dtype=jnp.float32)[:, None] * jnp.ones((1, 4))
+    grads = 100.0 + jnp.arange(PP, dtype=jnp.float32)[:, None] * \
+        jnp.ones((1, 4))
+
+    def body(a, g):
+        fa, bg = p2p.send_forward_recv_backward(a[0], g[0])
+        return fa[None], bg[None]
+
+    fa, bg = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("pipe"), P("pipe")),
+        out_specs=(P("pipe"), P("pipe"))))(acts, grads)
+    np.testing.assert_allclose(
+        fa[:, 0], jnp.roll(jnp.arange(PP, dtype=jnp.float32), 1))
+    np.testing.assert_allclose(
+        bg[:, 0], jnp.roll(100.0 + jnp.arange(PP, dtype=jnp.float32), -1))
+
+
+def test_pytree_payloads(mesh):
+    payload = {"x": jnp.arange(PP, dtype=jnp.float32)[:, None],
+               "y": (jnp.ones((PP, 2)) *
+                     jnp.arange(PP, dtype=jnp.float32)[:, None])}
+    out = _run(mesh, p2p.send_forward_recv_forward, payload)
+    np.testing.assert_allclose(
+        out["x"][:, 0], jnp.roll(jnp.arange(PP, dtype=jnp.float32), 1))
+    np.testing.assert_allclose(
+        out["y"][:, 0], jnp.roll(jnp.arange(PP, dtype=jnp.float32), 1))
+
+
+def test_roundtrip_is_identity(mesh):
+    payload = jax.random.normal(jax.random.PRNGKey(1), (PP, 8))
+
+    def body(x):
+        return p2p.send_backward(p2p.send_forward(x[0]))[None]
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("pipe"),), out_specs=P("pipe")))(
+        payload)
+    np.testing.assert_allclose(out, payload)
+
+
+def test_tensor_shape_kwargs_accepted(mesh):
+    """Parity: reference callers pass tensor_shape/dtype/timers kwargs."""
+    payload = jnp.ones((PP, 4))
+    out = _run(mesh, functools.partial(
+        p2p.send_forward_recv_forward, tensor_shape=(4,),
+        override_scatter_gather_tensors_in_pipeline=False,
+        dtype_=jnp.float32, timers=None), payload)
+    assert out.shape == (PP, 4)

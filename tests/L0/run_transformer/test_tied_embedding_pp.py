@@ -1,0 +1,130 @@
+"""Tied input/output embedding across pipeline stages (reference:
+``allreduce_word_embedding_grads`` over the first+last-stage embedding
+group).  The pipelined run with the masked-psum embedding reduction must
+match the non-pipelined tied-weights oracle exactly."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    embedding_grads_all_reduce,
+    forward_backward_pipelining_without_interleaving,
+)
+
+PP = 4
+VOCAB, HID = 16, 8
+MICRO_BS, N_MICRO, SEQ = 2, 4, 6
+
+
+def _make(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    embed = jax.random.normal(k1, (VOCAB, HID)) * 0.5
+    stage_w = jax.random.normal(k2, (PP, HID, HID)) / np.sqrt(HID)
+    tokens = jax.random.randint(k3, (N_MICRO, MICRO_BS, SEQ), 0, VOCAB)
+    labels = jnp.roll(tokens, -1, axis=-1)
+    return embed, stage_w, tokens, labels
+
+
+def _stage_body(w, x):
+    return x + jax.nn.gelu(x @ w)
+
+
+def _oracle(embed, stage_w, tokens, labels):
+    """Non-pipelined tied-embedding model: embed -> PP stages -> logits
+    with embed.T (the tied head)."""
+    def loss_fn(embed, stage_w):
+        total = 0.0
+        for m in range(N_MICRO):
+            x = embed[tokens[m]]                      # [bs, seq, hid]
+            for s in range(PP):
+                x = _stage_body(stage_w[s], x)
+            logits = x @ embed.T                      # tied head
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            total = total + -jnp.mean(
+                jnp.take_along_axis(logp, labels[m][..., None],
+                                    axis=-1))
+        return total / N_MICRO
+    return jax.value_and_grad(loss_fn, argnums=(0, 1))(embed, stage_w)
+
+
+@pytest.fixture
+def setup():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=PP)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_tied_embedding_grads_match_oracle(setup):
+    embed, stage_w, tokens, labels = _make(jax.random.PRNGKey(0))
+    mesh = parallel_state.get_mesh()
+    batch = {"tokens": tokens, "labels": labels}
+
+    def stage_fn(params, x, mb):
+        stage = jax.lax.axis_index("pipe")
+        # stage 0 consumes the embedding lookup instead of the carried x
+        emb = params["embed"][mb["tokens"]]
+        x = jnp.where(stage == 0, emb, x)
+        return _stage_body(params["w"], x)
+
+    def loss_fn(y, mb, params):
+        # tied head: logits through the SAME embedding matrix (3-arg loss
+        # contract — closures over params would get zero grads)
+        logits = y @ params["embed"].T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, mb["labels"][..., None], axis=-1))
+
+    # The tied embedding param must reach both stage 0 (lookup) and the
+    # last stage (head).  Every rank carries a replica; the masked psum
+    # reconciles the two stages' grad contributions.
+    def body(embed_rep, stage_w, batch):
+        params = {"embed": embed_rep[0], "w": stage_w[0]}
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, params, batch,
+            num_microbatches=N_MICRO,
+            input_fn=lambda mb: jnp.zeros(
+                (MICRO_BS, SEQ, HID), jnp.float32))
+        # reference: first+last stage allreduce of the embedding grad
+        grads["embed"] = embedding_grads_all_reduce(grads["embed"])
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    embed_rep = jnp.broadcast_to(embed, (PP,) + embed.shape)
+    loss, grads = jax.jit(functools.partial(
+        jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P("pipe"), P("pipe"), P()),
+        out_specs=(P(), P("pipe"))))(embed_rep, stage_w, batch)
+
+    ref_loss, (ref_gembed, ref_gw) = _oracle(embed, stage_w, tokens, labels)
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(grads["w"], ref_gw, rtol=1e-4, atol=1e-5)
+    # after the embedding-group reduction, stage 0's (and the last stage's)
+    # embedding grad equals the tied-weights total grad
+    np.testing.assert_allclose(grads["embed"][0], ref_gembed,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(grads["embed"][PP - 1], ref_gembed,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_grads_all_reduce_masks_middle_stages(setup):
+    """Only first+last stages contribute (reference embedding-group
+    membership)."""
+    mesh = parallel_state.get_mesh()
+    per_stage = jnp.arange(PP, dtype=jnp.float32)[:, None] * \
+        jnp.ones((1, 5))
+
+    def body(g):
+        return embedding_grads_all_reduce(g[0])[None]
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("pipe"),), out_specs=P("pipe")))(
+        per_stage)
+    # sum of stage 0 (=0) and stage PP-1 (=PP-1) only
+    np.testing.assert_allclose(out, jnp.full((PP, 5), float(PP - 1)))
