@@ -11,11 +11,13 @@ registration API).
 """
 from __future__ import annotations
 
+import functools
 import importlib
 from typing import Optional
 
 from apex_tpu.amp.wrap import (
     make_cast_wrapper,
+    make_inplace_promote_wrapper,
     make_promote_wrapper,
     make_sequence_promote_wrapper,
 )
@@ -74,7 +76,30 @@ class AmpHandle:
             _current_handle = None
 
     def wrap_optimizer(self, optimizer, num_loss: int = 1):
-        # parity shim: the torch shim patches optimizers directly
+        """Patch ``optimizer.step`` to clear the per-iteration weight-cast
+        cache after every update (reference: ``OptimWrapper``).  Without
+        this, the old-style API (``amp.init()`` + ``wrap_optimizer`` +
+        ``scale_loss``, no ``amp.initialize``) keeps serving stale bf16
+        weight copies after in-place parameter updates — ``cached_cast``'s
+        identity check passes because the parameter object is mutated in
+        place, so training silently freezes."""
+        if getattr(optimizer, "_amp_cache_patched", False):
+            return optimizer
+        orig_step = optimizer.step
+
+        @functools.wraps(orig_step)
+        def step(*args, **kwargs):
+            out = orig_step(*args, **kwargs)
+            # resolve the LIVE handle at call time (same pattern as
+            # _torch_shim): after a re-init, self may be a dead handle
+            # while a new one owns the active cache.
+            live = current_handle()
+            if live is not None:
+                live._clear_cache()
+            return out
+
+        optimizer.step = step
+        optimizer._amp_cache_patched = True
         return optimizer
 
     def __enter__(self):
@@ -96,6 +121,10 @@ def _apply_lists(handle: AmpHandle, obj, lists_mod) -> None:
     for name in getattr(lists_mod, "CASTS", []):
         if hasattr(obj, name):
             handle._patch(obj, name, make_promote_wrapper(
+                getattr(obj, name), _is_active))
+    for name in getattr(lists_mod, "INPLACE_CASTS", []):
+        if hasattr(obj, name):
+            handle._patch(obj, name, make_inplace_promote_wrapper(
                 getattr(obj, name), _is_active))
     for name in getattr(lists_mod, "SEQUENCE_CASTS", []):
         if hasattr(obj, name):

@@ -18,12 +18,18 @@ FP32_FUNCS = [
 ]
 
 CASTS = [
-    "__add__", "__div__", "__eq__", "__ge__", "__gt__", "__iadd__",
-    "__idiv__", "__imul__", "__isub__", "__itruediv__", "__le__",
+    "__add__", "__div__", "__eq__", "__ge__", "__gt__", "__le__",
     "__lt__", "__mul__", "__ne__", "__radd__", "__rdiv__", "__rmul__",
     "__rsub__", "__rtruediv__", "__sub__", "__truediv__",
     "add", "addcdiv", "addcmul", "atan2", "div", "dot", "fmod", "mul",
     "sub",
+]
+
+# In-place methods mutate arg0's storage: the other args are cast to
+# arg0's dtype (promote_match_arg0), never arg0 itself — a widest-dtype
+# promote would rebind instead of mutate and break parameter aliasing.
+INPLACE_CASTS = [
+    "__iadd__", "__idiv__", "__imul__", "__isub__", "__itruediv__",
 ]
 
 SEQUENCE_CASTS = []

@@ -14,13 +14,20 @@ from typing import Optional
 
 __all__ = [
     "cached_cast", "make_cast_wrapper", "make_promote_wrapper",
-    "make_sequence_promote_wrapper",
+    "make_sequence_promote_wrapper", "make_inplace_promote_wrapper",
 ]
 
 
 def _torch():
     import torch
     return torch
+
+
+def _is_arraylike(x) -> bool:
+    """Cheap pre-filter so plain ints/floats/strings passed to patched
+    ops never reach the jax branch (the torch-only O1 path must not
+    hard-require jax at call time)."""
+    return hasattr(x, "dtype") and hasattr(x, "ndim")
 
 
 def _is_fp_tensor(x) -> bool:
@@ -30,9 +37,13 @@ def _is_fp_tensor(x) -> bool:
             return x.is_floating_point()
     except ImportError:  # pragma: no cover
         pass
-    import jax.numpy as jnp
-    return hasattr(x, "dtype") and hasattr(x, "ndim") and \
-        jnp.issubdtype(getattr(x, "dtype", None), jnp.floating)
+    if not _is_arraylike(x):
+        return False
+    try:
+        import jax.numpy as jnp
+    except ImportError:  # pragma: no cover
+        return False
+    return jnp.issubdtype(getattr(x, "dtype", None), jnp.floating)
 
 
 def _to_dtype(x, want_half: bool):
@@ -43,7 +54,12 @@ def _to_dtype(x, want_half: bool):
             return x.to(torch.bfloat16 if want_half else torch.float32)
     except ImportError:  # pragma: no cover
         pass
-    import jax.numpy as jnp
+    if not _is_arraylike(x):
+        return x
+    try:
+        import jax.numpy as jnp
+    except ImportError:  # pragma: no cover
+        return x
     return x.astype(jnp.bfloat16 if want_half else jnp.float32)
 
 
@@ -54,8 +70,24 @@ def _is_half(x) -> bool:
             return x.dtype in (torch.bfloat16, torch.float16)
     except ImportError:  # pragma: no cover
         pass
-    import jax.numpy as jnp
+    if not _is_arraylike(x):
+        return False
+    try:
+        import jax.numpy as jnp
+    except ImportError:  # pragma: no cover
+        return False
     return x.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def _cast_like(x, ref):
+    """Cast ``x`` to ``ref``'s exact dtype (torch or jax)."""
+    try:
+        torch = _torch()
+        if isinstance(x, torch.Tensor):
+            return x.to(ref.dtype)
+    except ImportError:  # pragma: no cover
+        pass
+    return x.astype(ref.dtype)
 
 
 def cached_cast(x, want_half: bool, cache: Optional[dict]):
@@ -131,6 +163,31 @@ def make_promote_wrapper(orig, is_active):
         args = _map_structure(list(args), cast)
         kwargs = _map_structure(kwargs, cast)
         return orig(*args, **kwargs)
+
+    wrapper._amp_original = orig
+    return wrapper
+
+
+def make_inplace_promote_wrapper(orig, is_active):
+    """Wrap an in-place tensor method (``__iadd__`` etc.).
+
+    In-place ops mutate arg0's storage, so arg0's dtype wins: the OTHER
+    floating args are cast to self's dtype and self is left untouched
+    (reference: ``apex/amp/wrap.py :: promote_match_arg0`` semantics for
+    in-place methods).  Promoting self instead would allocate a NEW
+    tensor — ``x += y`` would rebind ``x`` and every other alias of the
+    original storage (e.g. a module parameter) would silently stop
+    seeing updates."""
+
+    @functools.wraps(orig)
+    def wrapper(self_, *args, **kwargs):
+        if not is_active() or not _is_fp_tensor(self_):
+            return orig(self_, *args, **kwargs)
+        cast = lambda x: (_cast_like(x, self_)  # noqa: E731
+                          if _is_fp_tensor(x) else x)
+        args = _map_structure(list(args), cast)
+        kwargs = _map_structure(kwargs, cast)
+        return orig(self_, *args, **kwargs)
 
     wrapper._amp_original = orig
     return wrapper

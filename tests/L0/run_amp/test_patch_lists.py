@@ -131,6 +131,57 @@ def test_activations_not_cached(handle):
     assert len(handle.cache) == 0
 
 
+# ---- in-place promote semantics (promote_match_arg0) ------------------------
+
+def test_inplace_add_keeps_self_dtype_and_storage(handle):
+    """``x += full`` on a bf16 tensor must mutate x's storage in place
+    (other args cast to self's dtype), never promote-and-rebind: a
+    widest-dtype promote would hand ``+=`` a NEW fp32 tensor and every
+    other alias of x would silently stop seeing updates."""
+    x = torch.zeros(8, dtype=torch.bfloat16)
+    alias = x
+    full = torch.ones(8)                   # fp32 operand
+    x += full
+    assert x.dtype == torch.bfloat16       # self dtype wins (arg0 match)
+    assert x is alias                      # same object, mutated in place
+    assert torch.all(alias == 1.0)         # alias sees the update
+
+
+def test_inplace_on_fp32_casts_half_operand(handle):
+    a = torch.zeros(8)                     # fp32 self
+    a += torch.ones(8, dtype=torch.bfloat16)
+    assert a.dtype == torch.float32
+    assert torch.all(a == 1.0)
+
+
+def test_inplace_mul_scalar_passthrough(handle):
+    # plain python scalars must not trip the cast machinery (and must not
+    # require jax on the torch-only path)
+    x = torch.full((4,), 2.0, dtype=torch.bfloat16)
+    x *= 3
+    assert x.dtype == torch.bfloat16
+    assert torch.all(x == 6.0)
+
+
+def test_wrap_optimizer_clears_cache(handle):
+    """Old-style API (init + wrap_optimizer + scale_loss): step() must
+    clear the weight-cast cache or forwards keep stale bf16 copies of
+    in-place-updated parameters and training silently freezes."""
+    w = torch.nn.Parameter(torch.randn(4, 4))
+    opt = handle.wrap_optimizer(torch.optim.SGD([w], lr=0.5))
+    x = torch.randn(4, 4)
+    y1 = torch.mm(w, x)
+    assert len(handle.cache) == 1
+    cast_before = handle.cache[id(w)][1]
+    y1.float().sum().backward()
+    opt.step()
+    assert len(handle.cache) == 0          # cache cleared by step()
+    y2 = torch.mm(w, x)                    # re-cast sees updated weights
+    cast_after = handle.cache[id(w)][1]
+    assert cast_after is not cast_before
+    assert not torch.equal(cast_after, cast_before)
+
+
 # ---- user decorators / registration (torch + jax) --------------------------
 
 def test_half_function_decorator_torch(handle):
