@@ -5,6 +5,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
     embedding_grads_all_reduce,
+    interleaved_phase_ticks,
 )
 from apex_tpu.transformer.pipeline_parallel import p2p_communication
 from apex_tpu.transformer.pipeline_parallel.utils import (
@@ -22,6 +23,7 @@ __all__ = [
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
     "embedding_grads_all_reduce",
+    "interleaved_phase_ticks",
     "p2p_communication",
     "setup_microbatch_calculator",
     "get_num_microbatches",

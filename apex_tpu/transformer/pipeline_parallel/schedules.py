@@ -47,6 +47,7 @@ __all__ = [
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
     "embedding_grads_all_reduce",
+    "interleaved_phase_ticks",
 ]
 
 
@@ -220,6 +221,64 @@ def _residual_layout(stage_fn, loss_fn, input_fn, params, batch):
     return inv_map, buf_shapes, x0
 
 
+def _check_consts(consts, inv_map, buf_shapes, p_leaves, *, where_tag):
+    """Trace-time consistency check between the probe's residual layout and
+    a scan-body trace (the positional-substitution contract).
+
+    ``closure_convert`` gives no ordering guarantee across separate traces;
+    the probe's ``inv_map``/``buf_shapes`` are applied positionally, so two
+    same-COUNT but reordered residual lists would silently corrupt
+    gradients.  Checking per-position shape+dtype (params positions against
+    the matched param leaf, buffered positions against the recorded buffer
+    layout) turns any reorder of non-identical residuals into a loud
+    trace-time error.
+    """
+    assert len(consts) == len(inv_map), (
+        f"vjp residual structure diverged between probe and {where_tag} "
+        f"({len(consts)} vs {len(inv_map)})")
+    bi = 0
+    for pos, (c, j) in enumerate(zip(consts, inv_map)):
+        if j >= 0:
+            want = p_leaves[j]
+        else:
+            want = buf_shapes[bi]
+            bi += 1
+        w_shape = want.shape if hasattr(want, "shape") else want[0]
+        w_dtype = want.dtype if hasattr(want, "dtype") else want[1]
+        assert c.shape == w_shape and c.dtype == w_dtype, (
+            f"vjp residual {pos} diverged between probe and {where_tag}: "
+            f"got {c.shape}/{c.dtype}, probe recorded {w_shape}/{w_dtype}")
+
+
+def _rebuild_vjp(stage_fn, mb_b, p_b, x_b, inv_map, buf_shapes, buf, slot,
+                 *, where_tag):
+    """Rebuild a buffered microbatch's backward from the circular buffer.
+
+    Re-traces the stage vjp from microbatch b's own ``(x, mb)`` for its
+    STRUCTURE: ``closure_convert`` hoists only inexact-dtype residuals —
+    integer/bool residuals (gather indices, masks) stay baked in the
+    converted function, so they MUST derive from the microbatch being
+    differentiated.  Hoisted float residuals are then substituted
+    positionally: param-identity residuals (``inv_map[j] >= 0``) from the
+    live params, the rest from buffer slot ``slot`` — so the rebuilt
+    forward's float compute is dead code XLA eliminates.  Returns
+    ``(vjp_fn, consts)`` ready to apply to the output cotangent.
+    """
+    pb_leaves = jax.tree.leaves(p_b)
+    y_b, vjp_b = jax.vjp(lambda p, xx: stage_fn(p, xx, mb_b), p_b, x_b)
+    vjp_fn_b, consts_probe = jax.closure_convert(vjp_b, y_b)
+    _check_consts(consts_probe, inv_map, buf_shapes, pb_leaves,
+                  where_tag=where_tag)
+    consts_b, bi = [], 0
+    for j in inv_map:
+        if j >= 0:
+            consts_b.append(pb_leaves[j])
+        else:
+            consts_b.append(buf[bi][slot])
+            bi += 1
+    return vjp_fn_b, consts_b
+
+
 def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
                          num_microbatches: int, axis_name: str):
     """True-1F1B pipelined forward+backward with bounded live activations
@@ -272,9 +331,8 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
             input_fn(mb), fwd_msg)
         y, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, mb), params, x)
         _, consts = jax.closure_convert(vjp, y)
-        assert len(consts) == len(inv_map), (
-            "vjp residual structure diverged between probe and scan body "
-            f"({len(consts)} vs {len(inv_map)})")
+        _check_consts(consts, inv_map, buf_shapes, p_leaves,
+                      where_tag="scan body")
 
         # loss + its input cotangent (meaningful on the last stage only;
         # other stages compute it masked — lockstep SPMD).  A 3-arg
@@ -307,26 +365,11 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
         # slot (t + 1 + 2*stage) % depth; on the last stage this IS the
         # slot written above (gap 0), already holding this tick's consts.
         slot_r = (t + 1 + 2 * stage) % depth
-        # Rebuild the vjp STRUCTURE from microbatch b's own (x, mb):
-        # closure_convert hoists only inexact-dtype residuals — integer /
-        # bool residuals (gather indices, masks) stay baked in the
-        # converted function, so they MUST be derived from the microbatch
-        # being differentiated, not from this tick's forward.  Hoisted
-        # float residuals are substituted from the circular buffer, so the
-        # rebuilt forward's float compute is dead code XLA eliminates —
-        # only int/bool-residual-producing prefixes (if any) recompute.
         mb_b = _microbatch(batch, jnp.clip(b_pos, 0, n - 1))
         x_b = jax.tree.map(lambda b: b[slot_r], xbuf)
-        y_b, vjp_b = jax.vjp(
-            lambda p, xx: stage_fn(p, xx, mb_b), params, x_b)
-        vjp_fn_b, _ = jax.closure_convert(vjp_b, y_b)
-        consts_b, bi = [], 0
-        for j in inv_map:
-            if j >= 0:
-                consts_b.append(p_leaves[j])
-            else:
-                consts_b.append(buf[bi][slot_r])
-                bi += 1
+        vjp_fn_b, consts_b = _rebuild_vjp(
+            stage_fn, mb_b, params, x_b, inv_map, buf_shapes, buf, slot_r,
+            where_tag="1f1b bwd")
         dy = jax.tree.map(
             lambda dl, msg: jnp.where(last, dl, msg), dy_local, bwd_msg)
         dparams, dx = vjp_fn_b(dy, *consts_b)
@@ -387,104 +430,232 @@ def forward_backward_pipelining_without_interleaving(
     return jax.lax.psum(loss, axis_name), grads
 
 
+def interleaved_phase_ticks(num_microbatches: int, pp: int, v: int):
+    """Static phase boundaries of the interleaved schedule, in chunk-ticks:
+    ``(warmup, steady, cooldown)`` where warmup ticks run forward-only,
+    steady ticks run one chunk-forward AND one chunk-backward (true 1F1B),
+    and cooldown ticks run backward-only.
+
+    Each chunk-tick costs ``1/v`` of a full-stage tick (a chunk is ``1/v``
+    of the rank's layers), so total time in full-stage fwd+bwd units is
+    ``(warmup + cooldown)/(2v) + steady/v  =  n + (pp-1)/v`` — the
+    reference's interleaved bubble ``(pp-1)/v`` (vs ``pp-1`` without
+    interleaving).  Exposed so tests can assert the bubble SHRINKS with
+    ``v``.
+    """
+    n = num_microbatches
+    t0 = v * pp                    # first backward anywhere
+    f_end = n * v + pp - 1         # forward window end (exclusive)
+    total = t0 + pp - 1 + n * v    # last backward tick + 1
+    return t0, f_end - t0, total - f_end
+
+
+def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
+                                *, num_microbatches: int, v: int,
+                                axis_name: str, forward_only: bool = False):
+    """True interleaved 1F1B over ``v`` virtual chunks per rank (reference:
+    ``fwd_bwd_pipelining_with_interleaving.py``'s schedule: microbatches in
+    groups of ``pp``, each rank cycling chunk 0..v-1 within a group).
+
+    Virtual stage ``vs = c*pp + r`` hosts chunk ``c`` on rank ``r``; rank
+    ``r``'s forward execution sequence index ``i`` decodes as
+    ``g = i // (pp*v); c = (i % (pp*v)) // pp; m = g*pp + i % pp`` and runs
+    at tick ``t = r + i``.  Every producer→consumer edge is then a ring +1
+    rotation consumed exactly one tick after it is sent (the chunk hand-off
+    rank ``pp-1 → 0`` rides the same rotation's wrap-around), so NO message
+    queuing is needed.  Backwards mirror with ring −1 rotations at tick
+    ``t = v*pp + (pp-1-r) + ib`` with the chunk order reversed.  The loss
+    cotangent on the last virtual stage is produced by the forward exactly
+    one tick before its backward consumes it — a single carried ``prev_dy``
+    buffer.
+
+    The schedule splits into three statically-bounded scans — forward-only
+    warmup, true-1F1B steady state, backward-only cooldown (see
+    ``interleaved_phase_ticks``) — giving the reference's ``(pp-1)/v``
+    bubble; a single fused fwd+bwd scan would pay masked backward compute
+    through the whole ``v*pp``-tick warmup and erase the interleaving win.
+
+    Forward activation residuals live in a circular buffer of
+    ``D = 2*v*pp`` chunk-slots (max forward→backward gap is ``D-1`` ticks,
+    min is 1): total live residual memory is ``~2*pp`` full-stage
+    equivalents, the same bounded O(pp) profile as plain 1F1B, independent
+    of ``num_microbatches``.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    n = num_microbatches
+    if n % n_stages != 0:
+        raise ValueError(
+            "interleaved pipelining requires num_microbatches to be a "
+            f"multiple of the pipeline size (got {n} % {n_stages}); the "
+            "reference asserts the same")
+    group = n_stages * v
+    t0, steady, cooldown = interleaved_phase_ticks(n, n_stages, v)
+    f_end = t0 + steady
+    total = f_end + cooldown
+    depth = 2 * v * n_stages
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    lf, loss_has_params = _normalize_loss_fn(loss_fn)
+
+    chunk0 = jax.tree.map(lambda x: x[0], params)
+    inv_map, buf_shapes, x0 = _residual_layout(
+        stage_fn, loss_fn, input_fn, chunk0, batch)
+
+    def fwd_half(carry, t):
+        """One chunk-forward: stash residuals, compute (masked) loss vjp."""
+        buf, xbuf, fwd_msg, bwd_msg, prev_dy, grad_acc, loss_acc = carry
+        i = jnp.clip(t - stage, 0, n * v - 1)
+        f_valid = (t - stage >= 0) & (t - stage < n * v)
+        g_idx, j = i // group, i % group
+        c_f = j // n_stages
+        m_f = g_idx * n_stages + (j % n_stages)
+        mb = _microbatch(batch, m_f)
+        p_f = jax.tree.map(lambda x: x[c_f], params)
+        inject = (stage == 0) & (c_f == 0)
+        x = jax.tree.map(
+            lambda inj, msg: jnp.where(inject, inj, msg),
+            input_fn(mb), fwd_msg)
+        y, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, mb), p_f, x)
+        _, consts = jax.closure_convert(vjp, y)
+        _check_consts(consts, inv_map, buf_shapes,
+                      jax.tree.leaves(p_f), where_tag="interleaved fwd")
+
+        # loss + dy on the LAST virtual stage (chunk v-1, last rank); its
+        # backward consumes prev_dy exactly one tick later
+        if loss_has_params:
+            loss, lvjp = jax.vjp(lambda p_, yy: lf(yy, mb, p_), p_f, y)
+            dp_loss, dy_local = lvjp(jnp.asarray(1.0 / n, loss.dtype))
+        else:
+            loss, lvjp = jax.vjp(lambda yy: lf(yy, mb, None), y)
+            (dy_local,) = lvjp(jnp.asarray(1.0 / n, loss.dtype))
+            dp_loss = None
+        lvalid = f_valid & (stage == n_stages - 1) & (c_f == v - 1)
+        loss_acc = loss_acc + jnp.where(lvalid, loss, 0.0)
+        if dp_loss is not None:
+            grad_acc = jax.tree.map(
+                lambda a, d: a.at[c_f].add(
+                    jnp.where(lvalid, d, jnp.zeros_like(d))),
+                grad_acc, dp_loss)
+
+        # slot t % depth's previous tenant (tick t-depth) was consumed at
+        # most at tick t-1 (max gap depth-1), so unconditional writes are
+        # safe even on masked bubble ticks
+        buffered = [c for c, jj in zip(consts, inv_map) if jj < 0]
+        buf = [b.at[t % depth].set(c) for b, c in zip(buf, buffered)]
+        xbuf = jax.tree.map(lambda b, c: b.at[t % depth].set(c), xbuf, x)
+        fwd_msg = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, fwd_perm), y)
+        return (buf, xbuf, fwd_msg, bwd_msg, dy_local, grad_acc, loss_acc)
+
+    def bwd_half(carry, t, prev_dy):
+        """One chunk-backward from buffered residuals (params substituted
+        by identity, so only activation residuals are buffered).
+
+        ``prev_dy`` is the loss cotangent produced by the PREVIOUS tick's
+        forward (the last virtual stage's backward runs exactly one tick
+        after its forward), passed explicitly because this tick's
+        ``fwd_half`` has already overwritten the carry slot.
+        """
+        buf, xbuf, fwd_msg, bwd_msg, _, grad_acc, loss_acc = carry
+        ib_raw = t - t0 - (n_stages - 1 - stage)
+        b_valid = (ib_raw >= 0) & (ib_raw < n * v)
+        ib = jnp.clip(ib_raw, 0, n * v - 1)
+        g_b, j_b = ib // group, ib % group
+        c_b = v - 1 - j_b // n_stages
+        k_b = j_b % n_stages
+        m_b = g_b * n_stages + k_b
+        # this (c_b, m_b)'s forward ran on this rank at sequence index
+        # i_f = g*pp*v + c_b*pp + k, tick stage + i_f → its buffer slot
+        i_f = g_b * group + c_b * n_stages + k_b
+        slot = (stage + i_f) % depth
+        mb_b = _microbatch(batch, m_b)
+        p_b = jax.tree.map(lambda x: x[c_b], params)
+        x_b = jax.tree.map(lambda b: b[slot], xbuf)
+        vjp_fn_b, consts_b = _rebuild_vjp(
+            stage_fn, mb_b, p_b, x_b, inv_map, buf_shapes, buf, slot,
+            where_tag="interleaved bwd")
+        use_prev = (stage == n_stages - 1) & (c_b == v - 1)
+        dy = jax.tree.map(
+            lambda dl, msg: jnp.where(use_prev, dl, msg),
+            prev_dy, bwd_msg)
+        dparams, dx = vjp_fn_b(dy, *consts_b)
+        grad_acc = jax.tree.map(
+            lambda a, d: a.at[c_b].add(
+                jnp.where(b_valid, d, jnp.zeros_like(d))),
+            grad_acc, dparams)
+        bwd_msg = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, bwd_perm), dx)
+        return (buf, xbuf, fwd_msg, bwd_msg, carry[4], grad_acc, loss_acc)
+
+    def phase(carry, lo, hi, *, do_fwd, do_bwd):
+        if hi <= lo:
+            return carry
+
+        def tick(carry, t):
+            prev_dy_in = carry[4]  # last tick's loss cotangent
+            if do_fwd:
+                carry = fwd_half(carry, t)
+            if do_bwd:
+                carry = bwd_half(carry, t, prev_dy_in)
+            return carry, None
+
+        carry, _ = jax.lax.scan(tick, carry, jnp.arange(lo, hi))
+        return carry
+
+    buf0 = [jnp.zeros((depth,) + shape, dtype)
+            for shape, dtype in buf_shapes]
+    xbuf0 = jax.tree.map(
+        lambda a: jnp.zeros((depth,) + a.shape, a.dtype), x0)
+    msg0 = jax.tree.map(jnp.zeros_like, x0)
+    carry = (buf0, xbuf0, msg0, msg0,
+             jax.tree.map(jnp.zeros_like, x0),
+             jax.tree.map(jnp.zeros_like, params),
+             jnp.zeros((), jnp.float32))
+
+    if forward_only:
+        carry = phase(carry, 0, f_end, do_fwd=True, do_bwd=False)
+        return carry[-1] / n, None
+    carry = phase(carry, 0, t0, do_fwd=True, do_bwd=False)
+    carry = phase(carry, t0, f_end, do_fwd=True, do_bwd=True)
+    carry = phase(carry, f_end, total, do_fwd=False, do_bwd=True)
+    _, _, _, _, _, grads, loss_acc = carry
+    return loss_acc / n, grads
+
+
 def forward_backward_pipelining_with_interleaving(
         stage_fn: Callable, loss_fn: Callable, params, batch, *,
         num_microbatches: int, input_fn: Callable = None,
         forward_only: bool = False, axis_name: str = PIPE_AXIS,
         virtual_pipeline_model_parallel_size: Optional[int] = None,
         **_parity_kwargs):
-    """Virtual-pipeline executor (reference:
+    """Virtual-pipeline interleaved-1F1B executor (reference:
     ``fwd_bwd_pipelining_with_interleaving.py``): the model is split into
-    ``v`` chunks per rank; hiddens make ``v`` laps around the ring (the
-    ring wrap-around last->first IS the chunk hand-off).
+    ``v`` chunks per rank; chunk ``c`` on rank ``r`` is virtual stage
+    ``c * pp + r``, and the steady state interleaves chunks so the bubble
+    shrinks to ``(pp-1)/v`` of a stage tick (vs ``pp-1`` without
+    interleaving — see ``interleaved_phase_ticks``).
 
-    Params leaves carry a local leading chunk dim ``[v, ...]``; chunk ``c``
-    on rank ``r`` is virtual stage ``c * pp + r``.  Current implementation
-    runs the laps sequentially (bubble ``v*(pp-1)`` ticks, vs. the
-    reference's interleaved ``(pp-1)/v``-style bubble); the lap structure
-    and APIs match, the steady-state interleave is a planned optimization
-    (tracked in ``bench.py`` MFU numbers).
+    Params leaves carry a local leading chunk dim ``[v, ...]``.  Requires
+    ``num_microbatches % pp == 0`` (same constraint as the reference).
     """
     input_fn = input_fn or (lambda mb: mb)
     v = virtual_pipeline_model_parallel_size
     if v is None:
         v = (parallel_state.get_virtual_pipeline_model_parallel_world_size()
              or jax.tree.leaves(params)[0].shape[0])
-
-    lf, _ = _normalize_loss_fn(loss_fn)
-
-    def local(params, batch):
-        # laps 1..v-1 consume the previous lap's last-stage output stream as
-        # stage-0 input while loss_fn still sees the ORIGINAL microbatches
-        def lap_stage_fn(p, x, mb):
-            return stage_fn(p, x, mb["orig"])
-
-        def lap_input_fn(mb):
-            return mb["hidden"]
-
-        def lap_loss_fn(y, mb, p):
-            return lf(y, mb["orig"], p)
-
+    if v == 1:
+        # degenerate: plain pipeline over the single chunk
         chunk0 = jax.tree.map(lambda x: x[0], params)
-        if v == 1:
-            return _pipeline_local_loss(
-                stage_fn, loss_fn, input_fn, chunk0, batch,
-                num_microbatches=num_microbatches, axis_name=axis_name)
-        stream = _collect_lap_outputs(
-            stage_fn, input_fn, chunk0, batch,
-            num_microbatches=num_microbatches, axis_name=axis_name)
-        for chunk in range(1, v - 1):
-            chunk_params = jax.tree.map(lambda x, c=chunk: x[c], params)
-            stream = _collect_lap_outputs(
-                lap_stage_fn, lap_input_fn, chunk_params,
-                {"hidden": stream, "orig": batch},
-                num_microbatches=num_microbatches, axis_name=axis_name)
-        chunk_last = jax.tree.map(lambda x: x[v - 1], params)
-        return _pipeline_local_loss(
-            lap_stage_fn, lap_loss_fn, lap_input_fn, chunk_last,
-            {"hidden": stream, "orig": batch},
-            num_microbatches=num_microbatches, axis_name=axis_name)
-
-    if forward_only:
-        loss = local(params, batch)
-        return jax.lax.psum(loss, axis_name), None
-    loss, grads = jax.value_and_grad(local)(params, batch)
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, loss_fn, chunk0, batch,
+            num_microbatches=num_microbatches, input_fn=input_fn,
+            forward_only=forward_only, axis_name=axis_name)
+        if grads is not None:
+            grads = jax.tree.map(lambda g: g[None], grads)
+        return loss, grads
+    loss, grads = _pipeline_interleaved_local(
+        stage_fn, loss_fn, input_fn, params, batch,
+        num_microbatches=num_microbatches, v=v, axis_name=axis_name,
+        forward_only=forward_only)
     return jax.lax.psum(loss, axis_name), grads
-
-
-def _collect_lap_outputs(stage_fn, input_fn, params, batch, *,
-                         num_microbatches: int, axis_name: str):
-    """Run one full pipeline lap, returning the stream of last-stage
-    outputs rotated to stage 0 (stacked per microbatch) so the next chunk
-    lap can consume them as inputs."""
-    n_stages = jax.lax.axis_size(axis_name)
-    stage = jax.lax.axis_index(axis_name)
-    n_ticks = num_microbatches + n_stages - 1
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-
-    mb0 = _microbatch(batch, 0)
-    hidden0 = input_fn(mb0)
-    state0 = jax.tree.map(jnp.zeros_like, hidden0)
-
-    def tick(carry, t):
-        state = carry
-        # stage s holds microbatch t-s at tick t (see _pipeline_local_loss)
-        mb_idx = jnp.clip(t - stage, 0, num_microbatches - 1)
-        mb_in = _microbatch(batch, mb_idx)
-        x = jax.tree.map(
-            lambda inj, s: jnp.where(stage == 0, inj, s),
-            input_fn(mb_in), state)
-        y = stage_fn(params, x, mb_in)
-        state = jax.tree.map(
-            lambda a: jax.lax.ppermute(a, axis_name, perm), y)
-        # after the rotation, stage 0 holds what the last stage produced at
-        # tick t; that is microbatch t - n_stages + 1's lap output
-        return state, state
-
-    _, stream = jax.lax.scan(tick, state0, jnp.arange(n_ticks))
-    # lap output for microbatch m lands on stage 0 after tick m+n_stages-1,
-    # i.e. stream[m + n_stages - 1]; slice those out
-    out = jax.tree.map(lambda s: s[n_stages - 1:, ...], stream)
-    # only stage 0's copy is meaningful next lap (input_fn of the next lap
-    # reads it there); other stages' entries rotate in as the lap runs
-    return out

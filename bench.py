@@ -26,11 +26,18 @@ Backend-init resilience (round-2 failure mode): a wedged axon tunnel can
 hang or kill the process inside the *first* ``jax.default_backend()``
 call, before any retry wrapper exists.  ``main()`` therefore never
 initializes a backend in-process; it probes the backend in a disposable
-subprocess with a short timeout, runs the measurement itself in a
-subprocess (``--inner tpu`` / ``--inner cpu``), and on persistent TPU
-unavailability still prints the JSON line — CPU-scale numbers marked
-``"backend": "cpu"`` plus an ``"error"`` field — so the driver always
-records a parseable artifact.
+subprocess with a short timeout, runs the measurement in subprocesses,
+and on persistent TPU unavailability still prints the JSON line —
+CPU-scale numbers marked ``"backend": "cpu"`` plus an ``"error"`` field —
+so the driver always records a parseable artifact.
+
+Per-leg isolation (round-3 failure mode): the tunnel can wedge MID-run —
+the round-3 chip answered ``jax.devices()`` in seconds, then hung
+minutes into measurement, losing every leg queued behind the hang in the
+single 2400 s inner subprocess.  Each leg (``main``, ``adam``, ``ln``,
+``attn``, ``xent``) therefore runs in its OWN subprocess with its own
+timeout (``--inner MODE --leg NAME``); the orchestrator merges whatever
+landed, so a wedge costs one leg, not the capture.
 
 Timing notes: the axon TPU tunnel has ~60-70 ms dispatch RTT and its
 ``block_until_ready`` does not synchronize, so each measurement runs
@@ -241,6 +248,51 @@ def _microbench_attention(rtt: float, on_tpu: bool):
             "flash_attn_shape": [b, h, s, d]}
 
 
+def _microbench_xentropy(rtt: float, on_tpu: bool):
+    """Fused softmax-CE fwd+bwd achieved GB/s (backs the measured rationale
+    in ``ops/xentropy.py``: XLA's fused logsumexp path streams at HBM rate;
+    bytes = read logits fwd + read logits bwd + write dlogits = 3x)."""
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+    tokens, vocab = (8192, 51200) if on_tpu else (128, 512)
+    logits = jax.random.normal(jax.random.PRNGKey(0), (tokens, vocab),
+                               jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (tokens,), 0, vocab)
+    iters = 20 if on_tpu else 3
+
+    def fwd_bwd(logits, labels):
+        def f(lg):
+            return jnp.sum(softmax_cross_entropy_loss(lg, labels))
+        return jax.grad(f)(logits)
+
+    t = _bench_fn(fwd_bwd, (logits, labels), iters, rtt)
+    nbytes = logits.size * logits.dtype.itemsize
+    achieved = 3 * nbytes / t / 1e9
+    _, hbm = _chip_spec()
+    return {"xentropy_gbps": round(achieved, 1),
+            "xentropy_roofline": round(achieved / hbm, 3),
+            "xentropy_shape": [tokens, vocab]}
+
+
+def _bench_setup(force_cpu: bool):
+    """Backend selection + rtt measurement shared by every leg."""
+    if force_cpu:
+        # Flip BEFORE any device query (env vars alone are ignored — the
+        # axon plugin force-registers itself).
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    rtt = _retry(_rtt, tag="rtt") if on_tpu else 0.0
+    return on_tpu, rtt
+
+
+MICRO_LEGS = {
+    "adam": _microbench_adam,
+    "ln": _microbench_layernorm,
+    "attn": _microbench_attention,
+    "xent": _microbench_xentropy,
+}
+
+
 def _bench_main(force_cpu: bool = False) -> None:
     from apex_tpu.ops.attention import mha_reference
     from apex_tpu.ops.layer_norm import layer_norm_reference
@@ -248,11 +300,7 @@ def _bench_main(force_cpu: bool = False) -> None:
     from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
     import apex_tpu.normalization as norm_mod
 
-    if force_cpu:
-        # Flip BEFORE any device query (env vars alone are ignored — the
-        # axon plugin force-registers itself).
-        jax.config.update("jax_platforms", "cpu")
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    on_tpu, rtt = _bench_setup(force_cpu)
     # shapes sized for the single dev chip; CPU fallback shrinks
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
@@ -324,7 +372,6 @@ def _bench_main(force_cpu: bool = False) -> None:
 
     m = jnp.zeros_like(flat_params)
     v = jnp.zeros_like(flat_params)
-    rtt = _retry(_rtt, tag="rtt") if on_tpu else 0.0
     state = (flat_params, m, v)
     batch_args = (tokens, labels)
 
@@ -352,13 +399,6 @@ def _bench_main(force_cpu: bool = False) -> None:
         "chip": jax.devices()[0].device_kind,
         "backend": "tpu" if on_tpu else "cpu",
     }
-    for fn, tag in ((lambda: _microbench_adam(rtt, on_tpu), "adam"),
-                    (lambda: _microbench_layernorm(rtt, on_tpu), "ln"),
-                    (lambda: _microbench_attention(rtt, on_tpu), "attn")):
-        res = _aux(fn, tag)
-        if res:
-            extras.update(res)
-
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_1chip",
         "value": round(value, 1),
@@ -367,6 +407,14 @@ def _bench_main(force_cpu: bool = False) -> None:
                         if t_naive is not None else None),
         "extras": extras,
     }))
+
+
+def _bench_micro_leg(name: str, force_cpu: bool = False) -> None:
+    """Run ONE microbench leg and print its extras dict as a JSON line."""
+    on_tpu, rtt = _bench_setup(force_cpu)
+    res = MICRO_LEGS[name](rtt, on_tpu)
+    res["_leg"] = name
+    print(json.dumps(res))
 
 
 def _probe_tpu(timeout: float = 180.0):
@@ -391,32 +439,68 @@ def _probe_tpu(timeout: float = 180.0):
                    + proc.stdout.strip()[-120:])
 
 
-def _run_inner(mode: str, timeout: float):
-    """Run the measurement in a subprocess; return (json_obj, error)."""
+def _run_leg(mode: str, leg: str, timeout: float, key=None):
+    """Run one leg in a subprocess; return (json_obj, error).
+
+    ``key`` is the field that must be present in the JSON line ("metric"
+    for the main leg, "_leg" for microbenches)."""
+    key = key or ("metric" if leg == "main" else "_leg")
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--inner", mode],
+            [sys.executable, os.path.abspath(__file__),
+             "--inner", mode, "--leg", leg],
             capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
-        return None, f"{mode} bench timed out after {timeout:.0f}s"
+        return None, f"{mode}:{leg} timed out after {timeout:.0f}s"
     sys.stderr.write(proc.stderr or "")
     if proc.returncode != 0:
-        return None, ("%s bench rc=%d: %s"
-                      % (mode, proc.returncode, (proc.stderr or "")[-600:]))
+        return None, ("%s:%s rc=%d: %s"
+                      % (mode, leg, proc.returncode,
+                         (proc.stderr or "")[-400:]))
     for line in reversed((proc.stdout or "").strip().splitlines()):
         try:
             obj = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(obj, dict) and "metric" in obj:
+        if isinstance(obj, dict) and key in obj:
             return obj, None
-    return None, (f"{mode} bench emitted no JSON line "
+    return None, (f"{mode}:{leg} emitted no JSON line "
                   f"(stdout tail: {(proc.stdout or '')[-200:]!r})")
 
 
+# (leg, subprocess timeout): main pays 2 scan-loop compiles over the
+# tunnel; each micro leg pays 1-2 smaller ones
+LEG_TIMEOUTS = [("main", 1500), ("adam", 700), ("ln", 600),
+                ("attn", 700), ("xent", 600)]
+
+
+def _run_all_legs(mode: str, errors: list):
+    """Run every leg in its own subprocess; merge into one result dict.
+    Returns None only if the MAIN leg failed (micro legs degrade).  The
+    main leg gets one retry on non-timeout failures (transient tunnel
+    crashes); a timeout means a wedged client, not worth another 25 min."""
+    result, err = _run_leg(mode, "main", dict(LEG_TIMEOUTS)["main"])
+    if result is None and "timed out" not in (err or ""):
+        errors.append(err)
+        result, err = _run_leg(mode, "main", dict(LEG_TIMEOUTS)["main"])
+    if result is None:
+        errors.append(err)
+        return None
+    for leg, timeout in LEG_TIMEOUTS:
+        if leg == "main":
+            continue
+        res, err = _run_leg(mode, leg, timeout)
+        if res is None:
+            errors.append(err)
+            continue
+        res.pop("_leg", None)
+        result.setdefault("extras", {}).update(res)
+    return result
+
+
 def main() -> None:
-    """Orchestrator: probe → measure (subprocess) → always print JSON."""
+    """Orchestrator: probe → per-leg subprocesses → always print JSON."""
     errors = []
     result = None
 
@@ -428,33 +512,32 @@ def main() -> None:
         if not ok:
             errors.append(err2 or err)
     if ok:
-        result, err = _run_inner("tpu", timeout=2400)
-        if result is None:
-            errors.append(err)
-            if "timed out" not in (err or ""):
-                result, err = _run_inner("tpu", timeout=2400)
-                if result is None:
-                    errors.append(err)
+        result = _run_all_legs("tpu", errors)
 
     if result is None:
-        result, err = _run_inner("cpu", timeout=1800)
+        result = _run_all_legs("cpu", errors)
         if result is not None:
             result.setdefault("extras", {})["backend"] = "cpu"
             if errors:
-                result["error"] = "; ".join(errors)
-        else:
-            errors.append(err)
+                result["error"] = "; ".join(e for e in errors if e)
 
     if result is None:
         result = {"metric": "gpt_train_tokens_per_sec_1chip", "value": None,
                   "unit": "tokens/s", "vs_baseline": None,
                   "error": "; ".join(e for e in errors if e)}
+    elif errors:
+        result["error"] = "; ".join(e for e in errors if e)
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     if "--inner" in sys.argv:
         mode = sys.argv[sys.argv.index("--inner") + 1]
-        _bench_main(force_cpu=(mode == "cpu"))
+        leg = (sys.argv[sys.argv.index("--leg") + 1]
+               if "--leg" in sys.argv else "main")
+        if leg == "main":
+            _bench_main(force_cpu=(mode == "cpu"))
+        else:
+            _bench_micro_leg(leg, force_cpu=(mode == "cpu"))
     else:
         main()

@@ -122,12 +122,26 @@ def test_1f1b_matches_reference(setup):
         grads, ref_grads)
 
 
-def test_interleaved_matches_reference(setup):
-    """2 virtual chunks x PP stages = 2*PP linear stages total."""
-    v = 2
+# interleaving requires num_microbatches % PP == 0 (reference constraint)
+N_MICRO_I = 8
+
+
+def _batch_i(key, n_micro=N_MICRO_I):
+    return {
+        "x": jax.random.normal(key, (n_micro, MICRO_BS, HID)),
+        "target": jnp.ones((n_micro, MICRO_BS, HID)) * 0.1,
+    }
+
+
+def _run_interleaved(v, n_micro=N_MICRO_I, forward_only=False,
+                     stage_fn=_stage_fn, extra_batch=None):
+    """Run the interleaved executor over v*PP virtual linear stages and
+    return (loss, grads-with-virtual-stage-leading-dim, params, batch)."""
     n_stages = v * PP
     params = _make_params(jax.random.key(2), n_stages)
-    batch = _batch(jax.random.key(3))
+    batch = _batch_i(jax.random.key(3), n_micro)
+    if extra_batch:
+        batch.update(extra_batch)
     mesh = parallel_state.get_mesh()
 
     # chunk c on rank r is virtual stage c*PP + r: reorder the stage stack
@@ -138,9 +152,13 @@ def test_interleaved_matches_reference(setup):
     def body(chunked_params, batch):
         local = jax.tree.map(lambda p: p[0], chunked_params)  # [v, ...]
         loss, grads = forward_backward_pipelining_with_interleaving(
-            _stage_fn, _loss_fn, local, batch,
-            num_microbatches=N_MICRO, input_fn=_input_fn,
+            stage_fn, _loss_fn, local, batch,
+            num_microbatches=n_micro, input_fn=_input_fn,
+            forward_only=forward_only,
             virtual_pipeline_model_parallel_size=v)
+        if forward_only:
+            assert grads is None
+            grads = jax.tree.map(lambda p: p * 0, local)
         return loss, jax.tree.map(lambda g: g[None], grads)
 
     loss, grads = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
@@ -150,23 +168,115 @@ def test_interleaved_matches_reference(setup):
     # undo the chunk layout: grads come back [PP, v, ...] -> [v*PP, ...]
     grads = jax.tree.map(
         lambda g: g.swapaxes(0, 1).reshape(n_stages, *g.shape[2:]), grads)
+    return loss, grads, params, batch
 
+
+def _interleaved_reference(params, batch, n_stages, n_micro,
+                           stage_fn=_stage_fn):
     def ref_loss_fn(params):
         total = 0.0
-        for m in range(N_MICRO):
+        for m in range(n_micro):
             x = batch["x"][m]
+            mb = jax.tree.map(lambda v_, m=m: v_[m], batch)
             for s in range(n_stages):
-                x = _stage_fn(
-                    jax.tree.map(lambda p, s=s: p[s], params), x, None)
-            total = total + _loss_fn(x, jax.tree.map(
-                lambda v_, m=m: v_[m], batch))
-        return total / N_MICRO
+                x = stage_fn(
+                    jax.tree.map(lambda p, s=s: p[s], params), x, mb)
+            total = total + _loss_fn(x, mb)
+        return total / n_micro
+    return jax.value_and_grad(ref_loss_fn)(params)
 
-    ref_loss, ref_grads = jax.value_and_grad(ref_loss_fn)(params)
+
+@pytest.mark.parametrize("v", [2, 3])
+def test_interleaved_matches_reference(setup, v):
+    """v virtual chunks x PP stages = v*PP linear stages total."""
+    loss, grads, params, batch = _run_interleaved(v)
+    ref_loss, ref_grads = _interleaved_reference(
+        params, batch, v * PP, N_MICRO_I)
     np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
         grads, ref_grads)
+
+
+def test_interleaved_stage_fn_sees_correct_microbatch(setup):
+    """Each virtual stage must receive the microbatch ITS activation
+    belongs to (per-microbatch conditioning), across chunk hand-offs."""
+    def cond_stage_fn(params, x, mb):
+        return jax.nn.gelu(x @ params["w"] + params["b"]) + mb["cond"]
+
+    cond = jax.random.normal(jax.random.key(6), (N_MICRO_I, MICRO_BS, HID))
+    loss, grads, params, batch = _run_interleaved(
+        2, stage_fn=cond_stage_fn, extra_batch={"cond": cond})
+    ref_loss, ref_grads = _interleaved_reference(
+        params, batch, 2 * PP, N_MICRO_I, stage_fn=cond_stage_fn)
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        grads, ref_grads)
+
+
+def test_interleaved_forward_only(setup):
+    loss, _, params, batch = _run_interleaved(2, forward_only=True)
+    ref_loss, _ = _interleaved_reference(params, batch, 2 * PP, N_MICRO_I)
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+
+
+def test_interleaved_requires_divisible_microbatches(setup):
+    with pytest.raises(ValueError, match="multiple of the pipeline"):
+        _run_interleaved(2, n_micro=6)
+
+
+def test_interleaved_bubble_shrinks_with_v():
+    """The whole point of virtual pipelining: bubble ~ (pp-1)/v.  Cost in
+    full-stage fwd+bwd units: warmup/cooldown chunk-ticks run only one of
+    (fwd, bwd) so cost 1/(2v) each; steady ticks cost 1/v."""
+    from apex_tpu.transformer.pipeline_parallel import interleaved_phase_ticks
+    n, pp = 32, 4
+
+    def bubble(v):
+        warm, steady, cool = interleaved_phase_ticks(n, pp, v)
+        cost = (warm + cool) / (2 * v) + steady / v
+        return cost - n  # ideal cost is n
+
+    assert bubble(1) == pytest.approx(pp - 1)
+    for v in (2, 4):
+        assert bubble(v) == pytest.approx((pp - 1) / v), (
+            f"v={v}: bubble {bubble(v)} != {(pp - 1) / v}")
+    assert bubble(4) < bubble(2) < bubble(1)
+
+
+def test_interleaved_memory_bounded_in_microbatches(setup):
+    """Interleaved 1F1B's circular residual buffer must keep live
+    activation memory O(v*pp), independent of num_microbatches."""
+    mesh = parallel_state.get_mesh()
+    hid, bs, v = 64, 4, 2
+
+    def temp_bytes(n_micro):
+        params = {"w": jnp.zeros((PP, v, hid, hid)),
+                  "b": jnp.zeros((PP, v, hid))}
+        batch = {"x": jnp.zeros((n_micro, bs, hid)),
+                 "target": jnp.zeros((n_micro, bs, hid))}
+
+        def body(params, batch):
+            local = jax.tree.map(lambda p: p[0], params)
+            loss, grads = forward_backward_pipelining_with_interleaving(
+                _stage_fn, _loss_fn, local, batch,
+                num_microbatches=n_micro, input_fn=_input_fn,
+                virtual_pipeline_model_parallel_size=v)
+            return loss, jax.tree.map(lambda g: g[None], grads)
+
+        f = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh,
+            in_specs=(P("pipe"), P()), out_specs=(P(), P("pipe"))))
+        ma = f.lower(params, batch).compile().memory_analysis()
+        if ma is None:
+            pytest.skip("memory_analysis unavailable on this backend")
+        return ma.temp_size_in_bytes
+
+    small, big = temp_bytes(8), temp_bytes(32)
+    assert big <= small * 1.25 + 16384, (
+        f"interleaved temp memory grew with num_microbatches: "
+        f"{small} -> {big}")
 
 
 def test_1f1b_stage_fn_sees_correct_microbatch(setup):
