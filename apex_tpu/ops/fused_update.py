@@ -88,11 +88,24 @@ def _tail_mask(i, n: int, x, fill):
     return jnp.where(idx < n, x, fill)
 
 
-# the flag/accumulator kernels carry SMEM state across grid steps and must
-# run sequentially; the elementwise update kernels are freely parallel
-# (Megacore can split their grid)
-_SEQ = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+# every kernel grid is parallel (Megacore splits it freely): the flag /
+# accumulator kernels write PER-BLOCK partials into a (grid,)-shaped SMEM
+# output (each step owns its own slot) that the wrapper reduces with one
+# tiny XLA max/sum — no SMEM state carried across grid steps, unlike the
+# earlier serialized ("arbitrary") variant that pinned the whole unscale
+# path to one core (parity: ``amp_C.multi_tensor_scale``'s chunked
+# launcher is likewise grid-parallel with a global flag buffer)
 _PAR = pltpu.CompilerParams(dimension_semantics=("parallel",))
+
+
+def _bspec():
+    """Per-grid-step (1,) SMEM output block: step i owns slot i.
+
+    The blocked index map means only ONE element is staged in SMEM per
+    grid step (the assembled ``(grid,)`` array lives in HBM), so SMEM
+    pressure is O(1) in buffer size; SMEM is the right home for a scalar
+    store (Mosaic vector stores want lane-shaped VMEM tiles)."""
+    return pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM)
 
 
 # ---------------------------------------------------------------------------
@@ -101,16 +114,10 @@ _PAR = pltpu.CompilerParams(dimension_semantics=("parallel",))
 
 def _scale_kernel(n, x_ref, hp_ref, o_ref, flag_ref):
     i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        flag_ref[0] = jnp.float32(0.0)
-
     x = x_ref[...].astype(jnp.float32)
     y = x * hp_ref[0]
-    bad = jnp.any(~jnp.isfinite(_tail_mask(i, n, y, 0.0))
-                  ).astype(jnp.float32)
-    flag_ref[0] = jnp.maximum(flag_ref[0], bad)
+    flag_ref[0] = jnp.any(~jnp.isfinite(_tail_mask(i, n, y, 0.0))
+                          ).astype(jnp.float32)
     o_ref[...] = y.astype(o_ref.dtype)
 
 
@@ -125,34 +132,28 @@ def fused_scale(flat: jax.Array, scale, out_dtype=None):
     if n == 0:   # empty grid would leave the SMEM flag uninitialized
         return flat.astype(out_dtype), jnp.float32(0.0)
     hp = jnp.asarray([scale], jnp.float32)
-    out, flag = pl.pallas_call(
+    out, flags = pl.pallas_call(
         functools.partial(_scale_kernel, n),
         grid=(_grid(x2),),
         in_specs=[_vspec(), _sspec()],
-        out_specs=[_vspec(), pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[_vspec(), _bspec()],
         out_shape=[
             jax.ShapeDtypeStruct(x2.shape, out_dtype),
-            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
         ],
-        compiler_params=_SEQ,
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(x2, hp)
-    return out, flag[0]
+    return out, jnp.max(flags)
 
 
 def _axpby_kernel(n, x_ref, y_ref, hp_ref, o_ref, flag_ref):
     i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        flag_ref[0] = jnp.float32(0.0)
-
     x = x_ref[...].astype(jnp.float32)
     y = y_ref[...].astype(jnp.float32)
     o = hp_ref[0] * x + hp_ref[1] * y
-    bad = jnp.any(~jnp.isfinite(_tail_mask(i, n, o, 0.0))
-                  ).astype(jnp.float32)
-    flag_ref[0] = jnp.maximum(flag_ref[0], bad)
+    flag_ref[0] = jnp.any(~jnp.isfinite(_tail_mask(i, n, o, 0.0))
+                          ).astype(jnp.float32)
     o_ref[...] = o.astype(o_ref.dtype)
 
 
@@ -167,19 +168,19 @@ def fused_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
     if n == 0:   # empty grid would leave the SMEM flag uninitialized
         return x.astype(out_dtype), jnp.float32(0.0)
     hp = jnp.asarray([a, b], jnp.float32)
-    out, flag = pl.pallas_call(
+    out, flags = pl.pallas_call(
         functools.partial(_axpby_kernel, n),
         grid=(_grid(x2),),
         in_specs=[_vspec(), _vspec(), _sspec()],
-        out_specs=[_vspec(), pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[_vspec(), _bspec()],
         out_shape=[
             jax.ShapeDtypeStruct(x2.shape, out_dtype),
-            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
         ],
-        compiler_params=_SEQ,
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(x2, y2, hp)
-    return out, flag[0]
+    return out, jnp.max(flags)
 
 
 # ---------------------------------------------------------------------------
@@ -188,13 +189,8 @@ def fused_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
 
 def _l2norm_kernel(n, x_ref, acc_ref):
     i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        acc_ref[0] = jnp.float32(0.0)
-
     x = _tail_mask(i, n, x_ref[...].astype(jnp.float32), 0.0)
-    acc_ref[0] += jnp.sum(x * x)
+    acc_ref[0] = jnp.sum(x * x)
 
 
 def fused_l2norm(flat: jax.Array) -> jax.Array:
@@ -209,12 +205,12 @@ def fused_l2norm(flat: jax.Array) -> jax.Array:
         functools.partial(_l2norm_kernel, n),
         grid=(_grid(x2),),
         in_specs=[_vspec()],
-        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((1,), jnp.float32),
-        compiler_params=_SEQ,
+        out_specs=_bspec(),
+        out_shape=jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+        compiler_params=_PAR,
         interpret=interpret_mode(),
     )(x2)
-    return jnp.sqrt(acc[0])
+    return jnp.sqrt(jnp.sum(acc))
 
 
 # ---------------------------------------------------------------------------

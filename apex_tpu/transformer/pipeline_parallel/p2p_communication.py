@@ -16,6 +16,11 @@ All functions must run inside a region binding the pipe axis.  Semantics of
 the ring: rank r's payload lands on r+1 (forward) or r-1 (backward); the
 wrap-around edge (last→first) is what the reference's "first/last stage has
 no prev/next" checks handle — callers mask it (the schedule does).
+
+These are the transport layer of ``schedules.py``: the GPipe forward uses
+``send_forward_recv_forward``, the 1F1B steady state
+``send_forward_recv_backward``, and the interleaved executor the
+forward/backward rotations (the chunk hand-off rides the wrap-around).
 """
 from __future__ import annotations
 

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.parallel_state import PIPE_AXIS
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
 
 __all__ = [
     "get_forward_backward_func",
@@ -167,7 +168,6 @@ def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
     n_stages = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     n_ticks = num_microbatches + n_stages - 1
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     lf, _ = _normalize_loss_fn(loss_fn)
 
     mb0 = _microbatch(batch, 0)
@@ -189,8 +189,7 @@ def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
         loss = lf(y, mb, params)
         valid = (stage == n_stages - 1) & (t - stage >= 0)
         loss_acc = loss_acc + jnp.where(valid, loss, 0.0)
-        state = jax.tree.map(
-            lambda a: jax.lax.ppermute(a, axis_name, perm), y)
+        state = p2p.send_forward_recv_forward(y, axis_name=axis_name)
         return (state, loss_acc), None
 
     (_, loss_acc), _ = jax.lax.scan(
@@ -304,8 +303,6 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
     n = num_microbatches
     depth = 2 * (n_stages - 1) + 1
     n_ticks = n + 2 * (n_stages - 1)
-    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
     lf, loss_has_params = _normalize_loss_fn(loss_fn)
 
     inv_map, buf_shapes, x0 = _residual_layout(
@@ -377,11 +374,9 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
             lambda a, d: a + jnp.where(b_valid, d, jnp.zeros_like(d)),
             grad_acc, dparams)
 
-        # ---- ring messages ----------------------------------------------
-        fwd_msg = jax.tree.map(
-            lambda a: jax.lax.ppermute(a, axis_name, fwd_perm), y)
-        bwd_msg = jax.tree.map(
-            lambda a: jax.lax.ppermute(a, axis_name, bwd_perm), dx)
+        # ---- ring messages: the 1F1B steady-state pair -------------------
+        fwd_msg, bwd_msg = p2p.send_forward_recv_backward(
+            y, dx, axis_name=axis_name)
         return (buf, xbuf, fwd_msg, bwd_msg, grad_acc, loss_acc), None
 
     xbuf0 = jax.tree.map(
@@ -494,8 +489,6 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
     f_end = t0 + steady
     total = f_end + cooldown
     depth = 2 * v * n_stages
-    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
     lf, loss_has_params = _normalize_loss_fn(loss_fn)
 
     chunk0 = jax.tree.map(lambda x: x[0], params)
@@ -544,8 +537,7 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
         buffered = [c for c, jj in zip(consts, inv_map) if jj < 0]
         buf = [b.at[t % depth].set(c) for b, c in zip(buf, buffered)]
         xbuf = jax.tree.map(lambda b, c: b.at[t % depth].set(c), xbuf, x)
-        fwd_msg = jax.tree.map(
-            lambda a: jax.lax.ppermute(a, axis_name, fwd_perm), y)
+        fwd_msg = p2p.send_forward_recv_forward(y, axis_name=axis_name)
         return (buf, xbuf, fwd_msg, bwd_msg, dy_local, grad_acc, loss_acc)
 
     def bwd_half(carry, t, prev_dy):
@@ -584,8 +576,7 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
             lambda a, d: a.at[c_b].add(
                 jnp.where(b_valid, d, jnp.zeros_like(d))),
             grad_acc, dparams)
-        bwd_msg = jax.tree.map(
-            lambda a: jax.lax.ppermute(a, axis_name, bwd_perm), dx)
+        bwd_msg = p2p.send_backward_recv_backward(dx, axis_name=axis_name)
         return (buf, xbuf, fwd_msg, bwd_msg, carry[4], grad_acc, loss_acc)
 
     def phase(carry, lo, hi, *, do_fwd, do_bwd):

@@ -16,10 +16,19 @@ TPU-native notes:
   (``LinearWithGradAccumulationAndAsyncCommunication``: launch grad-input
   allreduce async, compute wgrad GEMM meanwhile) is XLA's job: the
   scheduler overlaps the psum from ``copy_to...``'s backward with the wgrad
-  dot automatically.  ``gradient_accumulation_fusion`` (wgrad accumulated
-  into an fp32 main_grad by ``fused_weight_gradient_mlp_cuda``) maps to
-  XLA buffer donation + fp32 accumulate in the optimizer path; the flag is
-  accepted and documented, not re-implemented.
+  dot automatically.
+* ``gradient_accumulation_fusion`` (reference: wgrad GEMM accumulating
+  straight into fp32 ``main_grad`` via ``fused_weight_gradient_mlp_cuda ::
+  wgrad_gemm_accum_fp32``) is REAL here, as :func:`_linear_wgrad_fp32`:
+  the weight is held fp32 (the master/main-grad regime where the reference
+  flag applies), cast to the activation dtype for the forward MXU pass,
+  and the backward emits the weight grad **fp32 straight from the MXU
+  accumulator** (``preferred_element_type=f32``) — the wgrad is never
+  quantized through bf16, exactly the fp32-accumulate semantics, with no
+  extra buffer (the MXU accumulates fp32 natively; the downstream add
+  into the grad accumulator fuses, see
+  ``tests/L0/run_transformer/test_grad_accumulation_fusion.py``'s HLO
+  check).
 * Layout convention follows Megatron: activations ``[s, b, h]`` when
   sequence parallel is on (dim 0 = sequence).
 """
@@ -68,6 +77,57 @@ def _shard_init(init: Callable, axis_name: str, world: int) -> Callable:
     return f
 
 
+@jax.custom_vjp
+def _linear_wgrad_fp32(x, weight):
+    """``y = x @ W.T`` whose backward emits the weight grad in fp32
+    straight from the MXU accumulator (reference:
+    ``fused_weight_gradient_mlp_cuda :: wgrad_gemm_accum_fp32``).
+
+    ``weight`` is the fp32 master; it is cast to ``x``'s dtype for the
+    forward GEMM (the 16-bit model-weight copy of the reference's O2
+    regime), while the backward's wgrad dot contracts the bf16 operands
+    with ``preferred_element_type=f32`` so the cotangent reaches the fp32
+    grad accumulator without ever being rounded to bf16.
+    """
+    return jnp.matmul(x, weight.astype(x.dtype).T)
+
+
+def _linear_wgrad_fp32_fwd(x, weight):
+    return _linear_wgrad_fp32(x, weight), (x, weight)
+
+
+def _linear_wgrad_fp32_bwd(res, dy):
+    x, weight = res
+    dx = jnp.matmul(dy, weight.astype(dy.dtype))
+    bdims = tuple(range(x.ndim - 1))
+    dw = jax.lax.dot_general(dy, x, ((bdims, bdims), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return dx, dw.astype(weight.dtype)
+
+
+_linear_wgrad_fp32.defvjp(_linear_wgrad_fp32_fwd, _linear_wgrad_fp32_bwd)
+
+
+def _maybe_fused_matmul(x, weight, fused: bool):
+    """Shared GEMM dispatch for Column/Row parallel linears.
+
+    With ``fused`` the weight MUST be fp32 (the master/main-grad regime):
+    a custom_vjp cotangent must match the primal dtype, so a 16-bit
+    weight would silently round the fp32-accumulated wgrad right back to
+    bf16 — the reference likewise hard-requires an fp32 ``main_grad``
+    buffer on the param.  Fail loud instead.
+    """
+    if fused:
+        if weight.dtype != jnp.float32:
+            raise ValueError(
+                "gradient_accumulation_fusion requires fp32 (master) "
+                f"weights, got {weight.dtype}; the reference's "
+                "wgrad_gemm_accum_fp32 equally requires param.main_grad "
+                "to be fp32")
+        return _linear_wgrad_fp32(x, weight)
+    return jnp.matmul(x, weight.T)
+
+
 def linear_with_grad_accumulation_and_async_allreduce(
         input, weight, bias=None, gradient_accumulation_fusion: bool = False,
         async_grad_allreduce: bool = True,
@@ -91,7 +151,7 @@ def linear_with_grad_accumulation_and_async_allreduce(
         x = mappings.copy_to_tensor_model_parallel_region(input, axis_name)
     else:
         x = input
-    out = jnp.matmul(x, weight.T)
+    out = _maybe_fused_matmul(x, weight, gradient_accumulation_fusion)
     if bias is not None:
         out = out + bias
     return out
@@ -182,7 +242,8 @@ class RowParallelLinear(nn.Module):
                 "sequence_parallel requires input_is_parallel"
             input_parallel = mappings.scatter_to_tensor_model_parallel_region(
                 input_, self.axis_name)
-        output_parallel = jnp.matmul(input_parallel, weight.T)
+        output_parallel = _maybe_fused_matmul(
+            input_parallel, weight, self.gradient_accumulation_fusion)
         if self.sequence_parallel_enabled:
             output = mappings.reduce_scatter_to_sequence_parallel_region(
                 output_parallel, self.axis_name)

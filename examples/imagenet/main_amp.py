@@ -71,7 +71,11 @@ def synthetic_loader(args):
     return batches
 
 
-def main(argv=None):
+def main(argv=None, return_state=False):
+    """Train; returns the per-iteration loss trace, plus (with
+    ``return_state=True``) the final fp32 parameter vectors — the hooks the
+    cross-run comparison tier uses to assert O0/O1/O2/O3 runs track each
+    other (reference: ``tests/L1/common/compare.py``)."""
     args = parse_args(argv)
     torch.manual_seed(args.seed)
 
@@ -125,6 +129,10 @@ def main(argv=None):
                 print(f"Epoch {epoch} [{i}] loss {loss.item():.4f} "
                       f"({(i + 1) / (time.time() - t0):.2f} it/s)")
     print(f"final loss {losses[-1]:.4f} (first {losses[0]:.4f})")
+    if return_state:
+        state = [p.detach().float().cpu().numpy()
+                 for p in model.parameters()]
+        return losses, state
     return losses
 
 

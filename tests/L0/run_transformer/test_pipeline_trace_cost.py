@@ -1,0 +1,82 @@
+"""1F1B trace-cost budget (SURVEY §7 "hard parts — 1F1B on TPU"): the
+bounded-memory executor re-traces the stage vjp inside the scan body
+(``jax.vjp`` + ``jax.closure_convert`` per tick half), which is O(1) per
+trace but would silently explode compile times if a future change made it
+per-microbatch or quadratic.  Pin it: tracing a pp=4 pipeline over a REAL
+transformer stage (the standalone GPT layer with TP layers + flash
+attention) must stay within a fixed time and jaxpr-size budget.
+
+Measured baseline on the CI CPU mesh: ~0.9 s trace+lower, ~150 KB jaxpr
+text; budgets are ~10x that — loose enough for slow CI, tight enough that
+an O(num_microbatches) regression (8 extra stage traces) trips it.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    forward_backward_pipelining_without_interleaving,
+)
+from apex_tpu.transformer.testing import GPTConfig
+from apex_tpu.transformer.testing.standalone_gpt import (
+    ParallelTransformerLayer,
+)
+
+PP, HID, SEQ, BS, N_MICRO = 4, 64, 32, 2, 8
+
+TRACE_BUDGET_S = 10.0
+JAXPR_BUDGET_BYTES = 1_500_000
+
+
+@pytest.fixture
+def setup():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=PP)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_1f1b_trace_cost_bounded_with_gpt_stage(setup):
+    mesh = parallel_state.get_mesh()
+    cfg = GPTConfig(vocab_size=128, hidden_size=HID, num_layers=PP,
+                    num_attention_heads=4, max_seq_length=SEQ,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    layer = ParallelTransformerLayer(cfg, causal=True)
+    x0 = jnp.zeros((SEQ, BS, HID))
+    params = layer.init(jax.random.PRNGKey(0), x0, None, True)
+    batch = {"x": jnp.zeros((N_MICRO, SEQ, BS, HID)),
+             "t": jnp.zeros((N_MICRO, SEQ, BS, HID))}
+
+    def stage(p, x, mb):
+        return layer.apply(p, x, None, True)
+
+    def loss(y, mb):
+        return jnp.mean((y - mb["t"]) ** 2)
+
+    def body(p, b):
+        return forward_backward_pipelining_without_interleaving(
+            stage, loss, p, b, num_microbatches=N_MICRO,
+            input_fn=lambda mb: mb["x"])
+
+    f = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P())))
+
+    t0 = time.time()
+    traced = f.trace(params, batch)
+    traced.lower()
+    elapsed = time.time() - t0
+    assert elapsed < TRACE_BUDGET_S, (
+        f"1F1B trace+lower took {elapsed:.1f}s (budget {TRACE_BUDGET_S}s) "
+        "— did the per-tick vjp rebuild become per-microbatch?")
+
+    jaxpr_bytes = len(str(traced.jaxpr))
+    assert jaxpr_bytes < JAXPR_BUDGET_BYTES, (
+        f"1F1B jaxpr grew to {jaxpr_bytes} bytes "
+        f"(budget {JAXPR_BUDGET_BYTES}) — residual machinery duplicating "
+        "stage compute per microbatch?")

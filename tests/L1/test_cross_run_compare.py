@@ -1,0 +1,73 @@
+"""L1 cross-run comparison tier (reference: ``tests/L1/common/compare.py``
++ ``tests/L1/cross_product/run.sh``): runs of DIFFERENT opt levels on the
+same data/seed must produce loss and parameter traces that track each
+other, and a re-run of the SAME opt level must reproduce exactly.
+
+The reference compares fp16 runs at ~1e-3 tolerance; bf16 carries 7
+mantissa bits vs fp16's 10 (8x coarser), and a ResNet with BatchNorm
+amplifies parameter noise chaotically with step count, so this tier runs a
+SHORT horizon (6 steps, lr 2e-3 — calibrated) and asserts bounds ~3x the
+observed bf16 divergence: real semantic breakage (missing master weights,
+wrong cast placement) measures ~10x larger.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from examples.imagenet.main_amp import main
+
+ARGS = ["--synthetic", "--arch", "resnet18", "-b", "8", "--iters", "6",
+        "--epochs", "1", "--image-size", "32", "--num-classes", "8",
+        "--lr", "0.002", "--print-freq", "100"]
+
+
+def _run(opt_level, extra=()):
+    return main(ARGS + ["--opt-level", opt_level, *extra],
+                return_state=True)
+
+
+@pytest.fixture(scope="module")
+def o0_trace():
+    return _run("O0")
+
+
+@pytest.mark.parametrize("opt_level,extra", [
+    ("O1", ()),
+    ("O2", ()),
+    ("O3", ("--keep-batchnorm-fp32", "True")),
+])
+def test_opt_level_tracks_o0(o0_trace, opt_level, extra):
+    ref_l, ref_s = o0_trace
+    losses, state = _run(opt_level, extra)
+    losses, ref_losses = np.asarray(losses), np.asarray(ref_l)
+    assert losses.shape == ref_losses.shape
+
+    # step 0 is a pure forward before any update: only cast error
+    assert abs(losses[0] - ref_losses[0]) < 0.05, (
+        f"{opt_level} initial forward diverged: "
+        f"{losses[0]} vs {ref_losses[0]}")
+    diffs = np.abs(losses - ref_losses)
+    assert diffs.max() < 0.9, (
+        f"{opt_level} loss trace diverged from O0: {diffs.tolist()}")
+    assert diffs.mean() < 0.3, (
+        f"{opt_level} loss trace mean-diverged from O0: {diffs.tolist()}")
+
+    param_diff = max(np.max(np.abs(a - b)) for a, b in zip(state, ref_s))
+    assert param_diff < 0.15, (
+        f"{opt_level} final params diverged from O0 by {param_diff}")
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O2"])
+def test_same_level_rerun_is_deterministic(opt_level):
+    """Same seed + same opt level reproduces the trace bitwise (the
+    reference's same-config compare; also the determinism contract)."""
+    l1, s1 = _run(opt_level)
+    l2, s2 = _run(opt_level)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    for a, b in zip(s1, s2):
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,18 @@
+"""L1 wiring of ``examples/simple/distributed`` (reference:
+``examples/simple/distributed/run.sh`` — the smallest mesh-DDP example
+must train end to end)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from examples.simple.distributed.distributed_data_parallel import main
+
+
+def test_simple_distributed_trains():
+    losses = main()
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
