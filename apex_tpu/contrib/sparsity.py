@@ -7,9 +7,17 @@ search kernels for better mask quality.
 
 Functional TPU rebuild: masks are a pytree of 0/1 arrays; the core mask
 rule (``m4n2_1d``: per group of 4 along the input dim keep the 2 largest
-|w|) is a vectorized jnp expression.  Permutation search is channel
-reordering ahead of masking — an offline quality refinement, deliberately
-out of scope (documented, like the reference's non-default strategies).
+|w|) is a vectorized jnp expression.  Permutation search (reference:
+``apex/contrib/sparsity/permutation_search_kernels`` — reorder input
+channels so 2:4 pruning keeps more magnitude, per NVIDIA's "Channel
+Permutations for N:M Sparsity") is :func:`search_for_good_permutation`:
+a jit-compiled stochastic hill-climb that proposes disjoint column-pair
+swaps each round and accepts every swap that increases kept magnitude —
+the whole sweep evaluated as one batched top-2-of-4 reduction instead of
+the reference's CUDA per-candidate kernels.  Applying the permutation to
+the surrounding network (permute this layer's inputs = permute the
+previous layer's outputs) is the caller's model-level rewiring, as in the
+reference's ``Permutation`` module.
 
 ``ASP`` keeps the reference's classmethod surface where it maps: compute
 masks, apply masks, and a functional "masked step" hook in place of
@@ -17,12 +25,15 @@ optimizer monkey-patching.
 """
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["mask_2to4_1d", "compute_sparse_masks", "apply_masks", "ASP"]
+__all__ = ["mask_2to4_1d", "compute_sparse_masks", "apply_masks", "ASP",
+           "sparsity_efficacy", "search_for_good_permutation",
+           "accelerated_search_for_good_permutation"]
 
 
 def mask_2to4_1d(w):
@@ -40,6 +51,106 @@ def mask_2to4_1d(w):
     rank = jnp.argsort(order, axis=-1)
     mask = (rank >= 2).astype(w.dtype)
     return mask.reshape(*lead, n)
+
+
+def sparsity_efficacy(w) -> jax.Array:
+    """Magnitude kept by 2:4 pruning, as a fraction of total magnitude
+    (reference: ``permutation_search_kernels``' "efficacy" objective)."""
+    kept = jnp.sum(jnp.abs(w) * mask_2to4_1d(w).astype(jnp.float32))
+    return kept / jnp.maximum(jnp.sum(jnp.abs(w)), 1e-30)
+
+
+def _kept_mass_grouped(mag):
+    """Sum of the top-2 magnitudes per group of 4 along the last dim;
+    ``mag`` is [..., n//4, 4]."""
+    top2 = jax.lax.top_k(mag, 2)[0]
+    return jnp.sum(top2, axis=(-1, -2))
+
+
+@functools.partial(jax.jit, static_argnames=("iters",))
+def search_for_good_permutation(w, *, iters: int = 100, key=None):
+    """Find a column permutation improving 2:4 efficacy (reference:
+    ``permutation_search_kernels.accelerated_search_for_good_permutation``).
+
+    Strategy (TPU-vectorized hill-climb): each round draws ONE random
+    disjoint pairing of all columns and evaluates every pair's swap —
+    columns a and b trade groups — with a single batched top-2-of-4
+    reduction over all rows; every swap whose isolated delta is positive
+    is applied.  Because several accepted swaps can touch the same group,
+    per-round improvement is heuristic, so the carry tracks the
+    best-efficacy permutation seen and THAT is returned — the result is
+    monotonically >= identity by construction.  The reference's CUDA
+    kernels brute-force candidate swaps per thread-block; one round here
+    is the same bounded-window greedy move, batched.
+
+    Returns ``perm`` (int32 [n]) such that ``w[..., perm]`` is the
+    permuted matrix; deterministic for a given ``key``.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    n = w.shape[-1]
+    assert n % 4 == 0, "column count must be divisible by 4"
+    w2d = jnp.abs(w.reshape(-1, n).astype(jnp.float32))
+
+    def _kept(perm):
+        mag = w2d[:, perm]
+        return jnp.sum(_kept_mass_grouped(mag.reshape(-1, n // 4, 4)))
+
+    def round_(carry, k):
+        perm, best_perm, best_kept = carry
+        mag = w2d[:, perm]                       # [r, n]
+        # random disjoint pairing: pos i pairs with its partner
+        shuf = jax.random.permutation(k, n)      # pairing in shuffled space
+        partner_shuf = shuf.reshape(n // 2, 2)[:, ::-1].reshape(n)
+        partner = jnp.zeros((n,), jnp.int32).at[shuf].set(partner_shuf)
+
+        grp = jnp.arange(n) // 4
+        # candidate: swap column position i with position partner[i]
+        # new kept mass of i's group when i's column is replaced by
+        # partner's column (gather the partner column into i's slot)
+        swapped_cols = mag[:, partner]           # column at pos i <- partner
+        g = mag.reshape(-1, n // 4, 4)
+        # for each position i, rebuild i's group with slot i swapped
+        slot = jnp.arange(n) % 4
+        onehot = jax.nn.one_hot(slot, 4, dtype=mag.dtype)  # [n, 4]
+        # groups_for_pos: [r, n, 4] = the group containing each position
+        groups_for_pos = g[:, grp, :]
+        new_groups = (groups_for_pos * (1 - onehot)[None]
+                      + swapped_cols[:, :, None] * onehot[None])
+        # top-2 kept mass of each position's group (last axis only)
+        old_kept = jnp.sum(jax.lax.top_k(groups_for_pos, 2)[0], -1)  # [r,n]
+        new_kept = jnp.sum(jax.lax.top_k(new_groups, 2)[0], -1)      # [r,n]
+        # delta for the swap PAIR (i, partner): both groups change; sum
+        # both sides (each position sees its own group's delta)
+        delta_pos = jnp.sum(new_kept - old_kept, axis=0)   # [n]
+        pair_delta = delta_pos + delta_pos[partner]
+        # a swap within the same group is a no-op for the mask: reject
+        same_group = grp == grp[partner]
+        # scale-invariant acceptance: require a gain of at least 1e-6 of
+        # an average column's mass (an absolute epsilon would freeze the
+        # search to identity on small-magnitude matrices)
+        tol = 1e-6 * jnp.sum(w2d) / n
+        accept = (pair_delta > tol) & ~same_group
+        # both endpoints must agree (they do, pair_delta is symmetric)
+        new_perm = jnp.where(accept, perm[partner], perm)
+        kept = _kept(new_perm)
+        better = kept > best_kept
+        best_perm = jnp.where(better, new_perm, best_perm)
+        best_kept = jnp.where(better, kept, best_kept)
+        return (new_perm, best_perm, best_kept), None
+
+    perm0 = jnp.arange(n, dtype=jnp.int32)
+    (_, best_perm, _), _ = jax.lax.scan(
+        round_, (perm0, perm0, _kept(perm0)), jax.random.split(key, iters))
+    return best_perm
+
+
+def accelerated_search_for_good_permutation(w, *, iters: int = 100,
+                                            key=None):
+    """Name-parity alias (reference:
+    ``permutation_search_kernels.accelerated_search_for_good_permutation``
+    returns the permuted matrix's permutation)."""
+    return search_for_good_permutation(w, iters=iters, key=key)
 
 
 def _maskable(path: tuple, leaf) -> bool:

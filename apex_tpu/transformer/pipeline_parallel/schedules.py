@@ -249,6 +249,20 @@ def _check_consts(consts, inv_map, buf_shapes, p_leaves, *, where_tag):
             f"got {c.shape}/{c.dtype}, probe recorded {w_shape}/{w_dtype}")
 
 
+def _phase_scan(tick, carry, lo: int, hi: int, **flags):
+    """Scan ``tick(carry, t, **flags)`` over ticks ``[lo, hi)`` — one
+    schedule phase (empty ranges are a no-op).  Shared by the 1F1B and
+    interleaved executors' warmup/steady/cooldown splits."""
+    if hi <= lo:
+        return carry
+
+    def body(carry, t):
+        return tick(carry, t, **flags), None
+
+    carry, _ = jax.lax.scan(body, carry, jnp.arange(lo, hi))
+    return carry
+
+
 def _rebuild_vjp(stage_fn, mb_b, p_b, x_b, inv_map, buf_shapes, buf, slot,
                  *, where_tag):
     """Rebuild a buffered microbatch's backward from the circular buffer.
@@ -284,10 +298,13 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
     (reference: ``fwd_bwd_pipelining_without_interleaving.py``'s
     warmup / steady-1F1B / cooldown schedule).
 
-    One ``lax.scan`` over ``num_microbatches + 2*(pp-1)`` ticks.  Each
-    tick, every stage runs one forward (microbatch ``t - s``) and one
-    backward (microbatch ``t - 2*(pp-1) + s``), hand-pairing ``jax.vjp``
-    per microbatch: forward residuals live in a circular buffer of
+    Three ``lax.scan`` phases over ``num_microbatches + 2*(pp-1)`` ticks
+    total: forward-only warmup ``[0, pp-1)``, steady state
+    ``[pp-1, n+pp-1)`` where every stage runs one forward (microbatch
+    ``t - s``) AND one backward (microbatch ``t - 2*(pp-1) + s``), and
+    backward-only cooldown — so bubble ticks cost one direction, not two.
+    Forward/backward pair hand-made ``jax.vjp`` per microbatch: forward
+    residuals live in a circular buffer of
     ``D = 2*(pp-1)+1`` slots — the 1F1B bounded-memory profile (O(pp)
     in-flight microbatches, INDEPENDENT of num_microbatches), vs. the
     grad-of-scan GPipe executor that stashes ``n + pp - 1`` ticks.
@@ -302,6 +319,15 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
     stage = jax.lax.axis_index(axis_name)
     n = num_microbatches
     depth = 2 * (n_stages - 1) + 1
+    # phase boundaries: backwards start at tick pp-1 (last stage's mb 0),
+    # forwards end after tick n+pp-2 (stage 0 injected its last microbatch
+    # at n-1).  Splitting the scan so warmup ticks run ONLY the forward
+    # half and cooldown ticks ONLY the backward half halves the bubble
+    # cost vs a monolithic masked scan: 2*(pp-1) full ticks become
+    # (pp-1)*(fwd+bwd) of real compute — the reference schedule's
+    # warmup/cooldown are likewise single-direction.
+    warm_end = n_stages - 1
+    fwd_end = n + n_stages - 1
     n_ticks = n + 2 * (n_stages - 1)
     lf, loss_has_params = _normalize_loss_fn(loss_fn)
 
@@ -315,77 +341,88 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
     bwd_msg0 = jax.tree.map(jnp.zeros_like, x0)
     grad0 = jax.tree.map(jnp.zeros_like, params)
 
-    def tick(carry, t):
-        buf, xbuf, fwd_msg, bwd_msg, grad_acc, loss_acc = carry
+    def tick(carry, t, *, do_fwd, do_bwd):
+        buf, xbuf, fwd_msg, bwd_msg, dy_hold, grad_acc, loss_acc = carry
         last = stage == n_stages - 1
 
-        # ---- forward half: microbatch t - stage --------------------------
-        f_pos = t - stage
-        f_valid = (f_pos >= 0) & (f_pos < n)
-        mb = _microbatch(batch, jnp.clip(f_pos, 0, n - 1))
-        x = jax.tree.map(
-            lambda inj, msg: jnp.where(stage == 0, inj, msg),
-            input_fn(mb), fwd_msg)
-        y, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, mb), params, x)
-        _, consts = jax.closure_convert(vjp, y)
-        _check_consts(consts, inv_map, buf_shapes, p_leaves,
-                      where_tag="scan body")
+        if do_fwd:
+            # ---- forward half: microbatch t - stage ----------------------
+            f_pos = t - stage
+            f_valid = (f_pos >= 0) & (f_pos < n)
+            mb = _microbatch(batch, jnp.clip(f_pos, 0, n - 1))
+            x = jax.tree.map(
+                lambda inj, msg: jnp.where(stage == 0, inj, msg),
+                input_fn(mb), fwd_msg)
+            y, vjp = jax.vjp(lambda p, xx: stage_fn(p, xx, mb), params, x)
+            _, consts = jax.closure_convert(vjp, y)
+            _check_consts(consts, inv_map, buf_shapes, p_leaves,
+                          where_tag="scan body")
 
-        # loss + its input cotangent (meaningful on the last stage only;
-        # other stages compute it masked — lockstep SPMD).  A 3-arg
-        # loss_fn(y, mb, params) is differentiated wrt params too — the
-        # tied-embedding / parameterized-head path.
-        if loss_has_params:
-            loss, lvjp = jax.vjp(lambda p_, yy: lf(yy, mb, p_), params, y)
-            dp_loss, dy_local = lvjp(jnp.asarray(1.0 / n, loss.dtype))
-        else:
-            loss, lvjp = jax.vjp(lambda yy: lf(yy, mb, None), y)
-            (dy_local,) = lvjp(jnp.asarray(1.0 / n, loss.dtype))
-            dp_loss = None
-        loss_acc = loss_acc + jnp.where(f_valid & last, loss, 0.0)
-        if dp_loss is not None:
+            # loss + its input cotangent (meaningful on the last stage
+            # only; other stages compute it masked — lockstep SPMD).  A
+            # 3-arg loss_fn(y, mb, params) is differentiated wrt params
+            # too — the tied-embedding / parameterized-head path.
+            if loss_has_params:
+                loss, lvjp = jax.vjp(
+                    lambda p_, yy: lf(yy, mb, p_), params, y)
+                dp_loss, dy_hold = lvjp(jnp.asarray(1.0 / n, loss.dtype))
+            else:
+                loss, lvjp = jax.vjp(lambda yy: lf(yy, mb, None), y)
+                (dy_hold,) = lvjp(jnp.asarray(1.0 / n, loss.dtype))
+                dp_loss = None
+            loss_acc = loss_acc + jnp.where(f_valid & last, loss, 0.0)
+            if dp_loss is not None:
+                grad_acc = jax.tree.map(
+                    lambda a, d: a + jnp.where(f_valid & last, d,
+                                               jnp.zeros_like(d)),
+                    grad_acc, dp_loss)
+
+            # stash hoisted (inexact) residuals + the stage input at slot
+            # t % depth
+            buffered = [c for c, j in zip(consts, inv_map) if j < 0]
+            buf = [b.at[t % depth].set(c) for b, c in zip(buf, buffered)]
+            xbuf = jax.tree.map(
+                lambda b, c: b.at[t % depth].set(c), xbuf, x)
+            fwd_msg = p2p.send_forward_recv_forward(y, axis_name=axis_name)
+
+        if do_bwd:
+            # ---- backward half: microbatch t - 2*(pp-1) + stage ----------
+            b_pos = t - 2 * (n_stages - 1) + stage
+            b_valid = (b_pos >= 0) & (b_pos < n)
+            # that microbatch's forward ran at tick f = b_pos + stage, i.e.
+            # slot (t + 1 + 2*stage) % depth; on the last stage this IS
+            # the slot written above (gap 0) — its dy is this tick's
+            # dy_hold, and last-stage backwards never reach the cooldown
+            # phase (their last one runs at tick n+pp-2), so a cooldown
+            # tick's stale dy_hold is always masked by b_valid/last.
+            slot_r = (t + 1 + 2 * stage) % depth
+            mb_b = _microbatch(batch, jnp.clip(b_pos, 0, n - 1))
+            x_b = jax.tree.map(lambda b: b[slot_r], xbuf)
+            vjp_fn_b, consts_b = _rebuild_vjp(
+                stage_fn, mb_b, params, x_b, inv_map, buf_shapes, buf,
+                slot_r, where_tag="1f1b bwd")
+            dy = jax.tree.map(
+                lambda dl, msg: jnp.where(last, dl, msg), dy_hold, bwd_msg)
+            dparams, dx = vjp_fn_b(dy, *consts_b)
             grad_acc = jax.tree.map(
-                lambda a, d: a + jnp.where(f_valid & last, d,
-                                           jnp.zeros_like(d)),
-                grad_acc, dp_loss)
+                lambda a, d: a + jnp.where(b_valid, d, jnp.zeros_like(d)),
+                grad_acc, dparams)
+            bwd_msg = p2p.send_backward_recv_backward(
+                dx, axis_name=axis_name)
 
-        # stash hoisted (inexact) residuals + the stage input at slot
-        # t % depth
-        buffered = [c for c, j in zip(consts, inv_map) if j < 0]
-        buf = [b.at[t % depth].set(c) for b, c in zip(buf, buffered)]
-        xbuf = jax.tree.map(lambda b, c: b.at[t % depth].set(c), xbuf, x)
-
-        # ---- backward half: microbatch t - 2*(pp-1) + stage --------------
-        b_pos = t - 2 * (n_stages - 1) + stage
-        b_valid = (b_pos >= 0) & (b_pos < n)
-        # that microbatch's forward ran at tick f = b_pos + stage, i.e.
-        # slot (t + 1 + 2*stage) % depth; on the last stage this IS the
-        # slot written above (gap 0), already holding this tick's consts.
-        slot_r = (t + 1 + 2 * stage) % depth
-        mb_b = _microbatch(batch, jnp.clip(b_pos, 0, n - 1))
-        x_b = jax.tree.map(lambda b: b[slot_r], xbuf)
-        vjp_fn_b, consts_b = _rebuild_vjp(
-            stage_fn, mb_b, params, x_b, inv_map, buf_shapes, buf, slot_r,
-            where_tag="1f1b bwd")
-        dy = jax.tree.map(
-            lambda dl, msg: jnp.where(last, dl, msg), dy_local, bwd_msg)
-        dparams, dx = vjp_fn_b(dy, *consts_b)
-        grad_acc = jax.tree.map(
-            lambda a, d: a + jnp.where(b_valid, d, jnp.zeros_like(d)),
-            grad_acc, dparams)
-
-        # ---- ring messages: the 1F1B steady-state pair -------------------
-        fwd_msg, bwd_msg = p2p.send_forward_recv_backward(
-            y, dx, axis_name=axis_name)
-        return (buf, xbuf, fwd_msg, bwd_msg, grad_acc, loss_acc), None
+        return (buf, xbuf, fwd_msg, bwd_msg, dy_hold, grad_acc, loss_acc)
 
     xbuf0 = jax.tree.map(
         lambda a: jnp.zeros((depth,) + a.shape, a.dtype), x0)
-    (_, _, _, _, grads, loss_acc), _ = jax.lax.scan(
-        tick,
-        (buf0, xbuf0, fwd_msg0, bwd_msg0, grad0,
-         jnp.zeros((), jnp.float32)),
-        jnp.arange(n_ticks))
+    carry = (buf0, xbuf0, fwd_msg0, bwd_msg0,
+             jax.tree.map(jnp.zeros_like, x0), grad0,
+             jnp.zeros((), jnp.float32))
+    carry = _phase_scan(tick, carry, 0, warm_end, do_fwd=True, do_bwd=False)
+    carry = _phase_scan(tick, carry, warm_end, fwd_end,
+                        do_fwd=True, do_bwd=True)
+    carry = _phase_scan(tick, carry, fwd_end, n_ticks,
+                        do_fwd=False, do_bwd=True)
+    _, _, _, _, _, grads, loss_acc = carry
     return loss_acc / n, grads
 
 
@@ -579,19 +616,12 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
         bwd_msg = p2p.send_backward_recv_backward(dx, axis_name=axis_name)
         return (buf, xbuf, fwd_msg, bwd_msg, carry[4], grad_acc, loss_acc)
 
-    def phase(carry, lo, hi, *, do_fwd, do_bwd):
-        if hi <= lo:
-            return carry
-
-        def tick(carry, t):
-            prev_dy_in = carry[4]  # last tick's loss cotangent
-            if do_fwd:
-                carry = fwd_half(carry, t)
-            if do_bwd:
-                carry = bwd_half(carry, t, prev_dy_in)
-            return carry, None
-
-        carry, _ = jax.lax.scan(tick, carry, jnp.arange(lo, hi))
+    def tick(carry, t, *, do_fwd, do_bwd):
+        prev_dy_in = carry[4]  # last tick's loss cotangent
+        if do_fwd:
+            carry = fwd_half(carry, t)
+        if do_bwd:
+            carry = bwd_half(carry, t, prev_dy_in)
         return carry
 
     buf0 = [jnp.zeros((depth,) + shape, dtype)
@@ -605,11 +635,13 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
              jnp.zeros((), jnp.float32))
 
     if forward_only:
-        carry = phase(carry, 0, f_end, do_fwd=True, do_bwd=False)
+        carry = _phase_scan(tick, carry, 0, f_end,
+                            do_fwd=True, do_bwd=False)
         return carry[-1] / n, None
-    carry = phase(carry, 0, t0, do_fwd=True, do_bwd=False)
-    carry = phase(carry, t0, f_end, do_fwd=True, do_bwd=True)
-    carry = phase(carry, f_end, total, do_fwd=False, do_bwd=True)
+    carry = _phase_scan(tick, carry, 0, t0, do_fwd=True, do_bwd=False)
+    carry = _phase_scan(tick, carry, t0, f_end, do_fwd=True, do_bwd=True)
+    carry = _phase_scan(tick, carry, f_end, total,
+                        do_fwd=False, do_bwd=True)
     _, _, _, _, _, grads, loss_acc = carry
     return loss_acc / n, grads
 

@@ -1,0 +1,46 @@
+"""L0 test runner (reference: ``tests/L0/run_test.py`` — selects test
+subdirectories with ``--include`` and runs them as one suite).
+
+The reference drives ``unittest.TestLoader`` over
+``run_amp / run_fp16util / run_optimizers / run_fused_layer_norm / ...``;
+this repo's suites are pytest files in the same per-area layout, so the
+runner shells out to pytest with the selected directories.
+
+Usage::
+
+    python tests/L0/run_test.py                       # every L0 area
+    python tests/L0/run_test.py --include run_amp run_optimizers
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+L0_DIR = os.path.dirname(os.path.abspath(__file__))
+
+TEST_DIRS = sorted(
+    d for d in os.listdir(L0_DIR)
+    if d.startswith("run_") and os.path.isdir(os.path.join(L0_DIR, d)))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="apex_tpu L0 test runner")
+    p.add_argument("--include", nargs="+", default=TEST_DIRS,
+                   choices=TEST_DIRS, metavar="DIR",
+                   help=f"subset of {TEST_DIRS}")
+    p.add_argument("-x", "--exitfirst", action="store_true",
+                   help="stop on first failure")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    cmd = [sys.executable, "-m", "pytest", "-q"]
+    if args.exitfirst:
+        cmd.append("-x")
+    cmd += [os.path.join(L0_DIR, d) for d in args.include]
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
