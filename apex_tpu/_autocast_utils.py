@@ -1,0 +1,36 @@
+"""Autocast dtype helpers shared by the op wrappers.
+
+Reference: ``apex/_autocast_utils.py :: _cast_if_autocast_enabled`` — casts
+an argument pack to ``torch.get_autocast_gpu_dtype()`` when autocast is on,
+so extension entry points behave like autocast-aware torch ops.
+
+TPU mapping: "autocast enabled" is an ACTIVE O1 amp handle (the patched-
+function regime of ``apex_tpu.amp``); the autocast dtype is bf16.  Arrays
+already in a 16-bit dtype, non-floating arrays, and non-array args pass
+through untouched — the same widest-dtype-wins rules as the reference.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+__all__ = ["_cast_if_autocast_enabled", "_get_autocast_dtype"]
+
+
+def _get_autocast_dtype():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def _is_fp32_array(x) -> bool:
+    import jax.numpy as jnp
+    return (hasattr(x, "dtype") and hasattr(x, "astype")
+            and x.dtype == jnp.float32)
+
+
+def _cast_if_autocast_enabled(*args) -> Sequence:
+    """Cast fp32 array args to bf16 iff an O1 amp handle is active."""
+    from apex_tpu.amp import amp as _amp
+    if not _amp._is_active():
+        return args
+    dtype = _get_autocast_dtype()
+    return tuple(a.astype(dtype) if _is_fp32_array(a) else a for a in args)

@@ -1,0 +1,26 @@
+"""RNN compatibility shims for O1 patching (reference:
+``apex/amp/rnn_compat.py`` — wraps torch's legacy RNN backend factories so
+patched-function autocast reaches RNN cells).
+
+The legacy fused-RNN surface this patched (``apex.RNN``) is deprecated in
+the reference and tombstoned here (see ``apex_tpu/RNN``); modern recurrent
+models run through scan + the patched functional ops, which O1 already
+covers.  The module keeps the reference's probe helper so callers can
+feature-test it.
+"""
+from __future__ import annotations
+
+__all__ = ["has_old_rnns", "whitelist_rnn_cells"]
+
+
+def has_old_rnns() -> bool:
+    """The legacy torch RNN backend the reference patches does not exist
+    on this stack (reference probes ``torch.nn.backends.thnn``)."""
+    return False
+
+
+def whitelist_rnn_cells(handle, verbose: bool = False) -> None:
+    """No-op: RNN cells route through already-patched functional ops
+    (reference registers fp16 casts on the legacy cell backends)."""
+    if verbose:
+        print("apex_tpu.amp.rnn_compat: no legacy RNN backend to patch")

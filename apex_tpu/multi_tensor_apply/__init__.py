@@ -19,7 +19,8 @@ from apex_tpu.ops import fused_update as _fu
 from apex_tpu.utils import tree_ravel
 
 __all__ = ["MultiTensorApply", "multi_tensor_applier",
-           "multi_tensor_scale", "multi_tensor_axpby", "multi_tensor_l2norm"]
+           "multi_tensor_scale", "multi_tensor_axpby",
+           "multi_tensor_l2norm", "multi_tensor_l2norm_scale"]
 
 
 def _ravel_list(tensors: Sequence[jax.Array]):
@@ -43,6 +44,11 @@ def multi_tensor_axpby(noop_flag, tensor_lists, a, b):
     return unravel(out), jnp.maximum(jnp.asarray(noop_flag, jnp.float32), flag)
 
 
+def _per_tensor_norms(tensors):
+    return jnp.stack([jnp.sqrt(jnp.sum(jnp.square(
+        t.astype(jnp.float32)))) for t in tensors])
+
+
 def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
     """Global (and optionally per-tensor) L2 norm of a tensor list.
 
@@ -52,10 +58,24 @@ def multi_tensor_l2norm(noop_flag, tensor_lists, per_tensor=False):
     flat, _ = _ravel_list(tensors)
     gnorm = _fu.fused_l2norm(flat)
     if per_tensor:
-        per = jnp.stack([jnp.sqrt(jnp.sum(jnp.square(
-            t.astype(jnp.float32)))) for t in tensors])
-        return gnorm, per
+        return gnorm, _per_tensor_norms(tensors)
     return gnorm, None
+
+
+def multi_tensor_l2norm_scale(noop_flag, tensor_lists, scale,
+                              per_tensor=False):
+    """Scale the list AND return the L2 norm of the scaled values in one
+    fused pass (parity: ``amp_C.multi_tensor_l2norm_scale``).  Returns
+    ``(outs, gnorm, per_tensor_norms, found_inf)`` — the flag keeps the
+    unscale path's skip-on-overflow contract, like the sibling ops."""
+    tensors = tensor_lists[0]
+    flat, unravel = _ravel_list(tensors)
+    out, gnorm, flag = _fu.fused_l2norm_scale(flat, scale)
+    outs = unravel(out)
+    found_inf = jnp.maximum(jnp.asarray(noop_flag, jnp.float32), flag)
+    if per_tensor:
+        return outs, gnorm, _per_tensor_norms(outs), found_inf
+    return outs, gnorm, None, found_inf
 
 
 class MultiTensorApply:

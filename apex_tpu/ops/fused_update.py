@@ -50,6 +50,7 @@ __all__ = [
     "fused_scale",
     "fused_axpby",
     "fused_l2norm",
+    "fused_l2norm_scale",
     "fused_adam_flat",
     "fused_adagrad_flat",
     "fused_sgd_flat",
@@ -211,6 +212,44 @@ def fused_l2norm(flat: jax.Array) -> jax.Array:
         interpret=interpret_mode(),
     )(x2)
     return jnp.sqrt(jnp.sum(acc))
+
+
+def _l2norm_scale_kernel(n, x_ref, hp_ref, o_ref, acc_ref, flag_ref):
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32) * hp_ref[0]
+    xm = _tail_mask(i, n, x, 0.0)
+    acc_ref[0] = jnp.sum(xm * xm)
+    flag_ref[0] = jnp.any(~jnp.isfinite(xm)).astype(jnp.float32)
+    o_ref[...] = x.astype(o_ref.dtype)
+
+
+def fused_l2norm_scale(flat: jax.Array, scale, out_dtype=None):
+    """``out = flat * scale`` AND the L2 norm of the scaled buffer, in one
+    pass (parity: ``amp_C.multi_tensor_l2norm_scale`` — the reference
+    fuses gradient unscaling with the norm the clipper needs, halving
+    the HBM traffic of scale-then-norm).  Returns ``(out, norm,
+    found_inf)`` — the non-finite flag keeps the unscale path's
+    skip-on-overflow contract (same as :func:`fused_scale`).
+    """
+    out_dtype = out_dtype or flat.dtype
+    x2, n = flat, flat.shape[0]
+    if n == 0:
+        return flat.astype(out_dtype), jnp.float32(0.0), jnp.float32(0.0)
+    hp = jnp.asarray([scale], jnp.float32)
+    out, acc, flags = pl.pallas_call(
+        functools.partial(_l2norm_scale_kernel, n),
+        grid=(_grid(x2),),
+        in_specs=[_vspec(), _sspec()],
+        out_specs=[_vspec(), _bspec(), _bspec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, out_dtype),
+            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+        ],
+        compiler_params=_PAR,
+        interpret=interpret_mode(),
+    )(x2, hp)
+    return out, jnp.sqrt(jnp.sum(acc)), jnp.max(flags)
 
 
 # ---------------------------------------------------------------------------

@@ -8,6 +8,11 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     interleaved_phase_ticks,
 )
 from apex_tpu.transformer.pipeline_parallel import p2p_communication
+from apex_tpu.transformer.pipeline_parallel.common import (
+    build_model,
+    forward_step,
+    backward_step,
+)
 from apex_tpu.transformer.pipeline_parallel.utils import (
     setup_microbatch_calculator,
     get_num_microbatches,
@@ -25,6 +30,9 @@ __all__ = [
     "embedding_grads_all_reduce",
     "interleaved_phase_ticks",
     "p2p_communication",
+    "build_model",
+    "forward_step",
+    "backward_step",
     "setup_microbatch_calculator",
     "get_num_microbatches",
     "get_current_global_batch_size",

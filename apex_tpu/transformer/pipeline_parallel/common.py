@@ -1,0 +1,81 @@
+"""Common pipeline-schedule machinery (reference:
+``apex/transformer/pipeline_parallel/schedules/common.py`` —
+``build_model``, ``forward_step``, ``backward_step``).
+
+The executors in ``schedules.py`` fuse these building blocks into
+``lax.scan`` ticks (a hand-written Python loop over them would defeat
+XLA); they are exported standalone so Megatron-style driver code that
+composes its own schedule — or tests that want one microbatch's
+forward/backward in isolation — has the reference surface.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+
+__all__ = ["build_model", "forward_step", "backward_step"]
+
+
+def build_model(model_provider_func: Callable,
+                wrap_with_ddp: bool = True,
+                virtual_pipeline_model_parallel_size: Optional[int] = None,
+                *args, **kwargs) -> List:
+    """Build this pipeline rank's model chunk(s) (reference:
+    ``common.py :: build_model``).
+
+    The provider is called as ``model_provider_func(*args,
+    pre_process=..., post_process=..., **kwargs)`` — ``pre_process`` true
+    when the chunk can host the first virtual stage (embedding lives
+    there), ``post_process`` when it can host the last (loss head).
+
+    SPMD note: the reference runs one process per rank, so its flags are
+    per-RANK; here the host program is rank-agnostic (pipeline rank only
+    exists inside ``shard_map`` — see ``parallel_state``), so flags are
+    per-CHUNK: chunk 0 gets ``pre_process`` (it contains virtual stage 0,
+    which lives on rank 0), chunk ``v-1`` gets ``post_process``; the
+    executors mask the embedding/loss paths to the right rank at run time
+    via ``axis_index``, exactly as they do for the loss today.
+
+    ``wrap_with_ddp`` is accepted for parity: gradient reduction is a
+    function of the training step here (``DistributedDataParallel.
+    reduce_gradients`` / ``flat_allreduce``), not a module wrapper.
+    """
+    v = virtual_pipeline_model_parallel_size
+    if v is not None and v > 1:
+        return [model_provider_func(
+            *args, pre_process=(chunk == 0),
+            post_process=(chunk == v - 1), **kwargs)
+            for chunk in range(v)]
+    return [model_provider_func(
+        *args, pre_process=True, post_process=True, **kwargs)]
+
+
+def forward_step(stage_fn: Callable, params, input_tensor, microbatch,
+                 loss_fn: Optional[Callable] = None,
+                 losses_reduced: Optional[list] = None):
+    """One microbatch through one stage (reference: ``common.py ::
+    forward_step`` — runs the module, collects the loss on the last
+    stage).  Returns the stage output; when ``loss_fn`` is given (last
+    stage), the loss is computed and appended to ``losses_reduced``.
+    """
+    output = stage_fn(params, input_tensor, microbatch)
+    if loss_fn is not None:
+        loss = loss_fn(output, microbatch)
+        if losses_reduced is not None:
+            losses_reduced.append(loss)
+        return loss
+    return output
+
+
+def backward_step(stage_fn: Callable, params, input_tensor, microbatch,
+                  output_grad):
+    """One microbatch's backward through one stage (reference:
+    ``common.py :: backward_step`` — injects the received output grad
+    into autograd).  Functional: returns ``(input_grad, param_grads)``
+    from ``jax.vjp`` instead of mutating ``.grad`` fields.
+    """
+    _, vjp = jax.vjp(
+        lambda p, x: stage_fn(p, x, microbatch), params, input_tensor)
+    dparams, dx = vjp(output_grad)
+    return dx, dparams

@@ -1,0 +1,138 @@
+"""schedules/common machinery + parity shims (reference:
+``pipeline_parallel/schedules/common.py``, ``apex/_autocast_utils.py``,
+``amp_C.multi_tensor_l2norm_scale``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    backward_step,
+    build_model,
+    forward_step,
+    listify_model,
+)
+
+
+@pytest.fixture
+def pp4():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=4)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+class TestBuildModel:
+    def test_single_chunk(self):
+        parallel_state.destroy_model_parallel()
+        calls = []
+
+        def provider(pre_process=False, post_process=False):
+            calls.append((pre_process, post_process))
+            return "model"
+
+        models = build_model(provider)
+        assert models == ["model"]
+        assert calls == [(True, True)]          # pp=1: both ends
+        assert listify_model(models) == ["model"]
+
+    def test_virtual_chunks(self, pp4):
+        """v=2: chunk 0 hosts virtual stage 0 (pre), chunk 1 the last
+        virtual stage (post); rank masking happens at apply time."""
+        calls = []
+
+        def provider(pre_process=False, post_process=False):
+            calls.append((pre_process, post_process))
+            return len(calls) - 1
+
+        models = build_model(
+            provider, virtual_pipeline_model_parallel_size=2)
+        assert models == [0, 1]
+        assert calls == [(True, False), (False, True)]
+
+
+class TestForwardBackwardStep:
+    def _stage(self, p, x, mb):
+        return jnp.tanh(x @ p["w"])
+
+    def test_forward_and_backward_match_vjp(self):
+        rng = np.random.RandomState(0)
+        p = {"w": jnp.asarray(rng.randn(8, 8), jnp.float32)}
+        x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        y = forward_step(self._stage, p, x, None)
+        np.testing.assert_allclose(
+            np.asarray(y), np.tanh(np.asarray(x) @ np.asarray(p["w"])),
+            rtol=1e-6)
+
+        dy = jnp.ones_like(y)
+        dx, dp = backward_step(self._stage, p, x, None, dy)
+        # oracle via plain grad of sum
+        want_dx, want_dp = jax.grad(
+            lambda xx, pp: jnp.sum(self._stage(pp, xx, None)),
+            argnums=(0, 1))(x, p)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(dp["w"]),
+                                   np.asarray(want_dp["w"]), rtol=1e-5)
+
+    def test_forward_step_collects_loss(self):
+        p = {"w": jnp.eye(4)}
+        x = jnp.ones((2, 4))
+        losses = []
+        loss = forward_step(self._stage, p, x, None,
+                            loss_fn=lambda y, mb: jnp.sum(y),
+                            losses_reduced=losses)
+        assert len(losses) == 1 and losses[0] is loss
+
+
+class TestL2NormScale:
+    def test_fused_matches_two_pass(self):
+        from apex_tpu.multi_tensor_apply import multi_tensor_l2norm_scale
+        rng = np.random.RandomState(1)
+        ts = [jnp.asarray(rng.randn(1000), jnp.float32),
+              jnp.asarray(rng.randn(77), jnp.float32)]
+        outs, gnorm, per, flag = multi_tensor_l2norm_scale(
+            0.0, [ts], 0.5, per_tensor=True)
+        cat = np.concatenate([np.asarray(t) * 0.5 for t in ts])
+        np.testing.assert_allclose(float(gnorm), np.linalg.norm(cat),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   np.asarray(ts[0]) * 0.5, rtol=1e-6)
+        assert per.shape == (2,)
+        assert float(flag) == 0.0
+
+    def test_flags_non_finite(self):
+        from apex_tpu.multi_tensor_apply import multi_tensor_l2norm_scale
+        ts = [jnp.asarray([1.0, jnp.inf, 3.0], jnp.float32)]
+        _, _, _, flag = multi_tensor_l2norm_scale(0.0, [ts], 1.0)
+        assert float(flag) == 1.0
+
+
+class TestAutocastUtils:
+    def test_cast_only_when_active(self):
+        from apex_tpu._autocast_utils import _cast_if_autocast_enabled
+        from apex_tpu.amp import amp as amp_mod
+        x = jnp.ones((4,), jnp.float32)
+        # inactive: passthrough
+        (y,) = _cast_if_autocast_enabled(x)
+        assert y.dtype == jnp.float32
+        # active handle: fp32 -> bf16, bf16/int/non-array untouched
+        handle = amp_mod.AmpHandle()
+        amp_mod._current_handle = handle
+        try:
+            a, b, c, d = _cast_if_autocast_enabled(
+                x, x.astype(jnp.bfloat16), jnp.arange(3), "s")
+            assert a.dtype == jnp.bfloat16
+            assert b.dtype == jnp.bfloat16
+            assert c.dtype == jnp.int32
+            assert d == "s"
+        finally:
+            handle._deactivate()
+
+
+def test_rnn_compat_probe():
+    from apex_tpu.amp import rnn_compat
+    assert rnn_compat.has_old_rnns() is False
+    rnn_compat.whitelist_rnn_cells(None)
