@@ -47,6 +47,8 @@ __all__ = [
     "get_data_parallel_group",
     "get_context_parallel_group",
     "get_embedding_group",
+    "get_position_embedding_group",
+    "get_amax_reduction_group",
     "get_tensor_model_parallel_world_size",
     "get_pipeline_model_parallel_world_size",
     "get_data_parallel_world_size",
@@ -168,6 +170,25 @@ def get_embedding_group() -> str:
     """
     get_mesh()
     return PIPE_AXIS
+
+
+def get_position_embedding_group() -> str:
+    """Reference: _POSITION_EMBEDDING_GROUP — ranks holding the (tied)
+    position embedding, a subset of the embedding group's pipe ranks; the
+    same masked-psum-over-pipe pattern applies (mask to the first stage)."""
+    get_mesh()
+    return PIPE_AXIS
+
+
+def get_amax_reduction_group() -> tuple:
+    """Reference: _AMAX_REDUCTION_GROUP — the FP8 amax statistics are
+    reduced over every rank sharing the same weights' numerics: data and
+    context replicas (each sees a different batch/sequence shard of the
+    same weights) plus the tensor shards.  Mesh-native that is a psum
+    over those axes, so the "group" is the axis tuple accepted by
+    ``jax.lax.psum``."""
+    get_mesh()
+    return (DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 
 # --- static world sizes -----------------------------------------------------

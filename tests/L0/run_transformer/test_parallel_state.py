@@ -82,3 +82,27 @@ def test_virtual_pipeline_bookkeeping():
     assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
     # non-zero virtual rank means "not the first model chunk"
     assert parallel_state.is_pipeline_first_stage() is False
+
+
+def test_group_getters_cover_reference_surface():
+    """Reference builds _EMBEDDING/_POSITION_EMBEDDING/_AMAX_REDUCTION
+    groups; here groups ARE mesh axis names usable with psum."""
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2)
+    assert parallel_state.get_embedding_group() == "pipe"
+    assert parallel_state.get_position_embedding_group() == "pipe"
+    amax = parallel_state.get_amax_reduction_group()
+    assert set(amax) == {"data", "context", "tensor"}
+    # usable as a psum axis spec
+    from jax.sharding import PartitionSpec as P
+    mesh = parallel_state.get_mesh()
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P("data"), out_specs=P("data"),
+                       check_vma=False)
+    def reduce(x):
+        return jax.lax.psum(x, amax)
+
+    out = reduce(jnp.ones((8, 2)))
+    # psum over data(2) x context(1) x tensor(2) = 4
+    np.testing.assert_allclose(np.asarray(out), 4.0)

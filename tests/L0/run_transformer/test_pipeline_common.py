@@ -115,13 +115,16 @@ class TestAutocastUtils:
         from apex_tpu._autocast_utils import _cast_if_autocast_enabled
         from apex_tpu.amp import amp as amp_mod
         x = jnp.ones((4,), jnp.float32)
-        # inactive: passthrough
-        (y,) = _cast_if_autocast_enabled(x)
-        assert y.dtype == jnp.float32
-        # active handle: fp32 -> bf16, bf16/int/non-array untouched
-        handle = amp_mod.AmpHandle()
-        amp_mod._current_handle = handle
+        # isolate from any handle an earlier amp test left active
+        saved = amp_mod._current_handle
+        amp_mod._current_handle = None
         try:
+            # inactive: passthrough
+            (y,) = _cast_if_autocast_enabled(x)
+            assert y.dtype == jnp.float32
+            # active handle: fp32 -> bf16, bf16/int/non-array untouched
+            handle = amp_mod.AmpHandle()
+            amp_mod._current_handle = handle
             a, b, c, d = _cast_if_autocast_enabled(
                 x, x.astype(jnp.bfloat16), jnp.arange(3), "s")
             assert a.dtype == jnp.bfloat16
@@ -129,7 +132,7 @@ class TestAutocastUtils:
             assert c.dtype == jnp.int32
             assert d == "s"
         finally:
-            handle._deactivate()
+            amp_mod._current_handle = saved
 
 
 def test_rnn_compat_probe():

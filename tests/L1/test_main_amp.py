@@ -37,3 +37,15 @@ def test_loss_decreases(opt_level):
 def test_static_loss_scale_runs():
     losses = _run("O2", ("--loss-scale", "128.0"))
     assert np.all(np.isfinite(losses))
+
+
+def test_baseline_config0_resnet50_o0():
+    """BASELINE.json configs[0] literally: ResNet-50, --opt-level O0, CPU,
+    runs unmodified and the loss decreases."""
+    argv = ["--synthetic", "--arch", "resnet50", "-b", "8",
+            "--iters", "5", "--epochs", "3", "--image-size", "32",
+            "--num-classes", "8", "--lr", "0.002", "--print-freq", "100",
+            "--opt-level", "O0"]
+    losses = main(argv)
+    assert np.all(np.isfinite(losses))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
