@@ -5,6 +5,12 @@ TPU has no user-visible direct-storage path (transfers stage through host
 RAM under XLA's control), so the equivalent capability is overlap: async
 host-side file I/O feeding ``jax.device_put``.  ``load_data``/``save_data``
 keep the reference's names; the async variants return futures.
+
+Native path: when the ``_gds_C`` extension is built
+(``APEX_TPU_CPP_EXT=1``, ``csrc/async_io.c``), reads/writes go through
+GIL-releasing pread/pwrite loops so the thread pool overlaps storage I/O
+with compute and device transfers — the role cuFile's DMA engine plays in
+the reference.  Falls back to plain Python file I/O.
 """
 from __future__ import annotations
 
@@ -14,7 +20,15 @@ import os
 import jax
 import numpy as np
 
-__all__ = ["load_data", "save_data", "load_data_async", "save_data_async"]
+try:
+    from apex_tpu import _gds_C
+    HAVE_GDS_C = True
+except ImportError:
+    _gds_C = None
+    HAVE_GDS_C = False
+
+__all__ = ["load_data", "save_data", "load_data_async", "save_data_async",
+           "HAVE_GDS_C"]
 
 _POOL = concurrent.futures.ThreadPoolExecutor(max_workers=4)
 
@@ -22,21 +36,39 @@ _POOL = concurrent.futures.ThreadPoolExecutor(max_workers=4)
 def save_data(t, filename: str, offset: int = 0):
     """Write a device array's bytes to file (reference:
     ``gds.save_data(tensor, filename)``)."""
-    arr = np.asarray(t)
+    arr = np.ascontiguousarray(np.asarray(t))
+    if HAVE_GDS_C:
+        _gds_C.write_from(filename, memoryview(arr).cast("B"), offset)
+        return
     mode = "r+b" if os.path.exists(filename) else "wb"
     with open(filename, mode) as f:
         f.seek(offset)
-        f.write(arr.tobytes())
+        f.write(memoryview(arr).cast("B"))
 
 
 def load_data(t, filename: str, offset: int = 0):
     """Read bytes into a NEW device array shaped/typed like ``t``
     (functional: JAX arrays are immutable; the reference fills in place)."""
-    like = np.asarray(t)
+    # only the template's shape/dtype are needed — never copy it to host
+    shape, dtype = t.shape, np.dtype(t.dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if HAVE_GDS_C:
+        arr = np.empty(shape, dtype)
+        nread = _gds_C.read_into(
+            filename, memoryview(arr).cast("B"), offset)
+        if nread != nbytes:
+            raise EOFError(
+                f"{filename}: read {nread} of {nbytes} bytes "
+                f"at offset {offset}")
+        return jax.device_put(arr)
     with open(filename, "rb") as f:
         f.seek(offset)
-        buf = f.read(like.nbytes)
-    arr = np.frombuffer(buf, dtype=like.dtype).reshape(like.shape)
+        buf = f.read(nbytes)
+    if len(buf) != nbytes:
+        raise EOFError(
+            f"{filename}: read {len(buf)} of {nbytes} bytes "
+            f"at offset {offset}")
+    arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
     return jax.device_put(arr)
 
 

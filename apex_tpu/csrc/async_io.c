@@ -1,0 +1,133 @@
+/* _gds_C: GIL-releasing positional file I/O.
+ *
+ * Reference: apex/contrib/csrc/gpu_direct_storage/ (cuFile — storage<->GPU
+ * DMA bypassing host bounce buffers).  TPU has no user-visible direct
+ * storage path (XLA owns device transfers), so the native capability that
+ * remains is OVERLAP: file bytes must stream while Python-side compute and
+ * device transfers proceed.  Plain Python file I/O holds the GIL across
+ * kernel copies into userspace; these entry points release it around
+ * pread/pwrite loops so the gpu_direct_storage thread pool achieves real
+ * concurrency (N readers saturating storage while jax.device_put runs).
+ *
+ * Contract (mirrors the posix calls):
+ *   read_into(path, writable_buffer, offset)  -> bytes_read
+ *   write_from(path, readonly_buffer, offset) -> bytes_written (creates,
+ *                                                never truncates)
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+static PyObject *
+py_read_into(PyObject *self, PyObject *args)
+{
+    const char *path;
+    Py_buffer buf;
+    long long offset;
+    if (!PyArg_ParseTuple(args, "sw*L", &path, &buf, &offset))
+        return NULL;
+
+    int fd = -1;
+    Py_ssize_t total = 0;
+    int saved_errno = 0;
+
+    Py_BEGIN_ALLOW_THREADS
+    fd = open(path, O_RDONLY);
+    if (fd < 0) {
+        saved_errno = errno;
+    } else {
+        char *p = (char *)buf.buf;
+        while (total < buf.len) {
+            ssize_t n = pread(fd, p + total, (size_t)(buf.len - total),
+                              (off_t)(offset + total));
+            if (n < 0) {
+                if (errno == EINTR)
+                    continue;
+                saved_errno = errno;
+                break;
+            }
+            if (n == 0)   /* EOF */
+                break;
+            total += n;
+        }
+        close(fd);
+    }
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&buf);
+    if (fd < 0 || saved_errno) {
+        errno = saved_errno;
+        return PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+    }
+    return PyLong_FromSsize_t(total);
+}
+
+static PyObject *
+py_write_from(PyObject *self, PyObject *args)
+{
+    const char *path;
+    Py_buffer buf;
+    long long offset;
+    if (!PyArg_ParseTuple(args, "sy*L", &path, &buf, &offset))
+        return NULL;
+
+    int fd = -1;
+    Py_ssize_t total = 0;
+    int saved_errno = 0;
+
+    Py_BEGIN_ALLOW_THREADS
+    fd = open(path, O_WRONLY | O_CREAT, 0644);
+    if (fd < 0) {
+        saved_errno = errno;
+    } else {
+        const char *p = (const char *)buf.buf;
+        while (total < buf.len) {
+            ssize_t n = pwrite(fd, p + total, (size_t)(buf.len - total),
+                               (off_t)(offset + total));
+            if (n < 0) {
+                if (errno == EINTR)
+                    continue;
+                saved_errno = errno;
+                break;
+            }
+            total += n;
+        }
+        close(fd);
+    }
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&buf);
+    if (fd < 0 || saved_errno) {
+        errno = saved_errno;
+        return PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+    }
+    return PyLong_FromSsize_t(total);
+}
+
+static PyMethodDef GdsMethods[] = {
+    {"read_into", py_read_into, METH_VARARGS,
+     "read_into(path, writable_buffer, offset) -> bytes_read; GIL "
+     "released around the pread loop"},
+    {"write_from", py_write_from, METH_VARARGS,
+     "write_from(path, buffer, offset) -> bytes_written; creates the "
+     "file, never truncates; GIL released around the pwrite loop"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef gds_module = {
+    PyModuleDef_HEAD_INIT, "_gds_C",
+    "GIL-releasing positional file I/O for apex_tpu.contrib."
+    "gpu_direct_storage",
+    -1, GdsMethods,
+};
+
+PyMODINIT_FUNC
+PyInit__gds_C(void)
+{
+    return PyModule_Create(&gds_module);
+}

@@ -21,5 +21,12 @@ if os.environ.get("APEX_TPU_CPP_EXT", "0") == "1":
             extra_compile_args=["-O3"],
         )
     )
+    ext_modules.append(
+        Extension(
+            "apex_tpu._gds_C",
+            sources=["apex_tpu/csrc/async_io.c"],
+            extra_compile_args=["-O3"],
+        )
+    )
 
 setup(ext_modules=ext_modules)
