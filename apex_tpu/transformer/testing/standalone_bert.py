@@ -71,7 +71,8 @@ class BertModel(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, token_types=None, attention_mask=None,
-                 lm_labels=None, deterministic: bool = True):
+                 lm_labels=None, deterministic: bool = True,
+                 loss_mask=None):
         cfg = self.cfg
         gcfg = self.cfg.gpt_cfg()
         b, s = tokens.shape
@@ -134,8 +135,13 @@ class BertModel(nn.Module):
             return lm_logits, binary_logits
         loss = vocab_parallel_cross_entropy(
             lm_logits.astype(jnp.float32), lm_labels.T)
-        if attention_mask is not None:
-            w = attention_mask.T.astype(jnp.float32)
+        # loss weighting is SEPARATE from the attention padding mask
+        # (reference: pretrain scripts pass loss_mask for the 15% MLM
+        # positions while attention_mask covers padding); attention_mask
+        # doubles as the weight only when no loss_mask is given
+        w = loss_mask if loss_mask is not None else attention_mask
+        if w is not None:
+            w = w.T.astype(jnp.float32)
             loss = (loss * w).sum() / jnp.maximum(w.sum(), 1.0)
         else:
             loss = loss.mean()
