@@ -10,6 +10,9 @@ from apex_tpu.optimizers.fused_sgd import FusedSGD
 from apex_tpu.optimizers.fused_lamb import FusedLAMB
 from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
 from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (
+    FusedMixedPrecisionLamb,
+)
 
 __all__ = ["FusedOptimizerBase", "FusedAdam", "FusedSGD", "FusedLAMB",
-           "FusedAdagrad", "FusedNovoGrad"]
+           "FusedAdagrad", "FusedNovoGrad", "FusedMixedPrecisionLamb"]

@@ -32,7 +32,10 @@ from apex_tpu.transformer.tensor_parallel.random import (
     model_parallel_cuda_manual_seed,
     checkpoint,
 )
-from apex_tpu.transformer.utils import split_tensor_along_last_dim
+from apex_tpu.transformer.utils import (
+    split_tensor_along_last_dim,
+    VocabUtility,
+)
 
 __all__ = [
     "copy_to_tensor_model_parallel_region",
@@ -61,4 +64,5 @@ __all__ = [
     "model_parallel_cuda_manual_seed",
     "checkpoint",
     "split_tensor_along_last_dim",
+    "VocabUtility",
 ]
