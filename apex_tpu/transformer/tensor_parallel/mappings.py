@@ -41,10 +41,25 @@ __all__ = [
 ]
 
 
-def _is_identity(axis_name: str) -> bool:
-    """The reference's world_size==1 early-return — only valid when the
-    requested axis really is the (size-1) tensor axis; any other axis name
-    must go through the collectives (its size is only known when bound)."""
+def _is_identity(axis_name: str, *, vma_safe: bool = False) -> bool:
+    """The reference's world_size==1 early-return.
+
+    When the axis is BOUND (inside shard_map), its size is static and a
+    size-1 axis — whatever its name — can take the identity fast path,
+    but ONLY for ops whose identity form preserves shard_map's
+    varying-axes typing (``vma_safe``): a reduction op's psum also types
+    its output as replicated over the axis, which ``check_vma=True``
+    relies on, so reductions keep their collective (free at size 1 in
+    compiled code) and only the genuinely elementwise-identity ops skip
+    it.  Unbound (host code), there is no vma typing and the only size
+    known statically is the canonical tensor axis's from parallel_state.
+    """
+    try:
+        n = jax.lax.axis_size(axis_name)
+    except NameError:   # axis not bound here; fall back to mesh metadata
+        n = None
+    if n is not None:
+        return vma_safe and n == 1
     return (axis_name == TENSOR_AXIS
             and parallel_state.model_parallel_is_initialized()
             and parallel_state.get_tensor_model_parallel_world_size() == 1)
@@ -73,7 +88,7 @@ def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
     """Identity forward / psum backward (``_CopyToModelParallelRegion``).
     Entry point of ColumnParallelLinear: the activation is replicated across
     TP, so its grad is the sum of per-rank grads."""
-    if _is_identity(axis_name):
+    if _is_identity(axis_name, vma_safe=True):
         return x
 
     @jax.custom_vjp
@@ -105,7 +120,7 @@ def reduce_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
 def scatter_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
     """split last dim forward / all-gather backward
     (``_ScatterToModelParallelRegion``)."""
-    if _is_identity(axis_name):
+    if _is_identity(axis_name, vma_safe=True):
         return x
 
     @jax.custom_vjp
@@ -137,7 +152,7 @@ def gather_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
 def scatter_to_sequence_parallel_region(x, axis_name: str = TENSOR_AXIS):
     """split dim 0 forward / all-gather backward
     (``_ScatterToSequenceParallelRegion``); used for SP embedding output."""
-    if _is_identity(axis_name):
+    if _is_identity(axis_name, vma_safe=True):
         return x
 
     @jax.custom_vjp

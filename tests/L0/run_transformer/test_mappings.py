@@ -131,3 +131,39 @@ def test_tp1_identity():
                tensor_parallel.gather_from_sequence_parallel_region,
                tensor_parallel.reduce_scatter_to_sequence_parallel_region):
         np.testing.assert_allclose(fn(x), x)
+
+
+def test_size1_custom_axis_takes_identity_fast_path():
+    """A size-1 axis under ANY name must emit no collectives (the
+    reference's world_size==1 early-return, axis-size-based at bind
+    time rather than special-cased to the canonical tensor axis)."""
+    from jax.sharding import Mesh
+    from apex_tpu.transformer.tensor_parallel import mappings
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("mp",))
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                       out_specs=P(), check_vma=False)
+    def run(x):
+        # vma-SAFE ops (elementwise identity at size 1): fast path
+        y = mappings.copy_to_tensor_model_parallel_region(x, "mp")
+        y = mappings.scatter_to_tensor_model_parallel_region(y, "mp")
+        return jax.grad(lambda a: jnp.sum(
+            mappings.copy_to_tensor_model_parallel_region(a, "mp") ** 2))(
+            x) + y
+
+    x = jnp.ones((4, 4))
+    jaxpr = str(jax.make_jaxpr(run)(x))
+    assert "psum" not in jaxpr and "all_gather" not in jaxpr, (
+        "size-1 axis still emits collectives on vma-safe ops")
+    np.testing.assert_allclose(np.asarray(run(x)), 3.0)
+
+    # reduce_from KEEPS its psum (its replicated vma typing under the
+    # default check_vma=True is load-bearing; an identity fast path here
+    # fails the out_specs=P() replication check at trace time)
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P(),
+                       out_specs=P())
+    def run_reduce(x):
+        return mappings.reduce_from_tensor_model_parallel_region(x, "mp")
+
+    np.testing.assert_allclose(np.asarray(run_reduce(x)), 1.0)
