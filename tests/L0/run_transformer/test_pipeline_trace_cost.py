@@ -29,8 +29,7 @@ from apex_tpu.transformer.testing.standalone_gpt import (
 
 PP, HID, SEQ, BS, N_MICRO = 4, 64, 32, 2, 8
 
-TRACE_BUDGET_S = 10.0
-JAXPR_BUDGET_BYTES = 1_500_000
+
 
 
 @pytest.fixture
@@ -42,7 +41,8 @@ def setup():
     parallel_state.destroy_model_parallel()
 
 
-def test_1f1b_trace_cost_bounded_with_gpt_stage(setup):
+def _trace_budget(executor, label, trace_budget_s,
+                  jaxpr_budget_bytes, **kw):
     mesh = parallel_state.get_mesh()
     cfg = GPTConfig(vocab_size=128, hidden_size=HID, num_layers=PP,
                     num_attention_heads=4, max_seq_length=SEQ,
@@ -60,9 +60,9 @@ def test_1f1b_trace_cost_bounded_with_gpt_stage(setup):
         return jnp.mean((y - mb["t"]) ** 2)
 
     def body(p, b):
-        return forward_backward_pipelining_without_interleaving(
+        return executor(
             stage, loss, p, b, num_microbatches=N_MICRO,
-            input_fn=lambda mb: mb["x"])
+            input_fn=lambda mb: mb["x"], **kw)
 
     f = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
         body, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P())))
@@ -71,12 +71,40 @@ def test_1f1b_trace_cost_bounded_with_gpt_stage(setup):
     traced = f.trace(params, batch)
     traced.lower()
     elapsed = time.time() - t0
-    assert elapsed < TRACE_BUDGET_S, (
-        f"1F1B trace+lower took {elapsed:.1f}s (budget {TRACE_BUDGET_S}s) "
-        "— did the per-tick vjp rebuild become per-microbatch?")
+    assert elapsed < trace_budget_s, (
+        f"{label} trace+lower took {elapsed:.1f}s "
+        f"(budget {trace_budget_s}s) — did the per-tick vjp rebuild "
+        "become per-microbatch?")
 
     jaxpr_bytes = len(str(traced.jaxpr))
-    assert jaxpr_bytes < JAXPR_BUDGET_BYTES, (
-        f"1F1B jaxpr grew to {jaxpr_bytes} bytes "
-        f"(budget {JAXPR_BUDGET_BYTES}) — residual machinery duplicating "
+    assert jaxpr_bytes < jaxpr_budget_bytes, (
+        f"{label} jaxpr grew to {jaxpr_bytes} bytes "
+        f"(budget {jaxpr_budget_bytes}) — residual machinery duplicating "
         "stage compute per microbatch?")
+
+
+def test_1f1b_trace_cost_bounded_with_gpt_stage(setup):
+    # measured ~0.9s / ~150KB; 10x margins trip on an
+    # O(num_microbatches) regression (8 extra stage traces)
+    _trace_budget(forward_backward_pipelining_without_interleaving,
+                  "1F1B", 10.0, 1_500_000)
+
+
+def test_interleaved_trace_cost_bounded_with_gpt_stage(setup):
+    """The interleaved executor traces the stage in 3 phases x 2 halves;
+    budget pins that it stays O(1) in num_microbatches."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving)
+
+    def chunked(executor):
+        def run(stage, loss, p, b, **kws):
+            p2 = jax.tree.map(lambda x: jnp.stack([x, x]), p)
+            return executor(stage, loss, p2, b,
+                            virtual_pipeline_model_parallel_size=2, **kws)
+        return run
+
+    # measured ~2.4s / ~0.9MB (3 phases x 2 halves x 2 chunks);
+    # same ~8x margin against per-microbatch blowup
+    _trace_budget(
+        chunked(forward_backward_pipelining_with_interleaving),
+        "interleaved", 20.0, 3_000_000)
