@@ -128,8 +128,16 @@ def _wrap_forward_autocast(model, dtype):
     return model
 
 
-def _patch_optimizer(optimizer, scaler: _TorchScaler, master_weights: bool):
-    optimizer._amp_scaler = scaler
+def _clear_o1_cache():
+    """Drop the O1 weight-cast cache at an iteration boundary (reference:
+    ``handle._clear_cache()``) — must happen even when the user ran every
+    backward with ``delay_unscale=True`` (no scaler update fired)."""
+    from apex_tpu.amp import amp as _amp_mod
+    if _amp_mod.current_handle() is not None:
+        _amp_mod.current_handle()._clear_cache()
+
+
+def _patch_optimizer(optimizer, master_weights: bool):
     optimizer._amp_stash = types.SimpleNamespace(already_patched=True)
 
     if master_weights:
@@ -146,16 +154,18 @@ def _patch_optimizer(optimizer, scaler: _TorchScaler, master_weights: bool):
             group["params"] = group_masters
         optimizer._amp_masters = masters
 
-    if master_weights:
-        # zero_grad must clear the 16-bit MODEL params' grads too (autograd
-        # accumulates there), or stale grads leak into every later step —
-        # the reference patches zero_grad the same way
-        # (apex/amp/_process_optimizer.py).
-        orig_zero = optimizer.zero_grad
+    # zero_grad re-arms the double-unscale guard (a fresh accumulation
+    # begins), and under master weights must also clear the 16-bit MODEL
+    # params' grads (autograd accumulates there), or stale grads leak into
+    # every later step — the reference patches zero_grad the same way
+    # (apex/amp/_process_optimizer.py).
+    orig_zero = optimizer.zero_grad
 
-        @functools.wraps(orig_zero)
-        def zero_grad(set_to_none=True):
-            orig_zero(set_to_none)
+    @functools.wraps(orig_zero)
+    def zero_grad(set_to_none=True):
+        orig_zero(set_to_none)
+        optimizer._amp_grads_unscaled = False
+        if master_weights:
             for model_group in optimizer._amp_model_groups:
                 for p in model_group:
                     if p.grad is not None:
@@ -165,17 +175,26 @@ def _patch_optimizer(optimizer, scaler: _TorchScaler, master_weights: bool):
                             p.grad.detach_()
                             p.grad.zero_()
 
-        optimizer.zero_grad = zero_grad
+    optimizer.zero_grad = zero_grad
 
     orig_step = optimizer.step
 
     @functools.wraps(orig_step)
     def step(closure=None):
-        if scaler.found_inf:
+        # stepping closes the iteration for this optimizer: clear the O1
+        # cast cache and re-arm the unscale guard
+        _clear_o1_cache()
+        optimizer._amp_grads_unscaled = False
+        # one-shot skip set by scale_loss's exit when ITS loss overflowed
+        # (reference: _process_optimizer's skip patch) — scaler updates
+        # happen per scale_loss exit, so multiple losses/optimizers each
+        # adjust their own scaler exactly once per iteration
+        if getattr(optimizer, "_amp_skip_next_step", False):
+            optimizer._amp_skip_next_step = False
             _amp_state.maybe_print(
-                f"Gradient overflow.  Skipping step, loss scaler reducing "
-                f"loss scale to {scaler._scale / 2.0}")
-            scaler.update()
+                f"Gradient overflow.  Skipping step, loss scaler reduced "
+                f"loss scale to "
+                f"{getattr(optimizer, '_amp_skip_scale', 'n/a')}")
             return None
         if master_weights:
             for group_masters, model_group in zip(
@@ -190,7 +209,6 @@ def _patch_optimizer(optimizer, scaler: _TorchScaler, master_weights: bool):
                     p.data.copy_(m.data.to(p.dtype))
         else:
             out = orig_step(closure)
-        scaler.update()
         return out
 
     optimizer.step = step
@@ -199,11 +217,21 @@ def _patch_optimizer(optimizer, scaler: _TorchScaler, master_weights: bool):
 
 def initialize_torch(model, optimizer, props, num_losses=1,
                      min_loss_scale=None, max_loss_scale=None):
-    """Apply an opt level to a torch module (+ optimizer)."""
-    opt_level = props.opt_level
-    scaler = _TorchScaler(props.loss_scale, min_scale=min_loss_scale,
-                          max_scale=max_loss_scale)
+    """Apply an opt level to torch module(s) (+ optimizer(s)).
 
+    Lists are the reference's multi-model/multi-optimizer contract
+    (``amp.initialize([m1, m2], [o1, o2], num_losses=2)``): each model is
+    cast/wrapped, each optimizer patched, and ``num_losses`` independent
+    scalers are created — ``scale_loss(..., loss_id=k)`` scales/unscales
+    with scaler ``k`` (reference: one ``LossScaler`` per loss_id).
+    """
+    opt_level = props.opt_level
+    scalers = [_TorchScaler(props.loss_scale, min_scale=min_loss_scale,
+                            max_scale=max_loss_scale)
+               for _ in range(max(1, num_losses))]
+
+    models_in_list = isinstance(model, (list, tuple))
+    models = list(model) if models_in_list else [model]
     if opt_level == "O1":
         # O1 = patch the torch/Tensor/functional namespaces with the cast
         # lists (reference: amp.init + lists/*); patch_torch_functions=False
@@ -212,14 +240,17 @@ def initialize_torch(model, optimizer, props, num_losses=1,
             from apex_tpu.amp import amp as amp_mod
             amp_mod.init()
         else:
-            _wrap_forward_autocast(model, torch.bfloat16)
+            for m in models:
+                _wrap_forward_autocast(m, torch.bfloat16)
     elif opt_level in ("O2", "O3"):
         keep_bn = bool(props.keep_batchnorm_fp32) and opt_level == "O2"
-        _cast_module(model, torch.bfloat16, keep_bn)
-        _wrap_forward_cast_inputs(model, torch.bfloat16)
+        for m in models:
+            _cast_module(m, torch.bfloat16, keep_bn)
+            _wrap_forward_cast_inputs(m, torch.bfloat16)
+    model_out = models if models_in_list else models[0]
 
     if optimizer is None:
-        return model
+        return model_out
 
     optimizers = optimizer if isinstance(optimizer, (list, tuple)) \
         else [optimizer]
@@ -228,26 +259,61 @@ def initialize_torch(model, optimizer, props, num_losses=1,
         if use_masters:
             opt._amp_model_groups = [list(g["params"])
                                      for g in opt.param_groups]
-        _patch_optimizer(opt, scaler, use_masters)
-    _amp_state.amp_state.loss_scalers = [scaler]
+        opt._amp_scalers = scalers
+        _patch_optimizer(opt, use_masters)
+    _amp_state.amp_state.loss_scalers = list(scalers)
     _amp_state.amp_state.optimizers = list(optimizers)
-    return (model, optimizer) if not isinstance(optimizer, (list, tuple)) \
-        else (model, optimizers)
+    return (model_out, optimizer) \
+        if not isinstance(optimizer, (list, tuple)) \
+        else (model_out, list(optimizers))
 
 
 @contextlib.contextmanager
-def torch_scale_loss(loss, optimizers, delay_unscale=False):
+def torch_scale_loss(loss, optimizers, loss_id=0, delay_unscale=False):
+    """Scale/unscale around one backward (reference: ``handle.scale_loss``).
+
+    On exit: unscale every listed optimizer's grads with loss ``loss_id``'s
+    scaler, update THAT scaler, and on overflow arm each optimizer's
+    one-shot step skip — the reference's per-loss_id scaler + skip-patch
+    flow, so multiple losses each manage their own dynamic scale.
+
+    Accumulating SEVERAL backwards into one optimizer before its step
+    requires ``delay_unscale=True`` on all but the last scale_loss (the
+    reference documents the same contract): a second unscale of already-
+    unscaled grads would silently divide the first loss's contribution
+    away, so that case raises instead.
+    """
     opts = optimizers if isinstance(optimizers, (list, tuple)) \
         else [optimizers]
-    scaler = getattr(opts[0], "_amp_scaler", None)
-    if scaler is None:
+    scalers = getattr(opts[0], "_amp_scalers", None)
+    if not scalers:
         yield loss
         return
+    scaler = scalers[loss_id]
     yield loss * scaler.loss_scale()
     if not delay_unscale:
+        for opt in opts:
+            if getattr(opt, "_amp_grads_unscaled", False):
+                raise RuntimeError(
+                    "scale_loss exit would unscale this optimizer's "
+                    "gradients a second time before its step() — grads "
+                    "already unscaled by an earlier loss's exit would be "
+                    "silently annihilated.  When accumulating multiple "
+                    "backwards into one optimizer, pass "
+                    "delay_unscale=True for all but the last scale_loss "
+                    "(the reference's documented contract).")
+        found = False
         for opt in opts:
             params = [p for g in getattr(opt, "_amp_model_groups",
                                          [g["params"]
                                           for g in opt.param_groups])
                       for p in g]
             scaler.unscale_grads(params)
+            found = found or scaler.found_inf
+            opt._amp_grads_unscaled = True
+        scaler.found_inf = found
+        scaler.update()
+        if found:
+            for opt in opts:
+                opt._amp_skip_next_step = True
+                opt._amp_skip_scale = scaler._scale

@@ -108,6 +108,8 @@ opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
 def _is_torch_module(model) -> bool:
     try:
         import torch
+        if isinstance(model, (list, tuple)) and model:
+            return all(isinstance(m, torch.nn.Module) for m in model)
         return isinstance(model, torch.nn.Module)
     except ImportError:  # pragma: no cover
         return False

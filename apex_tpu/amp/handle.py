@@ -28,7 +28,7 @@ def scale_loss(loss, optimizers, loss_id=0, model=None, delay_unscale=False,
 
     if is_torch:
         from apex_tpu.amp._torch_shim import torch_scale_loss
-        with torch_scale_loss(loss, optimizers,
+        with torch_scale_loss(loss, optimizers, loss_id=loss_id,
                               delay_unscale=delay_unscale) as scaled:
             yield scaled
         return

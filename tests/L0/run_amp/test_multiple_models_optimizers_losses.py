@@ -1,0 +1,155 @@
+"""Multiple models / optimizers / losses under amp (reference:
+``tests/L0/run_amp/test_multiple_models_optimizers_losses.py`` — lists to
+``amp.initialize`` + per-``loss_id`` scalers)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu.amp import _amp_state  # noqa: E402
+
+
+def _fresh_models(n=2, dim=4):
+    torch.manual_seed(0)
+    models = [nn.Sequential(nn.Linear(dim, dim), nn.ReLU(),
+                            nn.Linear(dim, dim)) for _ in range(n)]
+    opts = [torch.optim.SGD(m.parameters(), lr=0.05) for m in models]
+    return models, opts
+
+
+@pytest.fixture(autouse=True)
+def _teardown_amp():
+    yield
+    from apex_tpu.amp import amp as amp_mod
+    if amp_mod.current_handle() is not None:
+        amp_mod.current_handle()._deactivate()
+    _amp_state.amp_state.loss_scalers = []
+    _amp_state.amp_state.optimizers = []
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_two_models_two_optimizers_two_losses(opt_level):
+    models, opts = _fresh_models()
+    models, opts = amp.initialize(models, opts, opt_level=opt_level,
+                                  num_losses=2, verbosity=0)
+    assert isinstance(models, list) and len(models) == 2
+    assert isinstance(opts, list) and len(opts) == 2
+    assert len(_amp_state.amp_state.loss_scalers) == 2
+
+    x = torch.randn(8, 4)
+    before = [p.detach().clone() for m in models for p in m.parameters()]
+    for it in range(3):
+        for i, (m, o) in enumerate(zip(models, opts)):
+            o.zero_grad()
+            loss = m(x).pow(2).mean()
+            with amp.scale_loss(loss, o, loss_id=i) as scaled:
+                scaled.backward()
+            o.step()
+    after = [p.detach().clone() for m in models for p in m.parameters()]
+    for b, a in zip(before, after):
+        assert not torch.allclose(b.float(), a.float()), "params frozen"
+
+
+def test_per_loss_scalers_are_independent():
+    models, opts = _fresh_models()
+    models, opts = amp.initialize(models, opts, opt_level="O1",
+                                  num_losses=2, verbosity=0)
+    s0, s1 = _amp_state.amp_state.loss_scalers
+    start0, start1 = s0.loss_scale(), s1.loss_scale()
+
+    x = torch.randn(4, 4)
+    p0 = [p.detach().clone() for p in models[0].parameters()]
+    p1 = [p.detach().clone() for p in models[1].parameters()]
+
+    # loss 0 overflows (scaled by inf factor), loss 1 is clean
+    opts[0].zero_grad()
+    loss = models[0](x).mean() * float("inf")
+    with amp.scale_loss(loss, opts[0], loss_id=0) as scaled:
+        scaled.backward()
+    opts[0].step()
+    for b, p in zip(p0, models[0].parameters()):
+        assert torch.equal(b, p.detach()), "overflow step must be skipped"
+
+    opts[1].zero_grad()
+    with amp.scale_loss(models[1](x).mean(), opts[1], loss_id=1) as scaled:
+        scaled.backward()
+    opts[1].step()
+    assert any(not torch.equal(b, p.detach())
+               for b, p in zip(p1, models[1].parameters())), (
+        "clean step must apply")
+
+    assert s0.loss_scale() == start0 / 2.0, "scaler 0 must back off"
+    assert s1.loss_scale() == start1, "scaler 1 must be untouched"
+
+
+def test_one_model_params_split_across_two_optimizers():
+    """The reference also covers ONE model whose parameters are split
+    across several optimizers: scale_loss([o1, o2]) must unscale both
+    partitions exactly once and (on overflow) skip both steps."""
+    torch.manual_seed(0)
+    model = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 4))
+    o1 = torch.optim.SGD(model[0].parameters(), lr=0.05)
+    o2 = torch.optim.SGD(model[2].parameters(), lr=0.05)
+    model, opts = amp.initialize(model, [o1, o2], opt_level="O1",
+                                 num_losses=1, verbosity=0)
+    x = torch.randn(4, 4)
+
+    # clean iteration: one scale_loss over both optimizers; grads must be
+    # unscaled exactly once (equal to the plain-loss grads)
+    for o in opts:
+        o.zero_grad()
+    with amp.scale_loss(model(x).pow(2).mean(), opts) as scaled:
+        scaled.backward()
+    amp_grads = [p.grad.detach().clone().float()
+                 for p in model.parameters()]
+    for o in opts:
+        o.zero_grad()
+    model(x).pow(2).mean().backward()
+    plain = [p.grad.detach().clone().float() for p in model.parameters()]
+    for a, b in zip(amp_grads, plain):
+        assert torch.allclose(a, b, rtol=1e-2, atol=1e-3), (a, b)
+
+    # overflow iteration: BOTH optimizers must skip
+    before = [p.detach().clone() for p in model.parameters()]
+    for o in opts:
+        o.zero_grad()
+    loss = model(x).mean() * float("inf")
+    with amp.scale_loss(loss, opts) as scaled:
+        scaled.backward()
+    opts[0].step()
+    opts[1].step()
+    for b, p in zip(before, model.parameters()):
+        assert torch.equal(b, p.detach()), (
+            "both optimizers must skip on overflow")
+
+
+def test_two_losses_one_optimizer_requires_delay_unscale():
+    """Accumulating two losses into ONE optimizer: the documented
+    contract is delay_unscale=True on all but the last scale_loss; a
+    second eager unscale would annihilate the first loss's grads, so it
+    must raise loudly instead."""
+    models, opts = _fresh_models(n=1)
+    model, opt = amp.initialize(models[0], opts[0], opt_level="O1",
+                                num_losses=2, verbosity=0)
+    x = torch.randn(4, 4)
+
+    # correct pattern: delay the first unscale
+    opt.zero_grad()
+    with amp.scale_loss(model(x).mean(), opt, loss_id=0,
+                        delay_unscale=True) as scaled:
+        scaled.backward()
+    with amp.scale_loss(model(x).pow(2).mean(), opt, loss_id=1) as scaled:
+        scaled.backward()
+    opt.step()
+
+    # incorrect pattern: two eager unscales -> loud error, not silent
+    # gradient corruption
+    opt.zero_grad()
+    with amp.scale_loss(model(x).mean(), opt, loss_id=0) as scaled:
+        scaled.backward()
+    with pytest.raises(RuntimeError, match="delay_unscale"):
+        with amp.scale_loss(model(x).pow(2).mean(), opt,
+                            loss_id=1) as scaled:
+            scaled.backward()
