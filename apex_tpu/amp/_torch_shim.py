@@ -58,9 +58,7 @@ class _TorchScaler:
     def update(self):
         # one iteration boundary: drop the O1 weight-cast cache (reference:
         # handle._clear_cache() on every scaler update)
-        from apex_tpu.amp import amp as _amp_mod
-        if _amp_mod.current_handle() is not None:
-            _amp_mod.current_handle()._clear_cache()
+        _clear_o1_cache()
         if not self.dynamic:
             self.found_inf = False
             return
@@ -165,6 +163,7 @@ def _patch_optimizer(optimizer, master_weights: bool):
     def zero_grad(set_to_none=True):
         orig_zero(set_to_none)
         optimizer._amp_grads_unscaled = False
+        optimizer._amp_pending_scales = []
         if master_weights:
             for model_group in optimizer._amp_model_groups:
                 for p in model_group:
@@ -185,6 +184,7 @@ def _patch_optimizer(optimizer, master_weights: bool):
         # cast cache and re-arm the unscale guard
         _clear_o1_cache()
         optimizer._amp_grads_unscaled = False
+        optimizer._amp_pending_scales = []
         # one-shot skip set by scale_loss's exit when ITS loss overflowed
         # (reference: _process_optimizer's skip patch) — scaler updates
         # happen per scale_loss exit, so multiple losses/optimizers each
@@ -291,29 +291,48 @@ def torch_scale_loss(loss, optimizers, loss_id=0, delay_unscale=False):
         return
     scaler = scalers[loss_id]
     yield loss * scaler.loss_scale()
-    if not delay_unscale:
+    if delay_unscale:
+        # record the scale the accumulated grads carry so the final eager
+        # exit can verify it unscales by the SAME factor
         for opt in opts:
-            if getattr(opt, "_amp_grads_unscaled", False):
-                raise RuntimeError(
-                    "scale_loss exit would unscale this optimizer's "
-                    "gradients a second time before its step() — grads "
-                    "already unscaled by an earlier loss's exit would be "
-                    "silently annihilated.  When accumulating multiple "
-                    "backwards into one optimizer, pass "
-                    "delay_unscale=True for all but the last scale_loss "
-                    "(the reference's documented contract).")
-        found = False
+            pending = getattr(opt, "_amp_pending_scales", None)
+            if pending is None:
+                pending = opt._amp_pending_scales = []
+            pending.append(scaler.loss_scale())
+        return
+    for opt in opts:
+        if getattr(opt, "_amp_grads_unscaled", False):
+            raise RuntimeError(
+                "scale_loss exit would unscale this optimizer's "
+                "gradients a second time before its step() — grads "
+                "already unscaled by an earlier loss's exit would be "
+                "silently annihilated.  When accumulating multiple "
+                "backwards into one optimizer, pass "
+                "delay_unscale=True for all but the last scale_loss "
+                "(the reference's documented contract).")
+        bad = [s for s in getattr(opt, "_amp_pending_scales", [])
+               if s != scaler.loss_scale()]
+        if bad:
+            raise RuntimeError(
+                "delayed-unscale gradients were scaled by "
+                f"{bad} but the final scale_loss would unscale by "
+                f"{scaler.loss_scale()} (loss_id={loss_id}) — diverged "
+                "per-loss scales would silently mis-weight the "
+                "accumulated losses.  Use ONE loss_id (shared scaler) "
+                "when accumulating into the same optimizer.")
+    found = False
+    for opt in opts:
+        params = [p for g in getattr(opt, "_amp_model_groups",
+                                     [g["params"]
+                                      for g in opt.param_groups])
+                  for p in g]
+        scaler.unscale_grads(params)
+        found = found or scaler.found_inf
+        opt._amp_grads_unscaled = True
+        opt._amp_pending_scales = []
+    scaler.found_inf = found
+    scaler.update()
+    if found:
         for opt in opts:
-            params = [p for g in getattr(opt, "_amp_model_groups",
-                                         [g["params"]
-                                          for g in opt.param_groups])
-                      for p in g]
-            scaler.unscale_grads(params)
-            found = found or scaler.found_inf
-            opt._amp_grads_unscaled = True
-        scaler.found_inf = found
-        scaler.update()
-        if found:
-            for opt in opts:
-                opt._amp_skip_next_step = True
-                opt._amp_skip_scale = scaler._scale
+            opt._amp_skip_next_step = True
+            opt._amp_skip_scale = scaler._scale

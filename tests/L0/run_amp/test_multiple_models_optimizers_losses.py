@@ -153,3 +153,23 @@ def test_two_losses_one_optimizer_requires_delay_unscale():
         with amp.scale_loss(model(x).pow(2).mean(), opt,
                             loss_id=1) as scaled:
             scaled.backward()
+
+
+def test_delay_unscale_rejects_diverged_scales():
+    """If the delayed loss's scaler and the final eager scaler have
+    diverged, the accumulated grads would be silently mis-weighted —
+    must raise instead."""
+    models, opts = _fresh_models(n=1)
+    model, opt = amp.initialize(models[0], opts[0], opt_level="O1",
+                                num_losses=2, verbosity=0)
+    s0, s1 = _amp_state.amp_state.loss_scalers
+    s1._scale = s0._scale / 2.0   # simulate a prior backoff on loss 1
+    x = torch.randn(4, 4)
+    opt.zero_grad()
+    with amp.scale_loss(model(x).mean(), opt, loss_id=0,
+                        delay_unscale=True) as scaled:
+        scaled.backward()
+    with pytest.raises(RuntimeError, match="mis-weight"):
+        with amp.scale_loss(model(x).pow(2).mean(), opt,
+                            loss_id=1) as scaled:
+            scaled.backward()

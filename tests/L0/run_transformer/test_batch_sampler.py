@@ -1,0 +1,93 @@
+"""Batch sampler tests (reference:
+``tests/L0/run_transformer/test_batch_sampler.py``)."""
+import numpy as np
+import pytest
+
+from apex_tpu.transformer.testing import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+TOTAL, MBS, DP = 64, 4, 2
+
+
+class TestSequentialSampler:
+    def test_ranks_partition_each_global_batch(self):
+        per_rank = [list(MegatronPretrainingSampler(
+            TOTAL, 0, MBS, rank, DP)) for rank in range(DP)]
+        # same number of micro-batches on every rank
+        assert len({len(b) for b in per_rank}) == 1
+        # each global batch = union of the rank slices, covering
+        # consecutive indices
+        for gb, (b0, b1) in enumerate(zip(*per_rank)):
+            merged = b0 + b1
+            assert sorted(merged) == list(
+                range(gb * MBS * DP, (gb + 1) * MBS * DP))
+
+    def test_resumes_from_consumed_samples(self):
+        first = next(iter(MegatronPretrainingSampler(
+            TOTAL, 16, MBS, 0, DP)))
+        assert first[0] == 16
+
+    def test_drop_last(self):
+        # 10 samples, global batch 8 -> 1 full batch, partial dropped
+        batches = list(MegatronPretrainingSampler(10, 0, MBS, 0, DP))
+        assert len(batches) == 1
+        batches = list(MegatronPretrainingSampler(
+            10, 0, MBS, 0, DP, drop_last=False))
+        assert len(batches) == 2
+
+    def test_validation(self):
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(0, 0, MBS, 0, DP)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(8, 8, MBS, 0, DP)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(8, 0, MBS, 3, DP)
+
+
+class TestRandomSampler:
+    def test_ranks_disjoint_and_shuffled(self):
+        per_rank = [list(MegatronPretrainingRandomSampler(
+            TOTAL, 0, MBS, rank, DP, seed=7)) for rank in range(DP)]
+        flat = [i for b in per_rank for mb in [b] for bb in mb for i in bb]
+        assert len(flat) == len(set(flat)), "ranks must not overlap"
+        # shuffled: not the sequential order
+        seq = [i for b in per_rank[0] for i in b]
+        assert seq != sorted(seq)
+
+    def test_same_seed_same_order(self):
+        a = list(MegatronPretrainingRandomSampler(
+            TOTAL, 0, MBS, 0, DP, seed=3))
+        b = list(MegatronPretrainingRandomSampler(
+            TOTAL, 0, MBS, 0, DP, seed=3))
+        assert a == b
+        c = list(MegatronPretrainingRandomSampler(
+            TOTAL, 0, MBS, 0, DP, seed=4))
+        assert a != c
+
+    def test_epoch_reshuffles(self):
+        epoch0 = list(MegatronPretrainingRandomSampler(
+            TOTAL, 0, MBS, 0, DP, seed=3))
+        epoch1 = list(MegatronPretrainingRandomSampler(
+            TOTAL, TOTAL, MBS, 0, DP, seed=3))
+        assert epoch0 != epoch1
+
+    def test_micro_batch_size_shape(self):
+        for mb in MegatronPretrainingRandomSampler(
+                TOTAL, 0, MBS, 1, DP, seed=0):
+            assert len(mb) == MBS
+
+
+def test_partial_batch_split_proportionally():
+    """drop_last=False must never hand a rank an empty micro-batch while
+    another gets the whole remainder."""
+    parts = [list(MegatronPretrainingSampler(
+        10, 0, MBS, rank, DP, drop_last=False))[-1] for rank in range(DP)]
+    assert sorted(parts[0] + parts[1]) == [8, 9]
+    assert all(len(p) >= 1 for p in parts)
+
+
+def test_random_sampler_rejects_tiny_dataset():
+    with pytest.raises(RuntimeError, match="full global batch"):
+        MegatronPretrainingRandomSampler(6, 0, MBS, 0, DP)

@@ -1,0 +1,49 @@
+"""transformer/utils tests (reference:
+``tests/L0/run_transformer/test_transformer_utils.py``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer.utils import (
+    VocabUtility,
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
+)
+
+
+def test_divide():
+    assert divide(12, 4) == 3
+    with pytest.raises(AssertionError):
+        divide(12, 5)
+
+
+def test_ensure_divisibility():
+    ensure_divisibility(8, 2)
+    with pytest.raises(AssertionError):
+        ensure_divisibility(7, 2)
+
+
+def test_split_tensor_along_last_dim():
+    x = jnp.arange(24.0).reshape(2, 12)
+    parts = split_tensor_along_last_dim(x, 3)
+    assert len(parts) == 3
+    for i, p in enumerate(parts):
+        assert p.shape == (2, 4)
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(x)[:, i * 4:(i + 1) * 4])
+
+
+def test_vocab_utility_ranges():
+    # per-partition: rank r of world w owns [r*per, (r+1)*per)
+    s, e = VocabUtility.vocab_range_from_per_partition_vocab_size(64, 3, 8)
+    assert (s, e) == (192, 256)
+    s, e = VocabUtility.vocab_range_from_global_vocab_size(512, 3, 8)
+    assert (s, e) == (192, 256)
+    # full coverage, no overlap
+    spans = [VocabUtility.vocab_range_from_global_vocab_size(512, r, 8)
+             for r in range(8)]
+    assert spans[0][0] == 0 and spans[-1][1] == 512
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c
