@@ -129,22 +129,22 @@ def fused_scale(flat: jax.Array, scale, out_dtype=None):
     the overflow buffer becomes a returned fp32 flag (0.0 clean, 1.0 inf/nan).
     """
     out_dtype = out_dtype or flat.dtype
-    x2, n = flat, flat.shape[0]
+    n = flat.shape[0]
     if n == 0:   # empty grid would leave the SMEM flag uninitialized
         return flat.astype(out_dtype), jnp.float32(0.0)
     hp = jnp.asarray([scale], jnp.float32)
     out, flags = pl.pallas_call(
         functools.partial(_scale_kernel, n),
-        grid=(_grid(x2),),
+        grid=(_grid(flat),),
         in_specs=[_vspec(), _sspec()],
         out_specs=[_vspec(), _bspec()],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, out_dtype),
-            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+            jax.ShapeDtypeStruct(flat.shape, out_dtype),
+            jax.ShapeDtypeStruct((_grid(flat),), jnp.float32),
         ],
         compiler_params=_PAR,
         interpret=interpret_mode(),
-    )(x2, hp)
+    )(flat, hp)
     return out, jnp.max(flags)
 
 
@@ -164,23 +164,22 @@ def fused_axpby(a, x: jax.Array, b, y: jax.Array, out_dtype=None):
     Parity: ``amp_C.multi_tensor_axpby`` (csrc/multi_tensor_axpby_kernel.cu).
     """
     out_dtype = out_dtype or x.dtype
-    x2, n = x, x.shape[0]
-    y2 = y
+    n = x.shape[0]
     if n == 0:   # empty grid would leave the SMEM flag uninitialized
         return x.astype(out_dtype), jnp.float32(0.0)
     hp = jnp.asarray([a, b], jnp.float32)
     out, flags = pl.pallas_call(
         functools.partial(_axpby_kernel, n),
-        grid=(_grid(x2),),
+        grid=(_grid(x),),
         in_specs=[_vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), _bspec()],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, out_dtype),
-            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+            jax.ShapeDtypeStruct(x.shape, out_dtype),
+            jax.ShapeDtypeStruct((_grid(x),), jnp.float32),
         ],
         compiler_params=_PAR,
         interpret=interpret_mode(),
-    )(x2, y2, hp)
+    )(x, y, hp)
     return out, jnp.max(flags)
 
 
@@ -199,18 +198,18 @@ def fused_l2norm(flat: jax.Array) -> jax.Array:
 
     Parity: ``amp_C.multi_tensor_l2norm`` (csrc/multi_tensor_l2norm_kernel.cu).
     """
-    x2, n = flat, flat.shape[0]
+    n = flat.shape[0]
     if n == 0:   # empty grid would leave the SMEM accumulator uninitialized
         return jnp.float32(0.0)
     acc = pl.pallas_call(
         functools.partial(_l2norm_kernel, n),
-        grid=(_grid(x2),),
+        grid=(_grid(flat),),
         in_specs=[_vspec()],
         out_specs=_bspec(),
-        out_shape=jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((_grid(flat),), jnp.float32),
         compiler_params=_PAR,
         interpret=interpret_mode(),
-    )(x2)
+    )(flat)
     return jnp.sqrt(jnp.sum(acc))
 
 
@@ -232,23 +231,23 @@ def fused_l2norm_scale(flat: jax.Array, scale, out_dtype=None):
     skip-on-overflow contract (same as :func:`fused_scale`).
     """
     out_dtype = out_dtype or flat.dtype
-    x2, n = flat, flat.shape[0]
+    n = flat.shape[0]
     if n == 0:
         return flat.astype(out_dtype), jnp.float32(0.0), jnp.float32(0.0)
     hp = jnp.asarray([scale], jnp.float32)
     out, acc, flags = pl.pallas_call(
         functools.partial(_l2norm_scale_kernel, n),
-        grid=(_grid(x2),),
+        grid=(_grid(flat),),
         in_specs=[_vspec(), _sspec()],
         out_specs=[_vspec(), _bspec(), _bspec()],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, out_dtype),
-            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
-            jax.ShapeDtypeStruct((_grid(x2),), jnp.float32),
+            jax.ShapeDtypeStruct(flat.shape, out_dtype),
+            jax.ShapeDtypeStruct((_grid(flat),), jnp.float32),
+            jax.ShapeDtypeStruct((_grid(flat),), jnp.float32),
         ],
         compiler_params=_PAR,
         interpret=interpret_mode(),
-    )(x2, hp)
+    )(flat, hp)
     return out, jnp.sqrt(jnp.sum(acc)), jnp.max(flags)
 
 

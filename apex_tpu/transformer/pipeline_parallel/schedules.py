@@ -197,7 +197,19 @@ def _pipeline_local_loss(stage_fn, loss_fn, input_fn, params, batch, *,
     return loss_acc / num_microbatches
 
 
-def _residual_layout(stage_fn, loss_fn, input_fn, params, batch):
+def _init_ring_state(buf_shapes, x0, depth):
+    """Zeroed executor state: ``depth``-slotted circular residual and
+    stage-input buffers plus a zero ring message shaped like ``x0``
+    (shared by the 1F1B and interleaved executors)."""
+    buf0 = [jnp.zeros((depth,) + shape, dtype)
+            for shape, dtype in buf_shapes]
+    xbuf0 = jax.tree.map(
+        lambda a: jnp.zeros((depth,) + a.shape, a.dtype), x0)
+    msg0 = jax.tree.map(jnp.zeros_like, x0)
+    return buf0, xbuf0, msg0
+
+
+def _residual_layout(stage_fn, input_fn, params, batch):
     """Trace one stage forward+vjp OUTSIDE the tick scan to learn the
     residual structure: which vjp residuals are the params themselves
     (tick-invariant — substituted at backward time, never buffered) and
@@ -332,13 +344,9 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
     lf, loss_has_params = _normalize_loss_fn(loss_fn)
 
     inv_map, buf_shapes, x0 = _residual_layout(
-        stage_fn, loss_fn, input_fn, params, batch)
+        stage_fn, input_fn, params, batch)
     p_leaves = jax.tree.leaves(params)
-
-    buf0 = [jnp.zeros((depth,) + shape, dtype)
-            for shape, dtype in buf_shapes]
-    fwd_msg0 = jax.tree.map(jnp.zeros_like, x0)
-    bwd_msg0 = jax.tree.map(jnp.zeros_like, x0)
+    buf0, xbuf0, msg0 = _init_ring_state(buf_shapes, x0, depth)
     grad0 = jax.tree.map(jnp.zeros_like, params)
 
     def tick(carry, t, *, do_fwd, do_bwd):
@@ -412,9 +420,7 @@ def _pipeline_1f1b_local(stage_fn, loss_fn, input_fn, params, batch, *,
 
         return (buf, xbuf, fwd_msg, bwd_msg, dy_hold, grad_acc, loss_acc)
 
-    xbuf0 = jax.tree.map(
-        lambda a: jnp.zeros((depth,) + a.shape, a.dtype), x0)
-    carry = (buf0, xbuf0, fwd_msg0, bwd_msg0,
+    carry = (buf0, xbuf0, msg0, msg0,
              jax.tree.map(jnp.zeros_like, x0), grad0,
              jnp.zeros((), jnp.float32))
     carry = _phase_scan(tick, carry, 0, warm_end, do_fwd=True, do_bwd=False)
@@ -530,7 +536,7 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
 
     chunk0 = jax.tree.map(lambda x: x[0], params)
     inv_map, buf_shapes, x0 = _residual_layout(
-        stage_fn, loss_fn, input_fn, chunk0, batch)
+        stage_fn, input_fn, chunk0, batch)
 
     def fwd_half(carry, t):
         """One chunk-forward: stash residuals, compute (masked) loss vjp."""
@@ -624,11 +630,7 @@ def _pipeline_interleaved_local(stage_fn, loss_fn, input_fn, params, batch,
             carry = bwd_half(carry, t, prev_dy_in)
         return carry
 
-    buf0 = [jnp.zeros((depth,) + shape, dtype)
-            for shape, dtype in buf_shapes]
-    xbuf0 = jax.tree.map(
-        lambda a: jnp.zeros((depth,) + a.shape, a.dtype), x0)
-    msg0 = jax.tree.map(jnp.zeros_like, x0)
+    buf0, xbuf0, msg0 = _init_ring_state(buf_shapes, x0, depth)
     carry = (buf0, xbuf0, msg0, msg0,
              jax.tree.map(jnp.zeros_like, x0),
              jax.tree.map(jnp.zeros_like, params),

@@ -480,10 +480,11 @@ def _run_all_legs(mode: str, errors: list):
     Returns None only if the MAIN leg failed (micro legs degrade).  The
     main leg gets one retry on non-timeout failures (transient tunnel
     crashes); a timeout means a wedged client, not worth another 25 min."""
-    result, err = _run_leg(mode, "main", dict(LEG_TIMEOUTS)["main"])
+    main_timeout = dict(LEG_TIMEOUTS)["main"]
+    result, err = _run_leg(mode, "main", main_timeout)
     if result is None and "timed out" not in (err or ""):
         errors.append(err)
-        result, err = _run_leg(mode, "main", dict(LEG_TIMEOUTS)["main"])
+        result, err = _run_leg(mode, "main", main_timeout)
     if result is None:
         errors.append(err)
         return None
