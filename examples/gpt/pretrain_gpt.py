@@ -53,6 +53,9 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description="mesh GPT pretrain (apex_tpu)")
     p.add_argument("--tp", type=int, default=2)
     p.add_argument("--pp", type=int, default=2)
+    p.add_argument("--vpp", type=int, default=1,
+                   help="virtual pipeline chunks per rank (interleaved "
+                        "1F1B when > 1)")
     p.add_argument("--hidden", type=int, default=32)
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--seq", type=int, default=16)
@@ -86,6 +89,11 @@ def main(argv=None):
     n_dev = len(jax.devices())
     dp = n_dev // (args.tp * args.pp)
     assert dp >= 1, f"need tp*pp <= {n_dev} devices"
+    if args.vpp > 1 and args.pp <= 1:
+        raise SystemExit(
+            "--vpp > 1 requires --pp > 1 (virtual chunks interleave "
+            "across pipeline ranks; with one rank there is nothing to "
+            "interleave)")
 
     parallel_state.destroy_model_parallel()
     parallel_state.initialize_model_parallel(
@@ -101,21 +109,29 @@ def main(argv=None):
         data_parallel_size=dp)
     n_micro = get_num_microbatches()
     fwd_bwd = get_forward_backward_func(
+        virtual_pipeline_model_parallel_size=args.vpp,
         pipeline_model_parallel_size=args.pp)
-    print(f"mesh: tp={args.tp} pp={args.pp} dp={dp} "
+    print(f"mesh: tp={args.tp} pp={args.pp} dp={dp} vpp={args.vpp} "
           f"micro-batches/step={n_micro} executor={fwd_bwd.__name__}")
 
     cfg = GPTConfig(
-        vocab_size=args.vocab, hidden_size=args.hidden, num_layers=args.pp,
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.pp * args.vpp,
         num_attention_heads=args.heads, max_seq_length=args.seq,
         hidden_dropout=0.0, attention_dropout=0.0)
     layer = ParallelTransformerLayer(cfg, causal=True)
 
     def stage_fn(params, x, mb):
+        # injection at VIRTUAL stage 0 only: rank 0 AND the chunk whose
+        # params carry first_chunk=1 (with vpp=1 every rank's single
+        # chunk of params has it set iff rank 0 uses it — the flag is a
+        # param leaf precisely so the interleaved executor's per-chunk
+        # param slicing selects it)
         stage = jax.lax.axis_index("pipe") if args.pp > 1 else 0
         emb = jnp.take(params["embed"], mb["tokens"], axis=0)  # [b,s,h]
         emb = emb.transpose(1, 0, 2)                           # [s,b,h]
-        x = jnp.where(stage == 0, emb, x)
+        inject = (stage == 0) & (params["first_chunk"] > 0.5)
+        x = jnp.where(inject, emb, x)
         return layer.apply(params["layer"], x, None, True)
 
     def loss_fn(y, mb, params):
@@ -134,15 +150,28 @@ def main(argv=None):
         layer init (axis_index-folded keys), then lax.scan over steps —
         the sharded optimizer state never crosses the jit boundary."""
         x0 = jnp.zeros((args.seq, args.micro_batch_size, args.hidden))
-        pipe_key = jax.random.fold_in(
-            jax.random.PRNGKey(args.seed),
-            jax.lax.axis_index("pipe") if args.pp > 1 else 0)
-        params = {
-            "embed": jax.random.normal(        # replicated tied embedding
-                jax.random.PRNGKey(args.seed + 1),
-                (args.vocab, args.hidden)) * 0.02,
-            "layer": layer.init(pipe_key, x0, None, True),
-        }
+        pipe_rank = jax.lax.axis_index("pipe") if args.pp > 1 else 0
+        embed0 = jax.random.normal(            # replicated tied embedding
+            jax.random.PRNGKey(args.seed + 1),
+            (args.vocab, args.hidden)) * 0.02
+
+        def chunk_params(chunk):
+            key = jax.random.fold_in(jax.random.fold_in(
+                jax.random.PRNGKey(args.seed), pipe_rank), chunk)
+            return {
+                "embed": embed0,
+                "layer": layer.init(key, x0, None, True),
+                "first_chunk": jnp.float32(1.0 if chunk == 0 else 0.0),
+            }
+
+        if args.vpp > 1:
+            # leading [v] chunk dim; chunk c on rank r = virtual stage
+            # c*pp + r (the interleaved executor's layout)
+            params = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                  *[chunk_params(c)
+                                    for c in range(args.vpp)])
+        else:
+            params = chunk_params(0)
         flat0, _ = tree_ravel(params)
         opt0 = (jnp.zeros_like(flat0), jnp.zeros_like(flat0))
 
@@ -151,9 +180,19 @@ def main(argv=None):
             step, batch = xs
             loss, grads = fwd_bwd(
                 stage_fn, loss_fn, params, batch,
-                num_microbatches=n_micro, input_fn=input_fn)
-            # tied-embedding reconciliation (first+last stage group psum)
-            grads["embed"] = embedding_grads_all_reduce(grads["embed"])
+                num_microbatches=n_micro, input_fn=input_fn,
+                virtual_pipeline_model_parallel_size=args.vpp)
+            # tied-embedding reconciliation (first+last stage group
+            # psum); with vpp the chunk contributions (lookup in chunk 0,
+            # head in chunk v-1) sum first, and every replica receives
+            # the reconciled total so they update in lockstep
+            g_embed = grads["embed"]
+            if args.vpp > 1:
+                total = embedding_grads_all_reduce(g_embed.sum(axis=0))
+                g_embed = jnp.broadcast_to(total, g_embed.shape)
+            else:
+                g_embed = embedding_grads_all_reduce(g_embed)
+            grads["embed"] = g_embed
             if dp > 1:
                 grads = flat_allreduce(grads, axis_name="data")
                 grads = jax.tree.map(lambda g: g / dp, grads)

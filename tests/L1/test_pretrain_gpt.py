@@ -19,3 +19,12 @@ def test_gpt_pretrain_learns(tp, pp):
     assert np.all(np.isfinite(losses))
     assert losses[-1] < 1.5, (tp, pp, losses[0], losses[-1])
     assert losses[-1] < losses[0] * 0.4
+
+
+def test_gpt_pretrain_learns_interleaved():
+    """vpp=2: interleaved-1F1B executor, 4 virtual stages on 2 ranks,
+    tied embeddings reconciled across chunks."""
+    losses = main(["--tp", "2", "--pp", "2", "--vpp", "2",
+                   "--iters", "30"])
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < 1.0, (losses[0], losses[-1])
