@@ -22,9 +22,14 @@ from .fused_update import (
     adam_reference,
 )
 from .attention import flash_attention, mha_reference
+from .ring_attention import ring_attention, ring_attention_reference
+from .ulysses_attention import ulysses_attention
 from .xentropy import softmax_cross_entropy_loss, xentropy_reference
 
 __all__ = [
+    "ring_attention",
+    "ring_attention_reference",
+    "ulysses_attention",
     "layer_norm",
     "rms_norm",
     "layer_norm_reference",
