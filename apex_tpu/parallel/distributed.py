@@ -35,11 +35,38 @@ __all__ = ["DistributedDataParallel", "flat_allreduce"]
 _DEFAULT_MESSAGE_SIZE = 10_000_000  # bytes, reference default ~10MB
 
 
-def flat_allreduce(tree, axis_name: str = "data"):
+def _resolve_data_axes(axis_name):
+    """``None`` -> the FULL data-parallel group: dense params replicate
+    over the ``expert`` axis too when expert parallelism is active, so
+    their grad reduction must span ``("data", "expert")`` — reducing
+    over the bare ``data`` axis there silently desyncs the dense
+    replicas across expert ranks.  An explicit ``axis_name`` is passed
+    through untouched (expert params, custom topologies)."""
+    if axis_name is not None:
+        return axis_name
+    from apex_tpu.transformer import parallel_state as ps
+    if (ps.model_parallel_is_initialized()
+            and ps.get_expert_model_parallel_world_size() > 1):
+        return ps.get_data_parallel_group(with_expert_parallel=True)
+    return "data"
+
+
+def _axes_size(axis_name):
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    world = 1
+    for a in axes:
+        world *= jax.lax.axis_size(a)
+    return world
+
+
+def flat_allreduce(tree, axis_name=None):
     """Flatten a pytree, one psum, unflatten (reference: ``flat_dist_call``
-    over ``apex_C.flatten``/``unflatten`` + ``dist.all_reduce``)."""
+    over ``apex_C.flatten``/``unflatten`` + ``dist.all_reduce``).
+
+    ``axis_name=None`` resolves to the full data-parallel group,
+    including the ``expert`` axis when expert parallelism is active."""
     flat, unravel = tree_ravel(tree)
-    return unravel(jax.lax.psum(flat, axis_name))
+    return unravel(jax.lax.psum(flat, _resolve_data_axes(axis_name)))
 
 
 class DistributedDataParallel:
@@ -55,7 +82,7 @@ class DistributedDataParallel:
                  allreduce_always_fp32: bool = False,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
-                 axis_name: str = "data",
+                 axis_name=None,
                  num_allreduce_streams: int = 1,
                  allreduce_communicators=None,
                  shared_param=None):
@@ -65,7 +92,15 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
-        self.axis_name = axis_name
+        # raw arg kept; resolution happens at reduce time — resolving
+        # here would freeze 'data' for the usual wrap-then-init ordering
+        # (DDP constructed before initialize_model_parallel) and miss an
+        # expert axis created later
+        self._axis_name = axis_name
+
+    @property
+    def axis_name(self):
+        return _resolve_data_axes(self._axis_name)
 
     def __call__(self, *args, **kw):
         if self.module is None:
@@ -82,7 +117,7 @@ class DistributedDataParallel:
             flat = flat / self.gradient_predivide_factor
         flat = jax.lax.psum(flat, self.axis_name)
         if self.gradient_average:
-            world = jax.lax.axis_size(self.axis_name)
+            world = _axes_size(self.axis_name)
             post = self.gradient_predivide_factor / world
             if post != 1.0:
                 flat = flat * post

@@ -1,0 +1,73 @@
+"""Expert FFNs evaluated as one batched einsum (beyond reference parity).
+
+Megatron-core's ``GroupedMLP`` exists because a per-expert Python loop of
+small GEMMs starves the GPU; it groups them via CUTLASS grouped-GEMM.
+The TPU-native equivalent is simpler: hold the local experts' weights as
+expert-major stacked tensors ``[E_local, h, ffn]`` and contract with the
+capacity-padded token buffer ``[E_local, cap, h]`` in a single
+``einsum('ech,ehf->ecf')`` — XLA lowers it to one batched MXU matmul, no
+grouping machinery required.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GroupedMLP", "expert_init"]
+
+# Per-expert 2-D xavier draw over the stacked [E, in, out] tensor: the
+# expert dim must be declared batch_axis or variance_scaling folds it
+# into fan_in and every expert's weights come out ~sqrt(E) too small.
+expert_init = nn.initializers.variance_scaling(
+    1.0, "fan_avg", "truncated_normal", in_axis=-2, out_axis=-1,
+    batch_axis=(0,))
+
+
+class GroupedMLP(nn.Module):
+    """The local shard of experts: ``num_local_experts`` independent
+    2-layer FFNs applied to an expert-major token buffer.
+
+    Input/output: ``[num_local_experts, capacity, hidden]``.  Each expert
+    ``e`` sees only its own capacity slots — exactly the buffer layout the
+    dispatch einsum produces (:mod:`apex_tpu.transformer.moe.layer`).
+
+    ``ffn_hidden_size`` is the LOCAL width: under tensor parallelism the
+    caller passes ``ffn/tp`` and owns the output psum (the Column->Row
+    parallel pattern collapsed into the expert einsums).  ``use_bias``
+    must then be False — a per-rank output bias would be summed tp times
+    by that psum (the bias-free convention of Megatron/Mixtral MoE).
+
+    Weights init per-expert independently (``expert_init`` declares the
+    expert dim as batch_axis) and, under expert/tensor parallelism,
+    per-rank independently via the caller's key folding.
+    """
+    num_local_experts: int
+    hidden_size: int
+    ffn_hidden_size: int
+    activation: Callable = nn.gelu
+    use_bias: bool = True
+    params_dtype: Any = jnp.float32
+    init_method: Callable = expert_init
+
+    @nn.compact
+    def __call__(self, x):
+        e, h, f = (self.num_local_experts, self.hidden_size,
+                   self.ffn_hidden_size)
+        w1 = self.param("w1", self.init_method, (e, h, f), self.params_dtype)
+        w2 = self.param("w2", self.init_method, (e, f, h), self.params_dtype)
+        dt = x.dtype
+        y = jnp.einsum("ech,ehf->ecf", x, w1.astype(dt))
+        if self.use_bias:
+            b1 = self.param("b1", nn.initializers.zeros, (e, 1, f),
+                            self.params_dtype)
+            y = y + b1.astype(dt)
+        y = self.activation(y)
+        out = jnp.einsum("ecf,efh->ech", y, w2.astype(dt))
+        if self.use_bias:
+            b2 = self.param("b2", nn.initializers.zeros, (e, 1, h),
+                            self.params_dtype)
+            out = out + b2.astype(dt)
+        return out

@@ -1,0 +1,264 @@
+"""MoE layer: dense dispatch/combine + all_to_all expert parallelism.
+
+Beyond reference parity (SURVEY.md §2.4 marks EP "No"); design is the
+canonical TPU MoE of GShard (Lepikhin et al. 2020) / Switch (Fedus et
+al. 2021), with Megatron-core's layer naming.
+
+Why dense einsum dispatch and not gather/scatter: XLA wants static
+shapes, and the MXU wants matmuls.  Routing decisions become a one-hot
+``dispatch`` tensor ``[tokens, E, capacity]``; moving tokens into the
+expert-major buffer is then ``einsum('sec,sh->ech')`` — a matmul with a
+0/1 operand that XLA tiles onto the MXU — and returning them is the
+transpose einsum weighted by the gates.  No dynamic indexing anywhere,
+so the whole layer jits once regardless of routing.
+
+Expert parallelism: with ``E`` global experts over ``ep`` ranks, each
+rank dispatches its local tokens into the GLOBAL ``[E, C, h]`` buffer,
+then one ``lax.all_to_all`` over the ``expert`` mesh axis reshards it so
+each rank holds its ``E/ep`` local experts' slots from EVERY source
+rank (``[E_local, ep*C, h]``).  After the expert FFNs, the inverse
+``all_to_all`` routes tokens home.  Exactly two collectives per layer,
+both riding ICI; ``lax.all_to_all`` is differentiable so the backward
+is the mirrored pair automatically.
+
+Capacity per expert defaults to ``ceil(capacity_factor * S * k / E)``
+rounded up to a multiple of 8 (TPU lane-friendly; the pad slots carry
+zero weight through both einsums).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.moe.experts import GroupedMLP, expert_init
+from apex_tpu.transformer.moe.router import TopKRouter
+from apex_tpu.transformer.parallel_state import (DATA_AXIS, EXPERT_AXIS,
+                                                 TENSOR_AXIS)
+from apex_tpu.transformer.tensor_parallel import mappings
+from apex_tpu.utils import round_up
+
+__all__ = ["MoELayer", "compute_dispatch_and_combine", "reduce_moe_grads"]
+
+
+def reduce_moe_grads(grads, *, dense_axes=(DATA_AXIS, EXPERT_AXIS),
+                     expert_axes=(DATA_AXIS,)):
+    """Average an MoE layer's grad tree over each param's replica axes.
+
+    MoE splits the data-parallel reduction (the analog of Megatron's
+    allreduce over _DATA_MODULO_EXPERT_PARALLEL_GROUP vs the full DP
+    group):
+
+    * subtrees under an ``experts`` key (the GroupedMLP weights) are
+      replicated along ``data`` only — the ``expert`` axis holds
+      *different* experts — so they reduce over ``expert_axes``;
+    * everything else (router + any dense params reached through the
+      same tree) is replicated along both, reducing over ``dense_axes``.
+
+    :class:`MoELayer` with ``tensor_parallel_size=tp`` +
+    ``sequence_parallel=True`` needs NO tensor-axis reduction here: the
+    in-layer gather makes every TP rank route the same tokens (router
+    grads replica-consistent) and the expert ffn shards are rank-local.
+    Only when running a tp=1 MoELayer directly on sequence-sharded
+    activations does the MoE region act data-parallel along the tensor
+    axis — append that axis to BOTH tuples there (the same obligation
+    Megatron's ``allreduce_sequence_parallel_gradients`` covers for SP
+    LayerNorm params).
+
+    Uses ``pmean`` (grads averaged, matching the DDP predivide
+    convention elsewhere in the package).
+    """
+    import jax.tree_util as jtu
+
+    def f(path, g):
+        names = {p.key for p in path if isinstance(p, jtu.DictKey)}
+        axes = expert_axes if "experts" in names else dense_axes
+        return jax.lax.pmean(g, axes) if axes else g
+    return jtu.tree_map_with_path(f, grads)
+
+
+def compute_dispatch_and_combine(gates, expert_index, num_experts: int,
+                                 capacity: int):
+    """Turn top-k routing decisions into dense dispatch/combine tensors.
+
+    ``gates``/``expert_index``: [S, k].  Returns ``(dispatch, combine)``
+    with shapes [S, E, C]: ``dispatch`` is 0/1 (token s occupies slot c
+    of expert e), ``combine = gate * dispatch``.
+
+    Slot assignment is GShard's: priority order is (k-slot, token) — all
+    top-1 choices beat all top-2 choices, ties broken by token position —
+    computed with ONE cumsum over the k-major flattened one-hot, no loop
+    over experts.  Tokens past an expert's capacity are dropped (zero
+    rows in both tensors).
+    """
+    s, k = gates.shape
+    onehot = jax.nn.one_hot(expert_index, num_experts,
+                            dtype=jnp.float32)          # [S, k, E]
+    km = onehot.transpose(1, 0, 2).reshape(k * s, num_experts)
+    pos = jnp.cumsum(km, axis=0) - km                    # slots before me
+    pos = pos.reshape(k, s, num_experts).transpose(1, 0, 2)  # [S, k, E]
+    within = onehot * (pos < capacity)                   # kept choices
+    # An expert appears at most once in a token's top-k, so the k axis
+    # collapses to [S, E] before the capacity one-hot — the biggest
+    # intermediate is [S, E, C], never [S, k, E, C].
+    kept = within.sum(axis=1)                            # [S, E] in {0,1}
+    pos_se = (pos * within).sum(axis=1)                  # [S, E]
+    gate_se = (gates[..., None] * within).sum(axis=1)    # [S, E]
+    dispatch = kept[..., None] * jax.nn.one_hot(
+        pos_se.astype(jnp.int32), capacity, dtype=jnp.float32)
+    combine = gate_se[..., None] * dispatch
+    return dispatch, combine
+
+
+class MoELayer(nn.Module):
+    """Sparsely-activated FFN (Megatron-core: ``MoELayer``).
+
+    Call with ``x`` of shape ``[..., hidden]``; leading dims are
+    flattened into a token axis.  Returns ``(y, aux)`` where ``aux``
+    holds the router's ``load_balancing_loss`` and ``z_loss`` scalars
+    (scale by your coefficients and add to the task loss; under
+    data/expert parallelism, mean them over those axes).
+
+    Parallel composition (all static config; >1 requires running inside
+    ``shard_map`` with the named axis bound):
+
+    * ``expert_parallel_size`` — experts shard over ``expert_axis``;
+      token exchange is the ``all_to_all`` round trip.
+    * ``tensor_parallel_size`` — each expert's FFN shards its ffn dim
+      over ``tensor_axis`` (the Column->Row parallel pattern collapsed
+      into the expert einsums, Megatron's MoE+TP): the router and
+      dispatch replicate, each rank computes a partial output with its
+      ``ffn/tp`` slice, and ONE psum (or reduce-scatter under SP)
+      finishes the layer.  Experts are bias-free under TP (a per-rank
+      output bias would be summed tp times), the Megatron/Mixtral
+      convention.
+    * ``sequence_parallel`` — input arrives sequence-sharded on dim 0
+      (Megatron ``[s/tp, b, h]`` layout); it is all-gathered over
+      ``tensor_axis`` so every TP rank routes the SAME token set (router
+      grads stay replica-consistent) and the output is reduce-scattered
+      back.  Exactly the ColumnParallelLinear-under-SP collective pair.
+
+    With all sizes 1 (default) the layer is a plain single-shard MoE —
+    identical math, zero collectives.
+    """
+    num_experts: int
+    hidden_size: int
+    ffn_hidden_size: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    capacity: Optional[int] = None            # override the formula
+    expert_parallel_size: int = 1
+    expert_axis: str = EXPERT_AXIS
+    tensor_parallel_size: int = 1
+    tensor_axis: str = TENSOR_AXIS
+    sequence_parallel: bool = False
+    activation: Callable = nn.gelu
+    params_dtype: Any = jnp.float32
+    jitter_eps: float = 0.0
+
+    def _expert_init(self, init: Callable) -> Callable:
+        """Fold the expert-axis and tensor-axis ranks into the init key
+        so each rank draws DIFFERENT local experts / ffn shards (same
+        trick as the TP layers' shard init — reference inits the full
+        master weight then scatters)."""
+        ep, tp = self.expert_parallel_size, self.tensor_parallel_size
+        if ep == 1 and tp == 1:
+            return init
+
+        def f(key, shape, dtype):
+            if ep > 1:
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(self.expert_axis))
+            if tp > 1:
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(self.tensor_axis) + 1)
+            return init(key, shape, dtype)
+        return f
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        ep, tp = self.expert_parallel_size, self.tensor_parallel_size
+        if self.num_experts % ep:
+            raise ValueError(f"num_experts ({self.num_experts}) not "
+                             f"divisible by expert_parallel_size ({ep})")
+        if self.ffn_hidden_size % tp:
+            raise ValueError(f"ffn_hidden_size ({self.ffn_hidden_size}) "
+                             f"not divisible by tensor_parallel_size ({tp})")
+        if self.sequence_parallel:
+            # gather the sequence shards so all TP ranks route the same
+            # tokens.  tensor_parallel_output_grad=False: by the time
+            # the cotangent reaches this gather it is already FULL and
+            # replicated on every rank (the router path is replicated
+            # and the dispatch path was psummed by copy_to's backward
+            # around the expert MLP below), so the backward must SLICE,
+            # not reduce-scatter — a sum here would count each
+            # contribution tp times.
+            x = mappings.gather_from_sequence_parallel_region(
+                x, self.tensor_axis, tensor_parallel_output_grad=False)
+        lead, h = x.shape[:-1], x.shape[-1]
+        tokens = x.reshape(-1, h)
+        s = tokens.shape[0]
+        cap = self.capacity if self.capacity is not None else round_up(
+            max(1, -(-int(self.capacity_factor * s * self.top_k) //
+                     self.num_experts)), 8)
+
+        gates, expert_index, aux = TopKRouter(
+            num_experts=self.num_experts, top_k=self.top_k,
+            jitter_eps=self.jitter_eps, name="router")(
+                tokens, deterministic=deterministic)
+        dispatch, combine = compute_dispatch_and_combine(
+            gates, expert_index, self.num_experts, cap)
+
+        dt = tokens.dtype
+        buf = jnp.einsum("sec,sh->ech", dispatch.astype(dt), tokens)
+        e_local = self.num_experts // ep
+        if ep > 1:
+            # [E, C, h] -> rows grouped by destination rank -> exchange ->
+            # [E_local, ep*C, h]: my experts' slots from every source rank
+            buf = buf.reshape(ep, e_local, cap, h)
+            buf = jax.lax.all_to_all(buf, self.expert_axis,
+                                     split_axis=0, concat_axis=0)
+            buf = buf.transpose(1, 0, 2, 3).reshape(e_local, ep * cap, h)
+        if tp > 1:
+            # The TP boundary wraps ONLY the expert MLP (Megatron: each
+            # expert is a Column->Row parallel pair).  copy_to: identity
+            # forward / psum backward — the rank-partial d(buf) from the
+            # ffn shards must be summed, while the replicated router/
+            # dispatch paths outside this region keep their replicated
+            # (already-full) cotangents untouched.
+            buf = mappings.copy_to_tensor_model_parallel_region(
+                buf, self.tensor_axis)
+        expert_out = GroupedMLP(
+            num_local_experts=e_local, hidden_size=h,
+            ffn_hidden_size=self.ffn_hidden_size // tp,
+            activation=self.activation, use_bias=(tp == 1),
+            params_dtype=self.params_dtype,
+            init_method=self._expert_init(expert_init),
+            name="experts")(buf)
+        if tp > 1:
+            # psum the ffn-shard partials BEFORE combine (Megatron: the
+            # per-expert RowParallel allreduce).  Reducing after combine
+            # would move fewer bytes ([S,h] vs [E,C,h] ~ k*cf larger)
+            # but would leave the router's gate grads rank-partial —
+            # each rank's combine cotangent would see only its local
+            # partial expert output — silently desyncing router
+            # replicas; here combine sees the FULL expert output, so
+            # router grads are replica-consistent by construction.
+            expert_out = mappings.reduce_from_tensor_model_parallel_region(
+                expert_out, self.tensor_axis)
+        if ep > 1:
+            expert_out = expert_out.reshape(e_local, ep, cap, h)
+            expert_out = expert_out.transpose(1, 0, 2, 3)
+            expert_out = jax.lax.all_to_all(expert_out, self.expert_axis,
+                                            split_axis=0, concat_axis=0)
+            expert_out = expert_out.reshape(self.num_experts, cap, h)
+        y = jnp.einsum("sec,ech->sh", combine.astype(dt), expert_out)
+        y = y.reshape(*lead, h)
+        if self.sequence_parallel:
+            # output is already full (tensor psum above): just slice my
+            # sequence shard back out; backward all-gathers
+            y = mappings.scatter_to_sequence_parallel_region(
+                y, self.tensor_axis)
+        return y, aux

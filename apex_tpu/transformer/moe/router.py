@@ -1,0 +1,88 @@
+"""Top-k router for MoE layers (beyond reference parity — SURVEY.md §2.4).
+
+Gating follows the Switch/GShard recipe with Megatron-core's knob names:
+
+* router logits are computed in **fp32** regardless of the activation
+  dtype (tiny matmul; softmax numerics dominate quality),
+* top-k selection + renormalized gates,
+* the Switch **load-balancing loss** ``E * sum_e f_e * P_e`` (f = fraction
+  of tokens whose top-1 choice is expert e, P = mean router probability
+  for e) — minimized at uniform routing where it equals 1,
+* the ST-MoE **router z-loss** ``mean(logsumexp(logits)^2)`` keeping the
+  logits from drifting into bf16-hostile magnitudes.
+
+Aux losses are returned, not summed into the output — the caller scales
+them by ``aux_loss_coeff``/``z_loss_coeff`` and adds them to the task
+loss (exactly how Megatron's MoEAuxLossAutoScaler is used).
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TopKRouter", "load_balancing_loss", "router_z_loss"]
+
+
+def load_balancing_loss(router_probs, expert_index_one_hot) -> jnp.ndarray:
+    """Switch aux loss: ``E * sum_e f_e * P_e`` (Fedus et al. 2021 eq. 4).
+
+    ``router_probs``: [tokens, E] fp32 softmax probabilities.
+    ``expert_index_one_hot``: [tokens, E] 0/1, a token's CHOSEN experts
+    (top-k union; for k>1 each chosen expert contributes, normalized by k
+    so the uniform-routing minimum stays 1).
+    """
+    num_experts = router_probs.shape[-1]
+    k = jnp.maximum(expert_index_one_hot.sum() /
+                    expert_index_one_hot.shape[0], 1e-9)
+    f = expert_index_one_hot.mean(axis=0) / k   # sums to 1 over experts
+    p = router_probs.mean(axis=0)
+    return num_experts * jnp.sum(f * p)
+
+
+def router_z_loss(router_logits) -> jnp.ndarray:
+    """ST-MoE z-loss: ``mean(logsumexp(logits)^2)`` (Zoph et al. 2022)."""
+    z = jax.nn.logsumexp(router_logits, axis=-1)
+    return jnp.mean(z * z)
+
+
+class TopKRouter(nn.Module):
+    """Learned top-k gate (Megatron-core: ``TopKRouter``).
+
+    Returns ``(gates, expert_index, aux)`` where
+
+    * ``gates`` — [tokens, k] fp32 combine weights (renormalized over the
+      selected k when ``renormalize``, the Megatron
+      ``moe_router_topk>1`` default),
+    * ``expert_index`` — [tokens, k] int32 selected expert ids,
+    * ``aux`` — dict with ``load_balancing_loss`` and ``z_loss`` scalars.
+    """
+    num_experts: int
+    top_k: int = 2
+    renormalize: bool = True
+    jitter_eps: float = 0.0    # multiplicative input jitter (train only)
+    init_method: Callable = nn.initializers.normal(stddev=0.02)
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
+        if self.jitter_eps and not deterministic:
+            key = self.make_rng("jitter")
+            x = x * jax.random.uniform(
+                key, x.shape, x.dtype,
+                1.0 - self.jitter_eps, 1.0 + self.jitter_eps)
+        w = self.param("weight", self.init_method,
+                       (self.num_experts, x.shape[-1]), jnp.float32)
+        logits = jnp.matmul(x.astype(jnp.float32), w.T)      # [tokens, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, expert_index = jax.lax.top_k(probs, self.top_k)
+        if self.renormalize and self.top_k > 1:
+            gates = gates / jnp.maximum(
+                gates.sum(axis=-1, keepdims=True), 1e-9)
+        chosen = jax.nn.one_hot(
+            expert_index, self.num_experts, dtype=jnp.float32).sum(axis=1)
+        aux = {"load_balancing_loss": load_balancing_loss(probs, chosen),
+               "z_loss": router_z_loss(logits)}
+        return gates, expert_index, aux

@@ -17,12 +17,15 @@ destroy groups         :func:`destroy_model_parallel`
 =====================  ==========================================
 
 Rank ordering matches Megatron: global rank =
-``pp_rank * (dp*cp*tp) + dp_rank * (cp*tp) + cp_rank * tp + tp_rank`` —
-i.e. TP ranks are adjacent devices (ride ICI), PP is outermost.  The mesh
-axes are ``("pipe", "data", "context", "tensor")``; the ``context`` axis is
-an extension over the reference for ring-attention context parallelism
-(the reference's longest-sequence tool is Megatron SP, which reuses the
-tensor axis — see SURVEY.md §2.4).
+``pp_rank * (dp*ep*cp*tp) + dp_rank * (ep*cp*tp) + ep_rank * (cp*tp)
++ cp_rank * tp + tp_rank`` — i.e. TP ranks are adjacent devices (ride
+ICI), PP is outermost.  The mesh axes are ``("pipe", "data", "expert",
+"context", "tensor")``; the ``context`` and ``expert`` axes are
+extensions over the reference — ring-attention context parallelism and
+MoE expert parallelism respectively (SURVEY.md §2.4 marks both "No" in
+the reference; the task spec makes them first-class).  Both default to
+size 1, in which case the mesh is exactly the reference's TP x PP x DP
+topology.
 
 World sizes are static Python ints (available any time after
 ``initialize_model_parallel``).  Ranks exist only inside a traced/sharded
@@ -46,6 +49,8 @@ __all__ = [
     "get_pipeline_model_parallel_group",
     "get_data_parallel_group",
     "get_context_parallel_group",
+    "get_expert_model_parallel_group",
+    "get_data_modulo_expert_parallel_group",
     "get_embedding_group",
     "get_position_embedding_group",
     "get_amax_reduction_group",
@@ -53,10 +58,12 @@ __all__ = [
     "get_pipeline_model_parallel_world_size",
     "get_data_parallel_world_size",
     "get_context_parallel_world_size",
+    "get_expert_model_parallel_world_size",
     "get_tensor_model_parallel_rank",
     "get_pipeline_model_parallel_rank",
     "get_data_parallel_rank",
     "get_context_parallel_rank",
+    "get_expert_model_parallel_rank",
     "get_pipeline_model_parallel_prev_rank",
     "get_pipeline_model_parallel_next_rank",
     "is_pipeline_first_stage",
@@ -72,6 +79,7 @@ TENSOR_AXIS = "tensor"
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 CONTEXT_AXIS = "context"
+EXPERT_AXIS = "expert"
 
 _MESH: Optional[Mesh] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
@@ -84,6 +92,7 @@ def initialize_model_parallel(
         virtual_pipeline_model_parallel_size_: Optional[int] = None,
         pipeline_model_parallel_split_rank_: Optional[int] = None,
         context_parallel_size_: int = 1,
+        expert_model_parallel_size_: int = 1,
         *,
         devices: Optional[Sequence] = None,
         default_backend: Optional[str] = None,
@@ -96,24 +105,35 @@ def initialize_model_parallel(
     selection (ICI intra-slice, DCN across slices).
 
     Data-parallel size is inferred as
-    ``n_devices // (tp * pp * cp)``, like the reference infers it from the
-    world size.
+    ``n_devices // (tp * pp * cp * ep)``, like the reference infers it
+    from the world size.
+
+    Expert parallelism (``expert_model_parallel_size_``, beyond reference
+    parity — SURVEY.md §2.4 marks EP "No"; the rebuild makes it
+    first-class): the mesh gains an ``expert`` axis carved out of the
+    data-parallel dimension, Megatron-core style.  Dense (non-expert)
+    params are data-parallel over ``(data, expert)`` jointly; expert
+    params are data-parallel over ``data`` alone (the "data modulo
+    expert" group) and model-parallel over ``expert``.
     """
     global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     tp = tensor_model_parallel_size_
     pp = pipeline_model_parallel_size_
     cp = context_parallel_size_
+    ep = expert_model_parallel_size_
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    denom = tp * pp * cp
+    denom = tp * pp * cp * ep
     if n % denom != 0:
         raise RuntimeError(
             f"world size ({n}) is not divisible by tensor ({tp}) x "
-            f"pipeline ({pp}) x context ({cp}) parallel sizes")
+            f"pipeline ({pp}) x context ({cp}) x expert ({ep}) "
+            "parallel sizes")
     dp = n // denom
-    grid = np.asarray(devices, dtype=object).reshape(pp, dp, cp, tp)
-    _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS))
+    grid = np.asarray(devices, dtype=object).reshape(pp, dp, ep, cp, tp)
+    _MESH = Mesh(grid, (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, CONTEXT_AXIS,
+                        TENSOR_AXIS))
     if virtual_pipeline_model_parallel_size_ is not None:
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = (
             virtual_pipeline_model_parallel_size_)
@@ -153,14 +173,40 @@ def get_pipeline_model_parallel_group() -> str:
     return PIPE_AXIS
 
 
-def get_data_parallel_group() -> str:
+def get_data_parallel_group(with_expert_parallel: bool = False):
+    """Data-parallel axis (reference: _DATA_PARALLEL_GROUP).
+
+    With expert parallelism active, DENSE params replicate over both the
+    ``data`` and ``expert`` axes — pass ``with_expert_parallel=True`` to
+    get the axis tuple their grad psum must span (``jax.lax.psum``
+    accepts it directly).  Expert params reduce over the bare ``data``
+    axis (see :func:`get_data_modulo_expert_parallel_group`).
+    """
     get_mesh()
+    if with_expert_parallel:
+        return (DATA_AXIS, EXPERT_AXIS)
     return DATA_AXIS
 
 
 def get_context_parallel_group() -> str:
     get_mesh()
     return CONTEXT_AXIS
+
+
+def get_expert_model_parallel_group() -> str:
+    """Mesh axis sharding the experts of MoE layers (beyond reference
+    parity; Megatron-core: _EXPERT_MODEL_PARALLEL_GROUP)."""
+    get_mesh()
+    return EXPERT_AXIS
+
+
+def get_data_modulo_expert_parallel_group() -> str:
+    """Data-parallel group for EXPERT params (Megatron-core:
+    _DATA_MODULO_EXPERT_PARALLEL_GROUP): the replicas of one expert shard
+    live along the bare ``data`` axis — the ``expert`` axis holds
+    *different* experts, not copies."""
+    get_mesh()
+    return DATA_AXIS
 
 
 def get_embedding_group() -> str:
@@ -188,7 +234,7 @@ def get_amax_reduction_group() -> tuple:
     over those axes, so the "group" is the axis tuple accepted by
     ``jax.lax.psum``."""
     get_mesh()
-    return (DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+    return (DATA_AXIS, EXPERT_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 
 # --- static world sizes -----------------------------------------------------
@@ -207,6 +253,10 @@ def get_data_parallel_world_size() -> int:
 
 def get_context_parallel_world_size() -> int:
     return get_mesh().shape[CONTEXT_AXIS]
+
+
+def get_expert_model_parallel_world_size() -> int:
+    return get_mesh().shape[EXPERT_AXIS]
 
 
 # --- ranks (traced inside shard_map; static 0 when axis size is 1) ----------
@@ -237,6 +287,10 @@ def get_data_parallel_rank():
 
 def get_context_parallel_rank():
     return _axis_rank(CONTEXT_AXIS)
+
+
+def get_expert_model_parallel_rank():
+    return _axis_rank(EXPERT_AXIS)
 
 
 def get_pipeline_model_parallel_prev_rank():

@@ -67,6 +67,22 @@ class GPTConfig:
     context_parallel: bool = False             # ring attention over 'context'
     remat: bool = False                        # jax.checkpoint per layer
     scan_layers: bool = False                  # lax.scan over layers
+    # MoE (beyond reference parity; Megatron-core arg names): replace the
+    # dense FFN with num_moe_experts top-k routed experts.  With
+    # expert_model_parallel the experts shard over the mesh's 'expert'
+    # axis (requires running inside shard_map binding it).  The router's
+    # aux losses are sown into the "intermediates" collection as
+    # moe_lb_loss / moe_z_loss — training loops scale them by their
+    # coefficients and add to the task loss.  TP/SP compose inside the
+    # layer (each expert's ffn dim shards over the tensor axis; under SP
+    # the sequence is gathered in / reduce-scattered out, so router
+    # grads stay replica-consistent across TP ranks).  Grad-reduction
+    # contract: router/expert grads have DIFFERENT replica axes than
+    # the dense params — reduce them with moe.reduce_moe_grads.
+    num_moe_experts: Optional[int] = None
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    expert_model_parallel: bool = False
 
     @property
     def ffn(self) -> int:
@@ -82,6 +98,12 @@ def _tp() -> int:
 def _cp() -> int:
     if parallel_state.model_parallel_is_initialized():
         return parallel_state.get_context_parallel_world_size()
+    return 1
+
+
+def _ep() -> int:
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_expert_model_parallel_world_size()
     return 1
 
 
@@ -179,7 +201,25 @@ class ParallelTransformerLayer(nn.Module):
         x = x + h
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                            name="post_attention_layernorm")(x)
-        h = ParallelMLP(cfg, name="mlp")(h, deterministic)
+        if cfg.num_moe_experts:
+            from apex_tpu.transformer.moe import MoELayer
+            h, aux = MoELayer(
+                num_experts=cfg.num_moe_experts,
+                hidden_size=cfg.hidden_size,
+                ffn_hidden_size=cfg.ffn,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                expert_parallel_size=_ep() if cfg.expert_model_parallel
+                else 1,
+                tensor_parallel_size=_tp(),
+                sequence_parallel=cfg.sequence_parallel,
+                params_dtype=cfg.params_dtype,
+                name="mlp")(h, deterministic=deterministic)
+            self.sow("intermediates", "moe_lb_loss",
+                     aux["load_balancing_loss"])
+            self.sow("intermediates", "moe_z_loss", aux["z_loss"])
+        else:
+            h = ParallelMLP(cfg, name="mlp")(h, deterministic)
         if not deterministic and cfg.hidden_dropout > 0.0:
             h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=False)
         return x + h
@@ -246,7 +286,10 @@ class GPTModel(nn.Module):
                     policy=jax.checkpoint_policies.nothing_saveable)
             self.layers = nn.scan(
                 block,
-                variable_axes={"params": 0},
+                # intermediates must be declared or nn.scan silently drops
+                # sown values (the MoE aux losses) — each leaf comes back
+                # stacked [num_layers]
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 in_axes=(nn.broadcast, nn.broadcast),

@@ -184,10 +184,15 @@ def _microbench_adam(rtt: float, on_tpu: bool):
               weight_decay=0.01, step=1)
     iters = 20 if on_tpu else 3
 
+    # g/m/v MUST be _bench_fn args, not closure captures: jit inlines
+    # closed-over ndarrays as HLO constants, and 3x400 MB of constants
+    # overflows the axon tunnel's compile-request limit (HTTP 413)
     t_fused = _bench_fn(
-        lambda p_: fused_adam_flat(p_, g, m, v, **hp), (p,), iters, rtt)
+        lambda p_, g_, m_, v_: fused_adam_flat(p_, g_, m_, v_, **hp),
+        (p, g, m, v), iters, rtt)
     t_ref = _bench_fn(
-        lambda p_: adam_reference(p_, g, m, v, **hp), (p,), iters, rtt)
+        lambda p_, g_, m_, v_: adam_reference(p_, g_, m_, v_, **hp),
+        (p, g, m, v), iters, rtt)
     return {"fused_adam_us": round(t_fused * 1e6, 1),
             "unfused_adam_us": round(t_ref * 1e6, 1),
             "adam_speedup": round(t_ref / t_fused, 3),

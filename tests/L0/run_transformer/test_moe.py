@@ -1,0 +1,453 @@
+"""MoE / expert-parallelism tests (beyond reference parity — SURVEY.md
+§2.4 marks EP "No"; the rebuild makes it first-class).
+
+Strategy mirrors the TP-layer tests: the sharded (ep>1, all_to_all)
+layer must reproduce a dense (ep=1) computation with the reassembled
+global expert weights, shard by shard.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.moe import MoELayer, reduce_moe_grads
+from apex_tpu.transformer.moe.layer import compute_dispatch_and_combine
+from apex_tpu.transformer.moe.router import (load_balancing_loss,
+                                             router_z_loss)
+
+E, H, F, K = 4, 8, 16, 2
+EP = 4
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(expert_model_parallel_size_=EP)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def _dense_moe_reference(tokens, params, capacity):
+    """Hand computation: gate -> capacity-drop -> per-expert FFN -> sum."""
+    w = np.asarray(params["router"]["weight"], np.float32)
+    logits = np.asarray(tokens, np.float32) @ w.T
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    idx = np.argsort(-probs, axis=-1)[:, :K]
+    gates = np.take_along_axis(probs, idx, axis=-1)
+    gates = gates / gates.sum(-1, keepdims=True)
+    # GShard slot assignment: k-major priority
+    count = np.zeros(E, np.int64)
+    kept = np.zeros((tokens.shape[0], E))
+    gate_se = np.zeros((tokens.shape[0], E))
+    for k in range(K):
+        for s in range(tokens.shape[0]):
+            e = idx[s, k]
+            if count[e] < capacity:
+                kept[s, e] = 1.0
+                gate_se[s, e] = gates[s, k]
+            count[e] += 1
+    w1 = np.asarray(params["experts"]["w1"], np.float32)
+    w2 = np.asarray(params["experts"]["w2"], np.float32)
+    ex = params["experts"]
+    b1 = np.asarray(ex["b1"], np.float32) if "b1" in ex else \
+        np.zeros((E, 1, w1.shape[-1]), np.float32)
+    b2 = np.asarray(ex["b2"], np.float32) if "b2" in ex else \
+        np.zeros((E, 1, w2.shape[-1]), np.float32)
+    out = np.zeros_like(np.asarray(tokens, np.float32))
+    for e in range(E):
+        y = np.asarray(jax.nn.gelu(tokens @ w1[e] + b1[e][0]))
+        y = y @ w2[e] + b2[e][0]
+        out += gate_se[:, e:e + 1] * kept[:, e:e + 1] * y
+    return out
+
+
+def test_dispatch_combine_capacity_drop():
+    """Three tokens all choosing expert 0 with capacity 2: the third is
+    dropped; slots assigned in token order within a k-slot."""
+    gates = jnp.array([[1.0], [1.0], [1.0]])
+    idx = jnp.array([[0], [0], [0]])
+    dispatch, combine = compute_dispatch_and_combine(gates, idx, E, 2)
+    assert dispatch.shape == (3, E, 2)
+    np.testing.assert_allclose(dispatch[0, 0], [1, 0])
+    np.testing.assert_allclose(dispatch[1, 0], [0, 1])
+    np.testing.assert_allclose(dispatch[2, 0], [0, 0])   # dropped
+    np.testing.assert_allclose(np.asarray(combine), np.asarray(dispatch))
+
+
+def test_dispatch_k_major_priority():
+    """Top-1 choices win capacity slots over top-2 choices regardless of
+    token order (GShard priority)."""
+    # token0 picks expert 1 as its SECOND choice; token1 picks it FIRST.
+    gates = jnp.array([[0.6, 0.4], [0.9, 0.1]])
+    idx = jnp.array([[0, 1], [1, 2]])
+    dispatch, _ = compute_dispatch_and_combine(gates, idx, E, 1)
+    np.testing.assert_allclose(dispatch[1, 1], [1])      # top-1 kept
+    np.testing.assert_allclose(dispatch[0, 1], [0])      # top-2 dropped
+
+
+def test_moe_ep1_matches_dense_reference():
+    tokens = jax.random.normal(jax.random.key(0), (16, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=16)
+    params = layer.init(jax.random.key(1), tokens)
+    y, aux = layer.apply(params, tokens)
+    ref = _dense_moe_reference(tokens, params["params"], capacity=16)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(aux["load_balancing_loss"]))
+    assert np.isfinite(float(aux["z_loss"]))
+
+
+def test_moe_ep4_matches_dense_per_shard():
+    """The all_to_all machinery: ep=4 sharded layer ≡ dense layer run on
+    each shard's tokens with the reassembled global expert weights."""
+    mesh = parallel_state.get_mesh()
+    dp = mesh.shape["data"]
+    t_local, cap = 8, 8
+    tokens = jax.random.normal(jax.random.key(2), (dp * EP * t_local, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=cap, expert_parallel_size=EP)
+
+    def body(x):
+        params = layer.init(jax.random.key(3), x)
+        y, _ = layer.apply(params, x)
+        p = params["params"]
+        return (y, p["router"]["weight"], p["experts"]["w1"],
+                p["experts"]["b1"], p["experts"]["w2"], p["experts"]["b2"])
+
+    y, wr, w1, b1, w2, b2 = jax.jit(
+        functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh,
+            in_specs=(P(("data", "expert")),),
+            out_specs=(P(("data", "expert")), P(), P("expert"), P("expert"),
+                       P("expert"), P("expert"))))(tokens)
+    global_params = {"router": {"weight": wr},
+                     "experts": {"w1": w1, "b1": b1, "w2": w2, "b2": b2}}
+    assert w1.shape == (E, H, F)
+    # per-expert-rank shards drew INDEPENDENT weights (folded init key)
+    e_local = E // EP
+    assert not np.allclose(np.asarray(w1[0]), np.asarray(w1[e_local]))
+    toks = np.asarray(tokens).reshape(dp * EP, t_local, H)
+    ys = np.asarray(y).reshape(dp * EP, t_local, H)
+    for shard in range(dp * EP):
+        ref = _dense_moe_reference(toks[shard], global_params, capacity=cap)
+        np.testing.assert_allclose(ys[shard], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_grads_flow():
+    tokens = jax.random.normal(jax.random.key(4), (16, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=16)
+    params = layer.init(jax.random.key(5), tokens)
+
+    def loss_fn(p):
+        y, aux = layer.apply(p, tokens)
+        return jnp.sum(y * y) + 0.01 * aux["load_balancing_loss"] \
+            + 0.001 * aux["z_loss"]
+
+    grads = jax.grad(loss_fn)(params)["params"]
+    for path in (("router", "weight"), ("experts", "w1"),
+                 ("experts", "w2")):
+        g = grads[path[0]][path[1]]
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).max()) > 0.0
+
+
+def test_expert_init_per_expert_variance():
+    """The stacked [E, h, f] init must give each expert a full 2-D xavier
+    draw — declaring the expert dim as batch_axis; folding it into
+    fan_in would shrink every expert's std by ~sqrt(E)."""
+    from apex_tpu.transformer.moe.experts import expert_init
+
+    e, h, f = 8, 64, 128
+    w = np.asarray(expert_init(jax.random.key(0), (e, h, f), jnp.float32))
+    want = np.sqrt(2.0 / (h + f))          # xavier fan_avg std
+    got = w.reshape(e, -1).std(axis=-1)
+    assert np.all(got > 0.8 * want), (got, want)
+    assert np.all(got < 1.2 * want), (got, want)
+
+
+def test_moe_tp_ep_matches_dense_per_shard():
+    """TP x EP: each expert's ffn dim shards over the tensor axis; the
+    per-rank partial outputs psum to exactly the dense computation with
+    the reassembled [E, h, f] weights."""
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, expert_model_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+    dp, ep, tp = mesh.shape["data"], 2, 2
+    t_local, cap = 8, 16
+    tokens = jax.random.normal(jax.random.key(8), (dp * ep * t_local, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=cap, expert_parallel_size=ep,
+                     tensor_parallel_size=tp)
+
+    def body(x):
+        params = layer.init(jax.random.key(9), x)
+        y, _ = layer.apply(params, x)
+        p = params["params"]
+        return y, p["router"]["weight"], p["experts"]["w1"], \
+            p["experts"]["w2"]
+
+    y, wr, w1, w2 = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P(("data", "expert")),),
+        out_specs=(P(("data", "expert")), P(),
+                   P("expert", None, "tensor"), P("expert", "tensor"))))(
+                       tokens)
+    assert w1.shape == (E, H, F) and w2.shape == (E, F, H)
+    gp = {"router": {"weight": wr}, "experts": {"w1": w1, "w2": w2}}
+    toks = np.asarray(tokens).reshape(dp * ep, t_local, H)
+    ys = np.asarray(y).reshape(dp * ep, t_local, H)
+    for shard in range(dp * ep):
+        ref = _dense_moe_reference(toks[shard], gp, capacity=cap)
+        np.testing.assert_allclose(ys[shard], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_tp_ep_sp_matches_dense_per_shard():
+    """TP x EP x SP: input arrives sequence-sharded [s/tp, b, h]; the
+    layer gathers, routes the full token set identically on every TP
+    rank, and reduce-scatters the psum'd output back to seq shards."""
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2, expert_model_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+    dp, ep, tp = mesh.shape["data"], 2, 2
+    s, b, cap = 16, 2, 32
+    x = jax.random.normal(jax.random.key(10), (s, dp * ep * b, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=cap, expert_parallel_size=ep,
+                     tensor_parallel_size=tp, sequence_parallel=True)
+
+    def body(x):
+        params = layer.init(jax.random.key(11), x)
+        y, _ = layer.apply(params, x)
+        p = params["params"]
+        return y, p["router"]["weight"], p["experts"]["w1"], \
+            p["experts"]["w2"]
+
+    y, wr, w1, w2 = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P("tensor", ("data", "expert")),),
+        out_specs=(P("tensor", ("data", "expert")), P(),
+                   P("expert", None, "tensor"), P("expert", "tensor"))))(x)
+    assert y.shape == x.shape
+    gp = {"router": {"weight": wr}, "experts": {"w1": w1, "w2": w2}}
+    xs = np.asarray(x).reshape(s, dp * ep, b, H)
+    ys = np.asarray(y).reshape(s, dp * ep, b, H)
+    for shard in range(dp * ep):
+        toks = xs[:, shard].reshape(s * b, H)
+        ref = _dense_moe_reference(toks, gp, capacity=cap)
+        np.testing.assert_allclose(ys[:, shard].reshape(s * b, H), ref,
+                                   rtol=2e-4, atol=2e-4)
+
+
+def _dense_moe_jnp(wr, w1, w2, tokens, capacity):
+    """Differentiable dense (unsharded, bias-free) MoE forward in jnp —
+    the grad oracle for the TP-sharded layer."""
+    logits = jnp.matmul(tokens.astype(jnp.float32), wr.T)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, K)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    dispatch, combine = compute_dispatch_and_combine(gates, idx, E, capacity)
+    dt = tokens.dtype
+    buf = jnp.einsum("sec,sh->ech", dispatch.astype(dt), tokens)
+    hidden = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", buf, w1.astype(dt)))
+    out = jnp.einsum("ecf,efh->ech", hidden, w2.astype(dt))
+    return jnp.einsum("sec,ech->sh", combine.astype(dt), out)
+
+
+@pytest.mark.parametrize("sp", [False, True])
+def test_moe_tp_grads_match_dense(sp):
+    """Gradients under TP (+/- SP) must equal the dense oracle's: router
+    grad replica-consistent across TP ranks and equal to the dense
+    grad; w1/w2 shard grads equal the dense grads' slices; input grad
+    equal to the dense input grad (regression: rank-partial router/
+    input cotangents desyncing replicas)."""
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+    tp, s_tok, cap = 2, 16, 32
+    tokens = jax.random.normal(jax.random.key(12), (s_tok, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=cap, tensor_parallel_size=tp,
+                     sequence_parallel=sp)
+
+    def body(x_shard):
+        params = layer.init(jax.random.key(13), x_shard)
+
+        def loss_fn(p, x):
+            # LOCAL loss only — no psum: under SP each rank's shard
+            # cotangent reaches the full output through the scatter's
+            # gather-backward, so grads already equal the dense oracle's
+            # (a psum here would re-seed the cotangent on every rank and
+            # inflate grads by tp)
+            y, _ = layer.apply(p, x)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        (gp, gx) = jax.grad(loss_fn, argnums=(0, 1))(params, x_shard)
+        gp = gp["params"]
+        p = params["params"]
+        if sp:  # full input grad for comparison: stack seq shards
+            return (gp["router"]["weight"][None], gp["experts"]["w1"],
+                    gp["experts"]["w2"], gx,
+                    p["router"]["weight"], p["experts"]["w1"],
+                    p["experts"]["w2"])
+        return (gp["router"]["weight"][None], gp["experts"]["w1"],
+                gp["experts"]["w2"], gx[None],
+                p["router"]["weight"], p["experts"]["w1"],
+                p["experts"]["w2"])
+
+    in_spec = P("tensor") if sp else P()
+    gx_spec = P("tensor") if sp else P("tensor", None)
+    g_wr, g_w1, g_w2, g_x, wr, w1, w2 = jax.jit(
+        functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh,
+            in_specs=(in_spec,),
+            out_specs=(P("tensor"), P(None, None, "tensor"),
+                       P(None, "tensor"), gx_spec, P(),
+                       P(None, None, "tensor"), P(None, "tensor"))))(tokens)
+    if not sp:
+        # router + input grads identical on both TP ranks
+        np.testing.assert_allclose(np.asarray(g_wr[0]), np.asarray(g_wr[1]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g_x[0]), np.asarray(g_x[1]),
+                                   rtol=1e-5, atol=1e-6)
+        g_wr, g_x = g_wr[0], g_x[0]
+    else:
+        g_wr = g_wr.reshape(tp, E, H)
+        np.testing.assert_allclose(np.asarray(g_wr[0]), np.asarray(g_wr[1]),
+                                   rtol=1e-5, atol=1e-6)
+        g_wr = g_wr[0]
+
+    def dense_loss(wr, w1, w2, x):
+        y = _dense_moe_jnp(wr, w1, w2, x, cap)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    d_wr, d_w1, d_w2, d_x = jax.grad(dense_loss, argnums=(0, 1, 2, 3))(
+        wr, w1, w2, tokens)
+    np.testing.assert_allclose(np.asarray(g_wr), np.asarray(d_wr),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g_w1), np.asarray(d_w1),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g_w2), np.asarray(d_w2),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(g_x), np.asarray(d_x),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ddp_axis_resolves_after_init():
+    """DDP built BEFORE initialize_model_parallel must still pick up the
+    expert axis once the EP mesh exists (regression: construction-time
+    resolution froze 'data')."""
+    from apex_tpu.parallel.distributed import DistributedDataParallel
+
+    parallel_state.destroy_model_parallel()
+    ddp = DistributedDataParallel()
+    assert ddp.axis_name == "data"
+    parallel_state.initialize_model_parallel(expert_model_parallel_size_=EP)
+    assert set(ddp.axis_name) == {"data", "expert"}
+    assert DistributedDataParallel(axis_name="data").axis_name == "data"
+
+
+def test_reduce_moe_grads_syncs_router_replicas():
+    """The router is replicated over the expert axis but sees different
+    local tokens, so its raw grads diverge per rank; reduce_moe_grads
+    must bring every expert rank to the same (averaged) router grad while
+    leaving expert grads rank-local."""
+    mesh = parallel_state.get_mesh()
+    dp = mesh.shape["data"]
+    tokens = jax.random.normal(jax.random.key(6), (dp * EP * 8, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=8, expert_parallel_size=EP)
+
+    def body(x):
+        params = layer.init(jax.random.key(7), x)
+
+        def loss_fn(p):
+            y, _ = layer.apply(p, x)
+            return jax.lax.pmean(jnp.sum(y * y), ("data", "expert"))
+
+        raw = jax.grad(loss_fn)(params)["params"]
+        red = reduce_moe_grads(raw)
+        # leading [1] so out_specs can stack the per-rank values
+        return (raw["router"]["weight"][None],
+                red["router"]["weight"][None])
+
+    raw_g, red_g = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P(("data", "expert")),),
+        out_specs=(P(("data", "expert")), P(("data", "expert")))))(tokens)
+    raw_g, red_g = np.asarray(raw_g), np.asarray(red_g)
+    assert raw_g.shape[0] == dp * EP
+    # raw router grads differ between ranks (different local tokens)...
+    assert not np.allclose(raw_g[0], raw_g[1])
+    # ...reduced ones are identical everywhere and equal the raw mean
+    # over BOTH replica axes (data and expert)
+    for r in range(1, dp * EP):
+        np.testing.assert_allclose(red_g[0], red_g[r], rtol=1e-6)
+    np.testing.assert_allclose(red_g[0], raw_g.mean(axis=0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_gpt_moe_scan_layers_keeps_aux_losses():
+    """nn.scan must carry the sown aux losses (regression: missing
+    'intermediates' in variable_axes silently dropped them)."""
+    from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_attention_heads=2, max_seq_length=8,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    num_moe_experts=4, moe_top_k=2, scan_layers=True)
+    model = gpt_model_provider(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    labels = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.key(0), tokens, labels)
+    loss, inter = model.apply(params, tokens, labels,
+                              mutable=["intermediates"])
+    flat = jax.tree.leaves(inter["intermediates"])
+    assert flat, "scan dropped the sown MoE aux losses"
+    # each sown leaf is stacked over the scanned layer axis
+    assert all(v.shape[-1] == cfg.num_layers or v.shape[0] == cfg.num_layers
+               for v in flat)
+    assert np.isfinite(float(loss.mean()))
+
+
+def test_gpt_with_moe_ffn():
+    """GPTConfig(num_moe_experts=...) swaps the dense FFN for the routed
+    MoE and sows the aux losses into "intermediates"."""
+    from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_attention_heads=2, max_seq_length=8,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    num_moe_experts=4, moe_top_k=2)
+    model = gpt_model_provider(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    labels = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.key(0), tokens, labels)
+    loss, inter = model.apply(params, tokens, labels,
+                              mutable=["intermediates"])
+    assert np.isfinite(float(loss.mean()))
+    flat = jax.tree.leaves(inter["intermediates"])
+    assert len(flat) >= 2 * cfg.num_layers   # lb + z loss per layer
+    assert all(np.isfinite(float(v)) for v in flat)
+    # expert weights exist at the MoE path
+    p0 = params["params"]["layer_0"]["mlp"]["experts"]["w1"]
+    assert p0.shape == (4, 16, cfg.ffn)
+
+
+def test_aux_losses_uniform_routing():
+    """Uniform router probabilities minimize the Switch loss at exactly 1."""
+    probs = jnp.full((32, E), 1.0 / E)
+    chosen = jnp.zeros((32, E)).at[:, :K].set(1.0)
+    assert abs(float(load_balancing_loss(probs, chosen)) - 1.0) < 1e-5
+    assert float(router_z_loss(jnp.zeros((32, E)))) >= 0.0

@@ -92,7 +92,7 @@ def test_group_getters_cover_reference_surface():
     assert parallel_state.get_embedding_group() == "pipe"
     assert parallel_state.get_position_embedding_group() == "pipe"
     amax = parallel_state.get_amax_reduction_group()
-    assert set(amax) == {"data", "context", "tensor"}
+    assert set(amax) == {"data", "expert", "context", "tensor"}
     # usable as a psum axis spec
     from jax.sharding import PartitionSpec as P
     mesh = parallel_state.get_mesh()
