@@ -170,8 +170,23 @@ def _bench_fn(fn, args, iters: int, rtt: float) -> float:
 
 
 def _microbench_adam(rtt: float, on_tpu: bool):
-    """FusedAdam step latency (µs) on a 100M-param flat buffer vs the
-    unfused elementwise chain (BASELINE.md row 2)."""
+    """FusedAdam step on a 100M-param flat buffer: achieved GB/s vs the
+    HBM roofline, and vs the jnp oracle chain (BASELINE.md row 2).
+
+    The (p, m, v) state is CARRIED through the timing scan.  Two
+    hard-won rules from the axon tunnel + XLA:
+
+    * g/m/v must be function arguments, never jit closure captures —
+      XLA inlines closed-over ndarrays as HLO constants and 3x400 MB of
+      constants overflows the tunnel's compile request (HTTP 413);
+    * loop-invariant inputs to a kernel with input_output_aliases force
+      a defensive copy per iteration (+800 MB/iter traffic against only
+      the aliased impl), and un-aliased outputs that feed nothing let
+      XLA slice away work from only the un-aliased impl — either way a
+      non-carried harness compares two DIFFERENT workloads.  Carried
+      state makes both run the full 2.8 GB/step stream (measured r3:
+      5706 vs 5704 us — the kernel and XLA's fusion are equivalent, as
+      expected for a purely HBM-bound op)."""
     from apex_tpu.ops.fused_update import adam_reference, fused_adam_flat
 
     n = 100_000_000 if on_tpu else 100_000
@@ -184,18 +199,19 @@ def _microbench_adam(rtt: float, on_tpu: bool):
               weight_decay=0.01, step=1)
     iters = 20 if on_tpu else 3
 
-    # g/m/v MUST be _bench_fn args, not closure captures: jit inlines
-    # closed-over ndarrays as HLO constants, and 3x400 MB of constants
-    # overflows the axon tunnel's compile-request limit (HTTP 413)
-    t_fused = _bench_fn(
-        lambda p_, g_, m_, v_: fused_adam_flat(p_, g_, m_, v_, **hp),
-        (p, g, m, v), iters, rtt)
-    t_ref = _bench_fn(
-        lambda p_, g_, m_, v_: adam_reference(p_, g_, m_, v_, **hp),
-        (p, g, m, v), iters, rtt)
+    t_fused = _bench_loop(
+        lambda s, g_: fused_adam_flat(s[0], g_, s[1], s[2], **hp),
+        (p, m, v), g, iters, rtt)
+    t_ref = _bench_loop(
+        lambda s, g_: adam_reference(s[0], g_, s[1], s[2], **hp),
+        (p, m, v), g, iters, rtt)
+    achieved = 7 * n * 4 / t_fused / 1e9      # r p,g,m,v + w p,m,v
+    _, hbm = _chip_spec()
     return {"fused_adam_us": round(t_fused * 1e6, 1),
             "unfused_adam_us": round(t_ref * 1e6, 1),
             "adam_speedup": round(t_ref / t_fused, 3),
+            "adam_gbps": round(achieved, 1),
+            "adam_roofline": round(achieved / hbm, 3),
             "adam_nelem": n}
 
 
