@@ -63,7 +63,8 @@ def _merge(out_a, lse_a, out_b, lse_b):
 def ring_attention(q, k, v, *, causal: bool = False,
                    sm_scale: Optional[float] = None,
                    axis_name: str = CONTEXT_AXIS,
-                   block_q: int = 512, block_k: int = 512):
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None):
     """Exact attention over a context-sharded sequence.
 
     ``q, k, v``: ``[b, h, s_local, d]`` — this rank's sequence shard (rank
@@ -78,8 +79,11 @@ def ring_attention(q, k, v, *, causal: bool = False,
         return flash_attention(q, k, v, causal=causal, sm_scale=scale,
                                block_q=block_q, block_k=block_k)
 
-    bq = _fit_block(s_local, block_q)
-    bk = _fit_block(s_local, block_k)
+    # None inherits flash_attention's tuned default (1024 inside its
+    # verified VMEM envelope, 512 beyond it)
+    default_block = 1024 if d <= 128 else 512
+    bq = _fit_block(s_local, block_q or default_block)
+    bk = _fit_block(s_local, block_k or default_block)
     if bq is None or bk is None:
         raise ValueError(
             f"ring_attention local shard length {s_local} must tile into "

@@ -40,7 +40,8 @@ __all__ = ["ulysses_attention"]
 def ulysses_attention(q, k, v, *, causal: bool = False,
                       sm_scale: Optional[float] = None,
                       axis_name: str = CONTEXT_AXIS,
-                      block_q: int = 512, block_k: int = 512):
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None):
     """Exact attention over a context-sharded sequence via head/sequence
     all-to-all resharding.
 
