@@ -202,25 +202,15 @@ def _dq_kernel(causal, off, scale, bq, bk, nk, masked, valid,
 
     @pl.when(run)
     def _body():
-        # input-dtype dots with fp32 accumulation (see _fwd_kernel)
-        q = q_ref[0]
-        kb = k_ref[0]
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows + off >= cols, s, _NEG_INF)
-        if masked:
-            s = jnp.where(mask_ref[0], _NEG_INF, s)
-        s = _valid_mask(s, valid, qi, ki, bq, bk)
-        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = _recompute_p(causal, off, scale, bq, bk, masked, valid,
+                         qi, ki, q_ref, k_ref, lse_ref, mask_ref)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
         dq_scr[...] += scale * jax.lax.dot(
-            ds.astype(k_ref.dtype), kb, preferred_element_type=jnp.float32)
+            ds.astype(k_ref.dtype), k_ref[0],
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -242,19 +232,8 @@ def _dkv_kernel(causal, off, scale, bq, bk, nq, masked, valid,
 
     @pl.when(run)
     def _body():
-        # input-dtype dots with fp32 accumulation (see _fwd_kernel)
-        q = q_ref[0]
-        kb = k_ref[0]
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows + off >= cols, s, _NEG_INF)
-        if masked:
-            s = jnp.where(mask_ref[0], _NEG_INF, s)
-        s = _valid_mask(s, valid, qi, ki, bq, bk)
-        p = jnp.exp(s - lse_ref[0][:, :1])                 # [bq, bk]
+        p = _recompute_p(causal, off, scale, bq, bk, masked, valid,
+                         qi, ki, q_ref, k_ref, lse_ref, mask_ref)
         do = do_ref[0]
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -264,13 +243,99 @@ def _dkv_kernel(causal, off, scale, bq, bk, nq, masked, valid,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
         dk_scr[...] += scale * jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # dsᵀ @ q
 
     @pl.when(qi == nq - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _recompute_p(causal, off, scale, bq, bk, masked, valid, qi, ki,
+                 q_ref, k_ref, lse_ref, mask_ref):
+    """Shared backward score recompute: p = exp(s - lse) for one
+    (qi, ki) block pair, with causal/mask/valid-window masking.  One
+    definition so the three backward kernels can never drift apart."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows + off >= cols, s, _NEG_INF)
+    if masked:
+        s = jnp.where(mask_ref[0], _NEG_INF, s)
+    s = _valid_mask(s, valid, qi, ki, bq, bk)
+    return jnp.exp(s - lse_ref[0][:, :1])
+
+
+def _bwd_fused_kernel(causal, off, scale, bq, bk, nq, nk, masked, valid,
+                      q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      mask_ref, dq_ref, dk_ref, dv_ref,
+                      dq_scr, dk_scr, dv_scr):
+    """One-pass backward (FlashAttention-2 shape): dq, dk, dv from a
+    single sweep over (ki, qi) blocks.
+
+    The split dq/dkv kernels each recompute the scores and the exp — the
+    dominant VPU cost at small head_dim — and each re-read q/k/v/do.
+    Fusing them computes p/ds ONCE per block pair (5 MXU dots instead of
+    7, 1 exp+mask pass instead of 2).  The price is a full-sequence
+    ``[sq, d]`` fp32 dq accumulator in VMEM scratch (dq contributions
+    arrive k-major, so no single output block is complete until the
+    sweep ends) — affordable exactly when sq*d is moderate, which the
+    caller gates on; and the ki grid dim turns sequential (the scratch
+    carries across it), keeping only bh as the parallel dim.
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1 + off)
+
+    @pl.when(run)
+    def _body():
+        p = _recompute_p(causal, off, scale, bq, bk, masked, valid,
+                         qi, ki, q_ref, k_ref, lse_ref, mask_ref)
+        do = do_ref[0]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # pᵀ @ do
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dsl = ds.astype(q_ref.dtype)
+        dk_scr[...] += scale * jax.lax.dot_general(
+            dsl, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # dsᵀ @ q
+        dq_scr[pl.ds(qi * bq, bq), :] += scale * jax.lax.dot(
+            dsl, k_ref[0], preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _fin_dkv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    @pl.when((ki == nk - 1) & (qi == nq - 1))
+    def _fin_dq():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+# fused-backward gate: the [sq, d] fp32 dq scratch (plus the same-sized
+# output block) must stay a small slice of the ~16 MB scoped VMEM —
+# 2 MB covers seq 8192 @ d 64 / seq 4096 @ d 128; beyond it the split
+# two-kernel backward below takes over.  Module-level so tests can
+# force either path.
+_FUSED_BWD_MAX_BYTES = 2 * 1024 * 1024
 
 
 def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
@@ -287,6 +352,53 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
 
     h_per = bh // mask3.shape[0] if masked else 1
     common = [q3, k3, v3, do3, lse2, delta2] + ([mask3] if masked else [])
+
+    # k-major (grid (bh, ki, qi)) input specs — shared by the fused and
+    # dkv kernels, which iterate the identical block layout
+    kmajor_in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+    ]
+    if masked:
+        kmajor_in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, j, i: (b // h_per, i, j)))
+
+    if sq * d * 4 <= _FUSED_BWD_MAX_BYTES:
+        base = functools.partial(
+            _bwd_fused_kernel, causal, off, scale, bq, bk, nq, nk,
+            masked, valid)
+        kernel = base if masked else (
+            lambda q, k, v, do, lse, dlt, dq, dk, dv, s1, s2, s3: base(
+                q, k, v, do, lse, dlt, None, dq, dk, dv, s1, s2, s3))
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(bh, nk, nq),
+            in_specs=kmajor_in_specs,
+            out_specs=[
+                pl.BlockSpec((1, sq, d), lambda b, j, i: (b, 0, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), out_dtype or q3.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), out_dtype or k3.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), out_dtype or v3.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((sq, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            # ki is sequential: the dq scratch carries across it
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=interpret_mode(),
+        )(*common)
+        return dq, dk, dv
 
     dq_in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -317,18 +429,6 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
         interpret=interpret_mode(),
     )(*common)
 
-    dkv_in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
-    ]
-    if masked:
-        dkv_in_specs.append(pl.BlockSpec(
-            (1, bq, bk), lambda b, j, i: (b // h_per, i, j)))
-
     dkv_base = functools.partial(
         _dkv_kernel, causal, off, scale, bq, bk, nq, masked, valid)
     dkv_kernel = dkv_base if masked else (
@@ -337,7 +437,7 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, nk, nq),
-        in_specs=dkv_in_specs,
+        in_specs=kmajor_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
