@@ -35,7 +35,7 @@ Per-leg isolation (round-3 failure mode): the tunnel can wedge MID-run —
 the round-3 chip answered ``jax.devices()`` in seconds, then hung
 minutes into measurement, losing every leg queued behind the hang in the
 single 2400 s inner subprocess.  Each leg (``main``, ``adam``, ``ln``,
-``attn``, ``xent``) therefore runs in its OWN subprocess with its own
+``attn``, ``xent``, ``moe``) therefore runs in its OWN subprocess with its own
 timeout (``--inner MODE --leg NAME``); the orchestrator merges whatever
 landed, so a wedge costs one leg, not the capture.
 
@@ -306,11 +306,49 @@ def _bench_setup(force_cpu: bool):
     return on_tpu, rtt
 
 
+def _microbench_moe(rtt: float, on_tpu: bool):
+    """MoE layer fwd+bwd throughput (beyond reference parity — the EP
+    subsystem's on-chip cost, not just its CPU-mesh logic).
+
+    Single-chip (ep=1) top-2 routed MoE at a Mixtral-ish slice: the
+    tokens/s through the layer plus the effective TFLOP/s counting the
+    EXPERT GEMMs only — the dispatch/combine einsums (the GShard dense
+    formulation's overhead) are deliberately excluded from the FLOP
+    credit so the number exposes their cost rather than hiding it.
+    """
+    from apex_tpu.transformer.moe import MoELayer
+
+    tokens, h, ffn, e, k = ((8192, 1024, 4096, 8, 2) if on_tpu
+                            else (256, 64, 128, 4, 2))
+    x = jax.random.normal(jax.random.PRNGKey(0), (tokens, h), jnp.bfloat16)
+    layer = MoELayer(num_experts=e, hidden_size=h, ffn_hidden_size=ffn,
+                     top_k=k)
+    params = jax.jit(layer.init)(jax.random.PRNGKey(1), x)
+    iters = 10 if on_tpu else 2
+
+    def fwd_bwd(x, params):
+        def f(x, p):
+            y, aux = layer.apply(p, x)
+            return (jnp.sum(y.astype(jnp.float32) ** 2)
+                    + 0.01 * aux["load_balancing_loss"])
+        return jax.grad(f, argnums=(0, 1))(x, params)
+
+    t = _bench_fn(fwd_bwd, (x, params), iters, rtt)
+    # expert GEMM model FLOPs: k experts/token x 2 matmuls x 2 FLOP/MAC
+    # x h*ffn, fwd + 2x bwd
+    flops = 3 * tokens * k * 2 * 2 * h * ffn
+    return {"moe_us": round(t * 1e6, 1),
+            "moe_tokens_per_s": round(tokens / t, 1),
+            "moe_expert_tflops": round(flops / t / 1e12, 2),
+            "moe_shape": [tokens, h, ffn, e, k]}
+
+
 MICRO_LEGS = {
     "adam": _microbench_adam,
     "ln": _microbench_layernorm,
     "attn": _microbench_attention,
     "xent": _microbench_xentropy,
+    "moe": _microbench_moe,
 }
 
 
@@ -493,7 +531,7 @@ def _run_leg(mode: str, leg: str, timeout: float, key=None):
 # (leg, subprocess timeout): main pays 2 scan-loop compiles over the
 # tunnel; each micro leg pays 1-2 smaller ones
 LEG_TIMEOUTS = [("main", 1500), ("adam", 700), ("ln", 600),
-                ("attn", 700), ("xent", 600)]
+                ("attn", 700), ("xent", 600), ("moe", 700)]
 
 
 def _run_all_legs(mode: str, errors: list):
