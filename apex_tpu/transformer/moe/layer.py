@@ -157,6 +157,7 @@ class MoELayer(nn.Module):
     activation: Callable = nn.gelu
     params_dtype: Any = jnp.float32
     jitter_eps: float = 0.0
+    load_balancing_type: str = "aux_loss"     # | "sinkhorn" | "none"
 
     def _expert_init(self, init: Callable) -> Callable:
         """Fold the expert-axis and tensor-axis ranks into the init key
@@ -206,7 +207,8 @@ class MoELayer(nn.Module):
 
         gates, expert_index, aux = TopKRouter(
             num_experts=self.num_experts, top_k=self.top_k,
-            jitter_eps=self.jitter_eps, name="router")(
+            jitter_eps=self.jitter_eps,
+            load_balancing_type=self.load_balancing_type, name="router")(
                 tokens, deterministic=deterministic)
         dispatch, combine = compute_dispatch_and_combine(
             gates, expert_index, self.num_experts, cap)

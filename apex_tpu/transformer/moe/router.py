@@ -23,7 +23,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-__all__ = ["TopKRouter", "load_balancing_loss", "router_z_loss"]
+__all__ = ["TopKRouter", "load_balancing_loss", "router_z_loss",
+           "sinkhorn"]
 
 
 def load_balancing_loss(router_probs, expert_index_one_hot) -> jnp.ndarray:
@@ -48,6 +49,27 @@ def router_z_loss(router_logits) -> jnp.ndarray:
     return jnp.mean(z * z)
 
 
+def sinkhorn(cost, n_iters: int = 8, eps: float = 1e-8) -> jnp.ndarray:
+    """Sinkhorn-Knopp normalization of a positive [tokens, E] matrix
+    toward doubly-stochastic (Megatron-core: ``sinkhorn`` in
+    ``moe_utils``; the S-BASE balanced-assignment router of Clark et
+    al. 2022).  Fixed iteration count — a tolerance ``while_loop`` would
+    trace fine but a static bound keeps the jaxpr flat and 8 rounds is
+    well past convergence for routing purposes.
+
+    Selection through the normalized matrix is balanced by construction,
+    so sinkhorn routing needs NO auxiliary load-balancing loss.
+    """
+    cost = cost.astype(jnp.float32)
+    d1 = jnp.ones(cost.shape[1], jnp.float32)
+    for _ in range(n_iters):
+        d0 = 1.0 / jnp.maximum(
+            cost.shape[0] * jnp.sum(cost * d1[None, :], axis=1), eps)
+        d1 = 1.0 / jnp.maximum(
+            cost.shape[1] * jnp.sum(cost * d0[:, None], axis=0), eps)
+    return cost * d0[:, None] * d1[None, :]
+
+
 class TopKRouter(nn.Module):
     """Learned top-k gate (Megatron-core: ``TopKRouter``).
 
@@ -63,11 +85,18 @@ class TopKRouter(nn.Module):
     top_k: int = 2
     renormalize: bool = True
     jitter_eps: float = 0.0    # multiplicative input jitter (train only)
+    # "aux_loss" (Switch, default) | "sinkhorn" (S-BASE balanced
+    # assignment — selection through the doubly-stochastic-normalized
+    # logits, no aux loss needed) | "none"
+    load_balancing_type: str = "aux_loss"
     init_method: Callable = nn.initializers.normal(stddev=0.02)
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, dict]:
+        if self.load_balancing_type not in ("aux_loss", "sinkhorn", "none"):
+            raise ValueError(
+                f"unknown load_balancing_type {self.load_balancing_type!r}")
         if self.jitter_eps and not deterministic:
             key = self.make_rng("jitter")
             x = x * jax.random.uniform(
@@ -77,12 +106,33 @@ class TopKRouter(nn.Module):
                        (self.num_experts, x.shape[-1]), jnp.float32)
         logits = jnp.matmul(x.astype(jnp.float32), w.T)      # [tokens, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        gates, expert_index = jax.lax.top_k(probs, self.top_k)
+        if self.load_balancing_type == "sinkhorn":
+            if self.top_k != 1:
+                # a doubly-stochastic matrix balances only the argmax;
+                # with the aux loss zeroed, 2nd choices would have no
+                # balance signal at all (Megatron-core asserts the same)
+                raise ValueError("sinkhorn routing requires top_k=1")
+            # select via the balanced assignment; gate values still come
+            # from the plain softmax (Megatron: sinkhorn output is used
+            # for argmax only, gradients flow through the softmax gates).
+            # Row-max subtraction before exp: sinkhorn is invariant to
+            # per-row scaling (absorbed into d0), and raw exp(logits)
+            # overflows fp32 past ~88, NaN-ing the assignment.
+            stable = logits - jax.lax.stop_gradient(
+                logits.max(axis=-1, keepdims=True))
+            balanced = sinkhorn(jax.lax.stop_gradient(jnp.exp(stable)))
+            _, expert_index = jax.lax.top_k(balanced, self.top_k)
+            gates = jnp.take_along_axis(probs, expert_index, axis=-1)
+        else:
+            gates, expert_index = jax.lax.top_k(probs, self.top_k)
         if self.renormalize and self.top_k > 1:
             gates = gates / jnp.maximum(
                 gates.sum(axis=-1, keepdims=True), 1e-9)
         chosen = jax.nn.one_hot(
             expert_index, self.num_experts, dtype=jnp.float32).sum(axis=1)
-        aux = {"load_balancing_loss": load_balancing_loss(probs, chosen),
+        zero = jnp.zeros((), jnp.float32)
+        aux = {"load_balancing_loss":
+               load_balancing_loss(probs, chosen)
+               if self.load_balancing_type == "aux_loss" else zero,
                "z_loss": router_z_loss(logits)}
         return gates, expert_index, aux

@@ -82,6 +82,8 @@ class GPTConfig:
     num_moe_experts: Optional[int] = None
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # "aux_loss" | "sinkhorn" (requires moe_top_k=1) | "none"
+    moe_load_balancing_type: str = "aux_loss"
     expert_model_parallel: bool = False
 
     @property
@@ -213,6 +215,7 @@ class ParallelTransformerLayer(nn.Module):
                 else 1,
                 tensor_parallel_size=_tp(),
                 sequence_parallel=cfg.sequence_parallel,
+                load_balancing_type=cfg.moe_load_balancing_type,
                 params_dtype=cfg.params_dtype,
                 name="mlp")(h, deterministic=deterministic)
             self.sow("intermediates", "moe_lb_loss",
