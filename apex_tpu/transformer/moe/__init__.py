@@ -21,9 +21,10 @@ Everything is differentiable through plain jnp ops + ``lax.all_to_all``
 (whose transpose is the inverse resharding), so no custom VJPs are
 needed; ep=1 degrades to a single-host MoE with zero collectives.
 """
-from apex_tpu.transformer.moe.router import TopKRouter, load_balancing_loss
+from apex_tpu.transformer.moe.router import (TopKRouter,
+                                             load_balancing_loss, sinkhorn)
 from apex_tpu.transformer.moe.experts import GroupedMLP
 from apex_tpu.transformer.moe.layer import MoELayer, reduce_moe_grads
 
 __all__ = ["TopKRouter", "GroupedMLP", "MoELayer", "load_balancing_loss",
-           "reduce_moe_grads"]
+           "reduce_moe_grads", "sinkhorn"]
