@@ -57,6 +57,12 @@ import jax
 import jax.flatten_util
 import jax.numpy as jnp
 
+# NOTE: do NOT enable jax's persistent compilation cache here — probed
+# in r3 and the axon backend HANGS under it (the ln leg, normally ~2
+# min, ran >10 min without producing output or cache entries, twice,
+# on an otherwise idle machine).  Every leg recompiling through the
+# tunnel is the lesser evil.
+
 # bf16 matmul peak (TFLOP/s) and HBM bandwidth (GB/s) per chip generation;
 # conservative public numbers, used only for the mfu/roofline extras.
 _CHIP_SPECS = {
