@@ -583,9 +583,21 @@ def main() -> None:
     if result is None:
         result = _run_all_legs("cpu", errors)
         if result is not None:
-            result.setdefault("extras", {})["backend"] = "cpu"
-            if errors:
-                result["error"] = "; ".join(e for e in errors if e)
+            extras = result.setdefault("extras", {})
+            extras["backend"] = "cpu"
+            # context for readers of a degraded capture: the last
+            # on-chip numbers this exact bench recorded (r3 session,
+            # 2026-07-30, TPU v5 lite — full provenance in PERF.md;
+            # update this dict in the same commit as any new PERF.md
+            # capture).  CLEARLY labeled history, never merged into
+            # `value`.
+            extras["last_recorded_tpu_capture"] = {
+                "date": "2026-07-30", "value_tokens_per_s": 109402.9,
+                "vs_baseline": 1.556, "mfu": 0.479,
+                "flash_attn_us": 2962.4, "adam_gbps": 668.2,
+                "layernorm_gbps": 778.1, "xentropy_gbps": 544.3,
+                "moe_tokens_per_s": 903748.4}
+            # (errors are attached by the shared `elif errors:` below)
 
     if result is None:
         result = {"metric": "gpt_train_tokens_per_sec_1chip", "value": None,
