@@ -1,0 +1,51 @@
+"""The bench orchestrator must ALWAYS emit one parseable JSON line —
+including when the TPU probe fails and the capture degrades to CPU
+scale (the r2 scoreboard failure mode this guards against).  Leg
+execution is mocked; this tests the merge/fallback plumbing only.
+"""
+import json
+import os
+import sys
+from unittest import mock
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import bench
+
+
+def _run_main(probe_ok, leg_results):
+    with mock.patch.object(bench, "_probe_tpu",
+                           return_value=(probe_ok, None if probe_ok
+                                         else "probe err")), \
+         mock.patch.object(bench, "_run_all_legs",
+                           side_effect=leg_results), \
+         mock.patch("time.sleep"), \
+         mock.patch("builtins.print") as p:
+        bench.main()
+    return json.loads(p.call_args[0][0])
+
+
+def test_degraded_capture_parses_and_carries_history():
+    out = _run_main(False, [{"metric": "m", "value": 1.0, "unit": "u",
+                             "vs_baseline": 0.5}])
+    assert out["extras"]["backend"] == "cpu"
+    assert "probe err" in out["error"]
+    hist = out["extras"]["last_recorded_tpu_capture"]
+    assert hist["value_tokens_per_s"] > 0
+    assert set(hist) >= {"date", "vs_baseline", "mfu"}
+
+
+def test_healthy_capture_untouched():
+    out = _run_main(True, [{"metric": "m", "value": 2.0, "unit": "u",
+                            "vs_baseline": 1.4,
+                            "extras": {"backend": "tpu"}}])
+    assert out["value"] == 2.0
+    assert "error" not in out
+    assert "last_recorded_tpu_capture" not in out["extras"]
+
+
+def test_total_failure_still_emits_json():
+    out = _run_main(False, [None])
+    assert out["value"] is None
+    assert "probe err" in out["error"]
