@@ -36,19 +36,21 @@ _DEFAULT_MESSAGE_SIZE = 10_000_000  # bytes, reference default ~10MB
 
 
 def _resolve_data_axes(axis_name):
-    """``None`` -> the FULL data-parallel group: dense params replicate
-    over the ``expert`` axis too when expert parallelism is active, so
-    their grad reduction must span ``("data", "expert")`` — reducing
-    over the bare ``data`` axis there silently desyncs the dense
-    replicas across expert ranks.  An explicit ``axis_name`` is passed
-    through untouched (expert params, custom topologies)."""
+    """``None`` -> the FULL data-parallel group for DENSE params: they
+    replicate over the ``expert`` axis when expert parallelism is
+    active AND over the ``context`` axis when context parallelism is
+    active (each cp rank sees a different sequence shard, so its dense
+    grads are partial — Megatron likewise allreduces grads over the
+    dp-cp group), so the grad reduction must span every such axis —
+    reducing over the bare ``data`` axis silently desyncs the replicas.
+    An explicit ``axis_name`` is passed through untouched (expert
+    params, custom topologies)."""
     if axis_name is not None:
         return axis_name
     from apex_tpu.transformer import parallel_state as ps
-    if (ps.model_parallel_is_initialized()
-            and ps.get_expert_model_parallel_world_size() > 1):
-        return ps.get_data_parallel_group(with_expert_parallel=True)
-    return "data"
+    if not ps.model_parallel_is_initialized():
+        return "data"
+    return ps.get_dense_param_grad_axes()
 
 
 def _axes_size(axis_name):
@@ -63,8 +65,10 @@ def flat_allreduce(tree, axis_name=None):
     """Flatten a pytree, one psum, unflatten (reference: ``flat_dist_call``
     over ``apex_C.flatten``/``unflatten`` + ``dist.all_reduce``).
 
-    ``axis_name=None`` resolves to the full data-parallel group,
-    including the ``expert`` axis when expert parallelism is active."""
+    ``axis_name=None`` resolves to the full dense-param data-parallel
+    group (``parallel_state.get_dense_param_grad_axes``): the ``expert``
+    and ``context`` axes join automatically when those parallelisms are
+    active."""
     flat, unravel = tree_ravel(tree)
     return unravel(jax.lax.psum(flat, _resolve_data_axes(axis_name)))
 

@@ -43,8 +43,7 @@ from apex_tpu.utils import round_up
 __all__ = ["MoELayer", "compute_dispatch_and_combine", "reduce_moe_grads"]
 
 
-def reduce_moe_grads(grads, *, dense_axes=(DATA_AXIS, EXPERT_AXIS),
-                     expert_axes=(DATA_AXIS,)):
+def reduce_moe_grads(grads, *, dense_axes=None, expert_axes=None):
     """Average an MoE layer's grad tree over each param's replica axes.
 
     MoE splits the data-parallel reduction (the analog of Megatron's
@@ -67,10 +66,32 @@ def reduce_moe_grads(grads, *, dense_axes=(DATA_AXIS, EXPERT_AXIS),
     Megatron's ``allreduce_sequence_parallel_gradients`` covers for SP
     LayerNorm params).
 
+    With the default ``None`` axes, both tuples are resolved from the
+    live mesh: dense = ``(data, expert[, context])``, expert =
+    ``(data[, context])`` — the ``context`` axis joins both whenever
+    context parallelism is active, because each cp rank routes a
+    different sequence shard through replicated weights (the same
+    dp-cp reduction Megatron applies to all non-attention params).
+
     Uses ``pmean`` (grads averaged, matching the DDP predivide
     convention elsewhere in the package).
     """
     import jax.tree_util as jtu
+
+    if dense_axes is None or expert_axes is None:
+        from apex_tpu.transformer import parallel_state as ps
+        live = ps.model_parallel_is_initialized()
+        if dense_axes is None:
+            # expert axis always included (pmean over a size-1 axis is
+            # identity); context joins when active
+            dense_axes = (ps.get_data_parallel_group(
+                with_expert_parallel=True,
+                with_context_parallel=(
+                    ps.get_context_parallel_world_size() > 1))
+                if live else (DATA_AXIS, EXPERT_AXIS))
+        if expert_axes is None:
+            expert_axes = (ps.get_expert_param_grad_axes() if live
+                           else (DATA_AXIS,))
 
     def f(path, g):
         names = {p.key for p in path if isinstance(p, jtu.DictKey)}

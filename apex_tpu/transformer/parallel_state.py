@@ -51,6 +51,8 @@ __all__ = [
     "get_context_parallel_group",
     "get_expert_model_parallel_group",
     "get_data_modulo_expert_parallel_group",
+    "get_dense_param_grad_axes",
+    "get_expert_param_grad_axes",
     "get_embedding_group",
     "get_position_embedding_group",
     "get_amax_reduction_group",
@@ -173,18 +175,46 @@ def get_pipeline_model_parallel_group() -> str:
     return PIPE_AXIS
 
 
-def get_data_parallel_group(with_expert_parallel: bool = False):
-    """Data-parallel axis (reference: _DATA_PARALLEL_GROUP).
+def get_data_parallel_group(with_expert_parallel: bool = False,
+                            with_context_parallel: bool = False):
+    """Data-parallel axis (reference: _DATA_PARALLEL_GROUP; the kwargs
+    mirror Megatron-core's ``with_context_parallel`` shape).
 
-    With expert parallelism active, DENSE params replicate over both the
-    ``data`` and ``expert`` axes — pass ``with_expert_parallel=True`` to
-    get the axis tuple their grad psum must span (``jax.lax.psum``
-    accepts it directly).  Expert params reduce over the bare ``data``
-    axis (see :func:`get_data_modulo_expert_parallel_group`).
+    DENSE params replicate over the ``expert`` axis when expert
+    parallelism is active AND over the ``context`` axis when context
+    parallelism is active — pass the matching flags to get the axis
+    tuple their grad psum must span (``jax.lax.psum`` accepts it
+    directly), or use :func:`get_dense_param_grad_axes`, which checks
+    the live mesh for you.  Expert params reduce over
+    :func:`get_expert_param_grad_axes`.
     """
     get_mesh()
+    axes = [DATA_AXIS]
     if with_expert_parallel:
-        return (DATA_AXIS, EXPERT_AXIS)
+        axes.append(EXPERT_AXIS)
+    if with_context_parallel:
+        axes.append(CONTEXT_AXIS)
+    return DATA_AXIS if len(axes) == 1 else tuple(axes)
+
+
+def get_dense_param_grad_axes():
+    """The axes a DENSE param's grad reduction must span on the live
+    mesh: ``data``, plus ``expert``/``context`` whenever those axes
+    have size > 1 (each such rank holds a full replica fed different
+    tokens — Megatron allreduces grads over the dp-cp(-ep) group for
+    the same reason).  Returns a plain axis name or a tuple, both
+    accepted by ``psum``/``pmean``."""
+    return get_data_parallel_group(
+        with_expert_parallel=get_expert_model_parallel_world_size() > 1,
+        with_context_parallel=get_context_parallel_world_size() > 1)
+
+
+def get_expert_param_grad_axes():
+    """The axes an EXPERT param's grad reduction must span: ``data``
+    (the data-modulo-expert group — the ``expert`` axis holds different
+    experts, not replicas) plus ``context`` when active."""
+    if get_context_parallel_world_size() > 1:
+        return (DATA_AXIS, CONTEXT_AXIS)
     return DATA_AXIS
 
 
@@ -204,7 +234,9 @@ def get_data_modulo_expert_parallel_group() -> str:
     """Data-parallel group for EXPERT params (Megatron-core:
     _DATA_MODULO_EXPERT_PARALLEL_GROUP): the replicas of one expert shard
     live along the bare ``data`` axis — the ``expert`` axis holds
-    *different* experts, not copies."""
+    *different* experts, not copies.  For grad reductions prefer
+    :func:`get_expert_param_grad_axes`, which also spans ``context``
+    when context parallelism is active."""
     get_mesh()
     return DATA_AXIS
 

@@ -343,7 +343,8 @@ def test_moe_tp_grads_match_dense(sp):
 def test_ddp_axis_resolves_after_init():
     """DDP built BEFORE initialize_model_parallel must still pick up the
     expert axis once the EP mesh exists (regression: construction-time
-    resolution froze 'data')."""
+    resolution froze 'data'); and the context axis joins whenever
+    context parallelism is active (dense grads are partial per cp rank)."""
     from apex_tpu.parallel.distributed import DistributedDataParallel
 
     parallel_state.destroy_model_parallel()
@@ -352,6 +353,54 @@ def test_ddp_axis_resolves_after_init():
     parallel_state.initialize_model_parallel(expert_model_parallel_size_=EP)
     assert set(ddp.axis_name) == {"data", "expert"}
     assert DistributedDataParallel(axis_name="data").axis_name == "data"
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(context_parallel_size_=2)
+    assert set(ddp.axis_name) == {"data", "context"}
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        expert_model_parallel_size_=2, context_parallel_size_=2)
+    assert set(ddp.axis_name) == {"data", "expert", "context"}
+
+
+def test_reduce_moe_grads_spans_context_axis():
+    """Under context parallelism each cp rank routes a different
+    sequence shard through replicated MoE weights, so BOTH router and
+    expert grads must average over the context axis too."""
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(context_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+    dp, cp = mesh.shape["data"], 2
+    tokens = jax.random.normal(jax.random.key(40), (dp * cp * 8, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=8)
+
+    def body(x):
+        params = layer.init(jax.random.key(41), x)
+
+        def loss_fn(p):
+            y, _ = layer.apply(p, x)
+            return jax.lax.pmean(jnp.sum(y * y), ("data", "context"))
+
+        raw = jax.grad(loss_fn)(params)["params"]
+        red = reduce_moe_grads(raw)     # defaults resolve the cp axis
+        return (raw["router"]["weight"][None],
+                red["router"]["weight"][None],
+                red["experts"]["w1"][None])
+
+    raw_g, red_g, red_w1 = jax.jit(
+        functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh,
+            in_specs=(P(("data", "context")),),
+            out_specs=(P(("data", "context")), P(("data", "context")),
+                       P(("data", "context")))))(tokens)
+    raw_g, red_g = np.asarray(raw_g), np.asarray(red_g)
+    assert not np.allclose(raw_g[0], raw_g[1])    # partial per cp rank
+    for r in range(1, dp * cp):
+        np.testing.assert_allclose(red_g[0], red_g[r], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(red_w1)[0],
+                                   np.asarray(red_w1)[r], rtol=1e-6)
+    np.testing.assert_allclose(red_g[0], raw_g.mean(axis=0), rtol=1e-5,
+                               atol=1e-6)
 
 
 def test_reduce_moe_grads_syncs_router_replicas():
