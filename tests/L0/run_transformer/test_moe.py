@@ -670,6 +670,84 @@ def test_1f1b_with_expert_parallel_moe_stage():
         g_pipe, g_ref)
 
 
+def test_interleaved_with_expert_parallel_moe_stage():
+    """vpp x PP x EP: the interleaved executor's (chunk, microbatch)
+    schedule and ring hand-offs must also tolerate all_to_all inside
+    every virtual stage, matching the non-pipelined run."""
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_no_pipelining,
+        forward_backward_pipelining_with_interleaving,
+    )
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size_=2, expert_model_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+    v, pp, hid, micro_bs, n_micro = 2, 2, 8, 4, 4
+    moe = MoELayer(num_experts=E, hidden_size=hid, ffn_hidden_size=16,
+                   top_k=K, capacity=2 * micro_bs,
+                   expert_parallel_size=2)
+    batch = {
+        "x": jax.random.normal(jax.random.key(50),
+                               (n_micro, micro_bs, hid)),
+        "target": jnp.full((n_micro, micro_bs, hid), 0.1),
+    }
+
+    def stage_fn(params, x, mb):
+        y, _ = moe.apply(params, x)
+        return y
+
+    def loss_fn(y, mb):
+        return jnp.mean((y - mb["target"]) ** 2)
+
+    def input_fn(mb):
+        return mb["x"]
+
+    def body(batch):
+        pipe_r = jax.lax.axis_index("pipe")
+        x0 = jnp.zeros((micro_bs, hid))
+        # chunk c on rank r is virtual stage c*pp + r; fold the stage id
+        # into the init key so every virtual stage draws distinct params
+        chunks = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[moe.init(jax.random.fold_in(jax.random.key(51),
+                                          c * pp + pipe_r), x0)
+              for c in range(v)])
+        l_v, g_v = forward_backward_pipelining_with_interleaving(
+            stage_fn, loss_fn, chunks, batch,
+            num_microbatches=n_micro, input_fn=input_fn,
+            virtual_pipeline_model_parallel_size=v)
+        allc = jax.lax.all_gather(chunks, "pipe")   # [pp, v, ...]
+
+        def full_model_fn(p_all, x, mb):
+            for s in range(v * pp):
+                c, r = s // pp, s % pp
+                x = stage_fn(jax.tree.map(
+                    lambda a, c=c, r=r: a[r, c], p_all), x, mb)
+            return x
+
+        l_ref, g_ref = forward_backward_no_pipelining(
+            full_model_fn, loss_fn, allc, batch,
+            num_microbatches=n_micro, input_fn=input_fn)
+        g_ref_mine = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, pipe_r, 0, keepdims=False), g_ref)
+        return (l_v, l_ref,
+                jax.tree.map(lambda g: g[None], g_v),
+                jax.tree.map(lambda g: g[None], g_ref_mine))
+
+    l_v, l_ref, g_v, g_ref = jax.jit(
+        functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh, in_specs=(P(),),
+            out_specs=(P(), P(), P(("pipe", "expert")),
+                       P(("pipe", "expert")))))(batch)
+    np.testing.assert_allclose(float(l_v), float(l_ref), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g_v, g_ref)
+
+
 def test_aux_losses_uniform_routing():
     """Uniform router probabilities minimize the Switch loss at exactly 1."""
     probs = jnp.full((32, E), 1.0 / E)
