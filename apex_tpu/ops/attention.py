@@ -48,6 +48,11 @@ from apex_tpu.utils import cdiv, interpret_mode
 __all__ = ["flash_attention", "mha_reference"]
 
 _NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
+# a row whose max score is below this is FULLY masked (causal sq > sk,
+# fully-masked varlen rows): it must emit 0 output and 0 grads.  One
+# definition shared by the oracle, the forward kernel, and the backward
+# recompute so the three can never disagree on which rows qualify.
+_MASKED_ROW_THRESH = _NEG_INF * 0.5
 _LANES = 128              # TPU lane width; m/l scratch is lane-replicated
 # lane width for the per-row softmax stats (lse, delta) at the kernel
 # HBM boundary.  Full 128-lane replication cost real bandwidth: at
@@ -76,6 +81,11 @@ def mha_reference(q, k, v, *, causal: bool = False, mask=None,
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (e.g. causal sq > sk: queries before the first
+    # key) emit 0, not softmax-of-constant's uniform artifact — the
+    # FlashAttention convention the kernel implements
+    p = jnp.where(jnp.max(s, axis=-1, keepdims=True) <= _MASKED_ROW_THRESH,
+                  0.0, p)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -130,7 +140,12 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid,
         m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)               # lane-replicated
         alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])    # [bq, 1]
-        p = jnp.exp(s - m_new[:, :1])                    # [bq, bk]
+        # _NEG_INF is finite, so a fully-masked row would get
+        # exp(s - m) = exp(0) = 1 everywhere and emit mean(v) instead
+        # of 0 (hit by causal sq > sk: queries before the first key);
+        # force p = 0 there so l stays 0 and _finish emits 0
+        p = jnp.where(m_new[:, :1] <= _MASKED_ROW_THRESH, 0.0,
+                      jnp.exp(s - m_new[:, :1]))         # [bq, bk]
         l_scr[...] = l_scr[...] * alpha + \
             jnp.sum(p, axis=1, keepdims=True)
         # p rounds to the input dtype for the MXU pass (the standard
@@ -282,7 +297,10 @@ def _recompute_p(causal, off, scale, bq, bk, masked, valid, qi, ki,
     if masked:
         s = jnp.where(mask_ref[0], _NEG_INF, s)
     s = _valid_mask(s, valid, qi, ki, bq, bk)
-    return jnp.exp(s - lse_ref[0][:, :1])
+    # fully-masked rows carry lse = _NEG_INF (finite), so exp(s - lse)
+    # would be 1, not 0 — mirror the forward's guard
+    return jnp.where(lse_ref[0][:, :1] <= _MASKED_ROW_THRESH, 0.0,
+                     jnp.exp(s - lse_ref[0][:, :1]))
 
 
 def _bwd_fused_kernel(causal, off, scale, bq, bk, nq, nk, masked, valid,

@@ -25,7 +25,8 @@ def _qkv(seed, b, h, sq, sk, d, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("sq,sk", [(128, 128), (256, 256), (128, 256)])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (256, 256), (128, 256),
+                                   (256, 128)])
 def test_forward_matches_oracle(causal, sq, sk):
     # causal with sq != sk uses bottom-right diagonal alignment (decode with
     # a KV cache), matching the oracle's tril(k=sk-sq)
@@ -154,6 +155,31 @@ def test_sm_scale_respected():
     out = flash_attention(q, k, v, sm_scale=0.05)
     ref = mha_reference(q, k, v, sm_scale=0.05)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 256), (256, 128)])
+def test_cross_shape_grads_match_oracle(causal, sq, sk):
+    """sq != sk backward (decode/cross-attention): the causal offset
+    (bottom-right diagonal alignment) must hold through the fused
+    backward's dq accumulator and the dk/dv path.
+
+    vjp with a RANDOM (everywhere-nonzero) cotangent, not grad of
+    sum(out^2): a quadratic loss zeroes the cotangent exactly on
+    fully-masked rows (out == 0 there), which would let a regression in
+    the backward's masked-row guard ship undetected."""
+    q, k, v = _qkv(13, 1, 2, sq, sk, 64)
+    dout = _rand(jax.random.key(14), 1, 2, sq, 64) + 0.1
+
+    def gl(attn):
+        _, vjp = jax.vjp(
+            lambda q, k, v: attn(q, k, v, causal=causal), q, k, v)
+        return vjp(dout)
+
+    gk = gl(flash_attention)
+    gr = gl(mha_reference)
+    for a, b_ in zip(gk, gr):
+        np.testing.assert_allclose(a, b_, atol=1e-3, rtol=1e-3)
 
 
 @pytest.mark.parametrize("causal", [False, True])
