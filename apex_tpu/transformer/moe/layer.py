@@ -137,10 +137,13 @@ class MoELayer(nn.Module):
     """Sparsely-activated FFN (Megatron-core: ``MoELayer``).
 
     Call with ``x`` of shape ``[..., hidden]``; leading dims are
-    flattened into a token axis.  Returns ``(y, aux)`` where ``aux``
-    holds the router's ``load_balancing_loss`` and ``z_loss`` scalars
-    (scale by your coefficients and add to the task loss; under
-    data/expert parallelism, mean them over those axes).
+    flattened into a token axis.  Returns ``(y, aux)``: the LOSS terms
+    ``aux["load_balancing_loss"]`` / ``aux["z_loss"]`` (scale by your
+    coefficients and add to the task loss; under data/expert
+    parallelism, mean them over those axes), plus stop-gradiented
+    DIAGNOSTICS for the metrics subsystem — ``aux["expert_load"]``
+    ([E] capacity-fill fractions) and ``aux["dropped_fraction"]``
+    (scalar) — which must NOT be added to the loss.
 
     Parallel composition (all static config; >1 requires running inside
     ``shard_map`` with the named axis bound):
@@ -233,6 +236,12 @@ class MoELayer(nn.Module):
                 tokens, deterministic=deterministic)
         dispatch, combine = compute_dispatch_and_combine(
             gates, expert_index, self.num_experts, cap)
+        # routing statistics for the metrics/logging subsystem
+        # (Megatron-core logs the same per-expert load + drop counters);
+        # stop_gradient: diagnostics must not leak into the loss
+        slots = jax.lax.stop_gradient(dispatch.sum(axis=(0, 2)))  # [E]
+        aux["expert_load"] = slots / cap          # fill fraction per expert
+        aux["dropped_fraction"] = 1.0 - slots.sum() / (s * self.top_k)
 
         dt = tokens.dtype
         buf = jnp.einsum("sec,sh->ech", dispatch.astype(dt), tokens)

@@ -138,6 +138,27 @@ def test_moe_ep4_matches_dense_per_shard():
         np.testing.assert_allclose(ys[shard], ref, rtol=2e-4, atol=2e-4)
 
 
+def test_routing_statistics():
+    """aux carries per-expert load and the dropped-token fraction."""
+    n_tok, cap = 16, 32
+    tokens = jax.random.normal(jax.random.key(60), (n_tok, H))
+    ample = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=cap)
+    params = ample.init(jax.random.key(61), tokens)
+    _, aux = ample.apply(params, tokens)
+    assert aux["expert_load"].shape == (E,)
+    np.testing.assert_allclose(float(aux["dropped_fraction"]), 0.0,
+                               atol=1e-6)              # capacity ample
+    np.testing.assert_allclose(
+        float(aux["expert_load"].sum()) * cap, n_tok * K, rtol=1e-6)
+    tight = ample.clone(capacity=1)
+    _, aux = tight.apply(params, tokens)
+    # n_tok x top-K choices into E single slots: the rest are dropped
+    np.testing.assert_allclose(float(aux["dropped_fraction"]),
+                               1.0 - E / (n_tok * K), rtol=1e-6)
+    assert float(aux["expert_load"].max()) <= 1.0
+
+
 def test_moe_grads_flow():
     tokens = jax.random.normal(jax.random.key(4), (16, H))
     layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
