@@ -82,6 +82,35 @@ class _TorchScaler:
         self.dynamic = sd.get("dynamic", self.dynamic)
 
 
+def _to_torch_dtype(cast_model_type):
+    """Map a frontend ``cast_model_type`` (a jnp dtype or None) to the
+    torch half type for the shim.  Default is bf16 (TPU-native half);
+    fp16 is selectable for reference-exact regimes (e.g. BERT phase 1).
+    Unknown names raise — a typo ('fp16') silently training in bf16
+    would defeat the point of selecting the regime."""
+    import numpy as np
+    if cast_model_type is None:
+        return torch.bfloat16
+    if isinstance(cast_model_type, torch.dtype):
+        # reference scripts pass torch dtypes here (np.dtype can't
+        # interpret them)
+        name = str(cast_model_type).removeprefix("torch.")
+    elif isinstance(cast_model_type, str):
+        name = cast_model_type
+    else:
+        try:
+            name = np.dtype(cast_model_type).name
+        except TypeError:
+            name = str(cast_model_type)
+    table = {"float16": torch.float16, "bfloat16": torch.bfloat16,
+             "float32": torch.float32}
+    if name not in table:
+        raise ValueError(
+            f"cast_model_type {cast_model_type!r} is not supported; use "
+            "one of float16/bfloat16/float32 (jnp dtype or name).")
+    return table[name]
+
+
 def _cast_module(model: torch.nn.Module, dtype, keep_batchnorm_fp32: bool):
     """Cast params/buffers to ``dtype``; optionally keep *Norm layers fp32."""
     norm_types = (torch.nn.modules.batchnorm._BatchNorm,
@@ -229,6 +258,9 @@ def initialize_torch(model, optimizer, props, num_losses=1,
     scalers = [_TorchScaler(props.loss_scale, min_scale=min_loss_scale,
                             max_scale=max_loss_scale)
                for _ in range(max(1, num_losses))]
+    # honor cast_model_type (frontend documents fp16 as selectable; the
+    # reference's O2 regime IS fp16 — BERT phase 1 trains under it)
+    half = _to_torch_dtype(getattr(props, "cast_model_type", None))
 
     models_in_list = isinstance(model, (list, tuple))
     models = list(model) if models_in_list else [model]
@@ -236,17 +268,22 @@ def initialize_torch(model, optimizer, props, num_losses=1,
         # O1 = patch the torch/Tensor/functional namespaces with the cast
         # lists (reference: amp.init + lists/*); patch_torch_functions=False
         # degrades to the autocast wrap.
+        if half == torch.float32:
+            raise ValueError(
+                "cast_model_type=float32 is incompatible with O1 (the "
+                "patch lists half-cast by design); use O0 for pure fp32.")
         if getattr(props, "patch_torch_functions", True):
             from apex_tpu.amp import amp as amp_mod
-            amp_mod.init()
+            amp_mod.init(half_dtype="float16" if half == torch.float16
+                         else "bfloat16")
         else:
             for m in models:
-                _wrap_forward_autocast(m, torch.bfloat16)
+                _wrap_forward_autocast(m, half)
     elif opt_level in ("O2", "O3"):
         keep_bn = bool(props.keep_batchnorm_fp32) and opt_level == "O2"
         for m in models:
-            _cast_module(m, torch.bfloat16, keep_bn)
-            _wrap_forward_cast_inputs(m, torch.bfloat16)
+            _cast_module(m, half, keep_bn)
+            _wrap_forward_cast_inputs(m, half)
     model_out = models if models_in_list else models[0]
 
     if optimizer is None:

@@ -132,12 +132,20 @@ def _apply_lists(handle: AmpHandle, obj, lists_mod) -> None:
                 getattr(obj, name), _is_active))
 
 
-def init(enabled: bool = True, verbose: bool = False) -> AmpHandle:
+def init(enabled: bool = True, verbose: bool = False,
+         half_dtype: str = None) -> AmpHandle:
     """Apply the O1 patch lists; returns the handle (reference:
-    ``amp.init``).  Re-entrant: a live handle is deactivated first."""
+    ``amp.init``).  Re-entrant: a live handle is deactivated first.
+    ``half_dtype`` ("bfloat16" | "float16") sets the type the half cast
+    lists cast to — threaded from the frontend's ``cast_model_type`` so
+    fp16 is honored on the patched-O1 path too."""
     global _current_handle
     if _current_handle is not None:
         _current_handle._deactivate()
+    # default restores bf16 — a prior fp16 init must not leak into later
+    # plain init() calls (the half type is a module global in wrap)
+    from apex_tpu.amp.wrap import set_half_dtype
+    set_half_dtype(half_dtype if half_dtype is not None else "bfloat16")
     handle = AmpHandle(verbose=verbose)
     if not enabled:
         handle.is_active = False

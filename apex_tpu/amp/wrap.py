@@ -46,12 +46,27 @@ def _is_fp_tensor(x) -> bool:
     return jnp.issubdtype(getattr(x, "dtype", None), jnp.floating)
 
 
+#: the O1 half type ("bfloat16" | "float16"), set by ``amp.init`` /
+#: ``set_half_dtype`` from the frontend's ``cast_model_type``; bf16 is
+#: the TPU-native default, fp16 the reference-exact regime.
+_HALF_NAME = "bfloat16"
+
+
+def set_half_dtype(name: str) -> None:
+    if name not in ("bfloat16", "float16"):
+        raise ValueError(
+            f"O1 half dtype must be 'bfloat16' or 'float16', got {name!r}")
+    global _HALF_NAME
+    _HALF_NAME = name
+
+
 def _to_dtype(x, want_half: bool):
     """Cast a floating tensor/array to the 16-bit or fp32 type."""
     try:
         torch = _torch()
         if isinstance(x, torch.Tensor):
-            return x.to(torch.bfloat16 if want_half else torch.float32)
+            half = getattr(torch, _HALF_NAME)
+            return x.to(half if want_half else torch.float32)
     except ImportError:  # pragma: no cover
         pass
     if not _is_arraylike(x):
@@ -60,14 +75,18 @@ def _to_dtype(x, want_half: bool):
         import jax.numpy as jnp
     except ImportError:  # pragma: no cover
         return x
-    return x.astype(jnp.bfloat16 if want_half else jnp.float32)
+    half = getattr(jnp, _HALF_NAME)
+    return x.astype(half if want_half else jnp.float32)
 
 
 def _is_half(x) -> bool:
+    """True iff ``x`` is already the SELECTED half type — under the fp16
+    regime a bf16 tensor must still be cast (mixed fp16/bf16 matmuls
+    error in torch and silently betray the selected regime in jax)."""
     try:
         torch = _torch()
         if isinstance(x, torch.Tensor):
-            return x.dtype in (torch.bfloat16, torch.float16)
+            return x.dtype == getattr(torch, _HALF_NAME)
     except ImportError:  # pragma: no cover
         pass
     if not _is_arraylike(x):
@@ -76,7 +95,7 @@ def _is_half(x) -> bool:
         import jax.numpy as jnp
     except ImportError:  # pragma: no cover
         return False
-    return x.dtype in (jnp.bfloat16, jnp.float16)
+    return x.dtype == getattr(jnp, _HALF_NAME)
 
 
 def _cast_like(x, ref):

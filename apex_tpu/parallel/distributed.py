@@ -53,6 +53,31 @@ def _resolve_data_axes(axis_name):
     return ps.get_dense_param_grad_axes()
 
 
+def _psum_checked(x, axis_name, was_default: bool):
+    """``psum`` with a diagnosable failure when a resolved axis is not
+    bound in the caller's ``shard_map``.
+
+    The ``axis_name=None`` default resolves through ``parallel_state`` —
+    if that was initialized with ``ep``/``cp`` > 1 but the caller runs
+    inside their OWN mesh without those axes, the bare JAX error
+    ("unbound axis name") does not say where the extra axes came from.
+    An explicitly passed axis that is unbound re-raises untouched (the
+    parallel_state explanation would send the user down the wrong path)."""
+    if not was_default:
+        return jax.lax.psum(x, axis_name)
+    try:
+        return jax.lax.psum(x, axis_name)
+    except NameError as e:
+        raise NameError(
+            f"{e}. apex_tpu resolved the data-parallel reduction axes to "
+            f"{axis_name!r} (from parallel_state — the expert/context axes "
+            "join automatically when ep/cp > 1). If you are running inside "
+            "your own mesh without those axes, pass an explicit "
+            "axis_name='data' (or your axis) to DistributedDataParallel/"
+            "flat_allreduce. See MIGRATION.md."
+        ) from e
+
+
 def _axes_size(axis_name):
     axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     world = 1
@@ -70,7 +95,8 @@ def flat_allreduce(tree, axis_name=None):
     and ``context`` axes join automatically when those parallelisms are
     active."""
     flat, unravel = tree_ravel(tree)
-    return unravel(jax.lax.psum(flat, _resolve_data_axes(axis_name)))
+    return unravel(_psum_checked(flat, _resolve_data_axes(axis_name),
+                                 was_default=axis_name is None))
 
 
 class DistributedDataParallel:
@@ -106,6 +132,11 @@ class DistributedDataParallel:
     def axis_name(self):
         return _resolve_data_axes(self._axis_name)
 
+    @axis_name.setter
+    def axis_name(self, value):
+        # pre-r3 this was a plain attribute; keep the mutation surface
+        self._axis_name = value
+
     def __call__(self, *args, **kw):
         if self.module is None:
             raise TypeError("DistributedDataParallel was constructed without "
@@ -119,7 +150,8 @@ class DistributedDataParallel:
             flat = flat.astype(jnp.float32)
         if self.gradient_predivide_factor != 1.0:
             flat = flat / self.gradient_predivide_factor
-        flat = jax.lax.psum(flat, self.axis_name)
+        flat = _psum_checked(flat, self.axis_name,
+                             was_default=self._axis_name is None)
         if self.gradient_average:
             world = _axes_size(self.axis_name)
             post = self.gradient_predivide_factor / world

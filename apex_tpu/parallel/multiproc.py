@@ -1,0 +1,20 @@
+"""Tombstone for the legacy per-GPU process launcher (reference:
+``apex/parallel/multiproc.py :: main`` — forks one ``python main.py``
+per device with ``--world-size``/``--rank`` argv appended).
+
+The reference itself deprecates this in favour of
+``torch.distributed.launch``.  On TPU there is nothing to launch: a
+single SPMD Python process drives every local chip through one
+``jax.sharding.Mesh``, and multi-host jobs are started by the cluster
+runtime (one process per host, ``jax.distributed.initialize()``), not by
+a fork loop.  Importing this module raises with that guidance so stale
+``python -m apex.parallel.multiproc train.py`` recipes fail loudly
+instead of silently running one unsharded process.
+"""
+
+raise ImportError(
+    "apex_tpu.parallel.multiproc does not exist: the reference's per-GPU "
+    "fork launcher has no TPU equivalent. A single process drives all "
+    "local chips via jax.sharding.Mesh; for multi-host, start one process "
+    "per host and call jax.distributed.initialize(). See MIGRATION.md."
+)

@@ -27,6 +27,7 @@ zero weight through both einsums).
 """
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -226,8 +227,8 @@ class MoELayer(nn.Module):
         tokens = x.reshape(-1, h)
         s = tokens.shape[0]
         cap = self.capacity if self.capacity is not None else round_up(
-            max(1, -(-int(self.capacity_factor * s * self.top_k) //
-                     self.num_experts)), 8)
+            max(1, math.ceil(self.capacity_factor * s * self.top_k /
+                             self.num_experts)), 8)
 
         gates, expert_index, aux = TopKRouter(
             num_experts=self.num_experts, top_k=self.top_k,

@@ -1,0 +1,107 @@
+"""Detached TPU-tunnel watcher for round 4.
+
+The axon tunnel has died late in ALL prior rounds (VERDICT r3 "do this" #2:
+capture early, commit immediately).  This watcher probes the backend in a
+disposable subprocess every PROBE_INTERVAL seconds; the moment the chip
+answers, it runs the full ``bench.py`` capture, saves the raw JSON line to
+``bench_captures/r4_watch_capture_<n>.json``, and keeps watching (later
+captures are upgrades — bench.py itself picks its own best numbers).
+
+Run detached:  nohup python bench_captures/tpu_watcher.py >> bench_captures/watcher.log 2>&1 &
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CAPDIR = REPO / "bench_captures"
+PROBE_TIMEOUT = 90
+BENCH_TIMEOUT = 1800
+PROBE_INTERVAL = 240
+
+PROBE_SRC = """
+import jax
+assert jax.default_backend() == "tpu", jax.default_backend()
+x = jax.numpy.ones((256, 256))
+print("PROBE_OK", float((x @ x).sum()))
+"""
+
+
+def log(msg: str) -> None:
+    print(f"[{datetime.datetime.utcnow().isoformat()}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # a cpu override would fail the assert
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "PROBE_OK" in r.stdout
+
+
+def run_capture(n: int) -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # bench manages its own backend choice
+    try:
+        r = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT, env=env,
+            cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired:
+        log("bench.py timed out")
+        return False
+    line = None
+    for cand in reversed(r.stdout.strip().splitlines()):
+        cand = cand.strip()
+        if cand.startswith("{") and cand.endswith("}"):
+            line = cand
+            break
+    if line is None:
+        log(f"no JSON line (rc={r.returncode}); stderr tail: {r.stderr[-400:]}")
+        return False
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError:
+        log("JSON parse failed")
+        return False
+    backend = (payload.get("extras") or {}).get("backend")
+    out = CAPDIR / f"r4_watch_capture_{n:03d}.json"
+    out.write_text(line + "\n")
+    log(f"capture saved to {out.name} backend={backend} "
+        f"value={payload.get('value')} vs_baseline={payload.get('vs_baseline')}")
+    return backend == "tpu"
+
+
+def main() -> None:
+    # resume numbering after a restart — never clobber a saved capture
+    # (numeric sort: lexicographic mis-orders once indices pass the pad)
+    indices = sorted(int(f.stem.rsplit("_", 1)[1])
+                     for f in CAPDIR.glob("r4_watch_capture_*.json"))
+    n = indices[-1] if indices else 0
+    log(f"watcher started (next capture index {n + 1})")
+    while True:
+        if probe():
+            log("probe OK — running full bench capture")
+            n += 1
+            ok = run_capture(n)
+            log(f"capture {'TPU-green' if ok else 'degraded'}; sleeping 1200s")
+            time.sleep(1200)
+        else:
+            log("probe failed (tunnel dead/wedged)")
+            time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    main()

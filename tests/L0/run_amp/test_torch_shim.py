@@ -39,6 +39,25 @@ def test_loss_decreases(opt_level):
     assert losses[-1] < losses[0] * 0.7, (opt_level, losses[:3], losses[-3:])
 
 
+def test_o2_float16_loss_decreases_masters_fp32():
+    """The reference's O2 regime is literally fp16 (BERT phase 1 trains
+    under it with dynamic scaling); pin the selectable
+    ``cast_model_type=float16`` path end to end: model halves are fp16,
+    masters stay fp32, and training still converges through the
+    scale/unscale loop."""
+    import jax.numpy as jnp
+    model = _mlp()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    model, opt = amp.initialize(model, opt, opt_level="O2",
+                                cast_model_type=jnp.float16)
+    assert model[0].weight.dtype == torch.float16
+    assert model[2].weight.dtype == torch.float32  # BN kept fp32
+    masters = list(amp.master_params(opt))
+    assert all(m.dtype == torch.float32 for m in masters)
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0] * 0.7, (losses[:3], losses[-3:])
+
+
 def test_o2_casts_model_keeps_bn_fp32():
     model = _mlp()
     opt = torch.optim.SGD(model.parameters(), lr=0.05)
