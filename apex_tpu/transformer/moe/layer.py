@@ -75,7 +75,15 @@ def reduce_moe_grads(grads, *, dense_axes=None, expert_axes=None):
     dp-cp reduction Megatron applies to all non-attention params).
 
     Uses ``pmean`` (grads averaged, matching the DDP predivide
-    convention elsewhere in the package).
+    convention elsewhere in the package).  Expert leaves additionally
+    divide by the expert-parallel world size: the loss is averaged over
+    ``dense_axes`` shards but an expert weight has replicas only along
+    ``expert_axes``, so a bare pmean normalizes by the smaller replica
+    count and returns ep x the true gradient — expert params would
+    silently train at ``lr * ep`` relative to dense params (Megatron
+    applies the same 1/ep expert-grad scaling; caught by the r4
+    multichip equivalence dryrun, which compares against a dense ep=1
+    replay).
     """
     import jax.tree_util as jtu
 
@@ -94,10 +102,20 @@ def reduce_moe_grads(grads, *, dense_axes=None, expert_axes=None):
             expert_axes = (ps.get_expert_param_grad_axes() if live
                            else (DATA_AXIS,))
 
+    from apex_tpu.parallel.distributed import _axes_size as world
+
     def f(path, g):
         names = {p.key for p in path if isinstance(p, jtu.DictKey)}
-        axes = expert_axes if "experts" in names else dense_axes
-        return jax.lax.pmean(g, axes) if axes else g
+        if "experts" in names:
+            if expert_axes:
+                g = jax.lax.pmean(g, expert_axes)
+            # pmean(expert_axes) * |expert| / |dense| == psum / |dense|:
+            # normalize by the LOSS replica count, not the (smaller)
+            # expert replica count
+            scale = (world(expert_axes) if expert_axes else 1) / \
+                (world(dense_axes) if dense_axes else 1)
+            return g * scale if scale != 1.0 else g
+        return jax.lax.pmean(g, dense_axes) if dense_axes else g
     return jtu.tree_map_with_path(f, grads)
 
 

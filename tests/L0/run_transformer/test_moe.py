@@ -464,6 +464,46 @@ def test_reduce_moe_grads_syncs_router_replicas():
                                atol=1e-6)
 
 
+def test_reduce_moe_grads_expert_scale_matches_dense():
+    """Expert grads must be gradients of the SAME replica-averaged loss
+    as dense grads.  The loss averages over data x expert token shards,
+    but an expert weight has replicas only along data — a bare pmean
+    over its replica axes normalizes by the smaller count and returns
+    ep x the true gradient (expert params would silently train at
+    lr*ep).  reduce_moe_grads therefore scales expert leaves by 1/ep:
+    red == pmean_data(raw) / ep == psum_data(raw) / (dp*ep).  The dense
+    ep=1 replay in ``__graft_entry__.dryrun_multichip`` pins the same
+    fact end to end."""
+    mesh = parallel_state.get_mesh()
+    dp = mesh.shape["data"]
+    tokens = jax.random.normal(jax.random.key(70), (dp * EP * 8, H))
+    layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
+                     top_k=K, capacity=8, expert_parallel_size=EP)
+
+    def body(x):
+        params = layer.init(jax.random.key(71), x)
+
+        def loss_fn(p):
+            y, _ = layer.apply(p, x)
+            return jax.lax.pmean(jnp.sum(y * y), ("data", "expert"))
+
+        raw = jax.grad(loss_fn)(params)["params"]
+        red = reduce_moe_grads(raw)
+        return (raw["experts"]["w1"][None], red["experts"]["w1"][None])
+
+    raw_g, red_g = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P(("data", "expert")),),
+        out_specs=(P(("data", "expert")), P(("data", "expert")))))(tokens)
+    raw_g, red_g = np.asarray(raw_g), np.asarray(red_g)
+    # rank stacking order under P(("data","expert")) is data-major
+    for e in range(EP):
+        want = raw_g[[d * EP + e for d in range(dp)]].mean(axis=0) / EP
+        for d in range(dp):
+            np.testing.assert_allclose(red_g[d * EP + e], want,
+                                       rtol=1e-5, atol=1e-7)
+
+
 def test_gpt_moe_scan_layers_keeps_aux_losses():
     """nn.scan must carry the sown aux losses (regression: missing
     'intermediates' in variable_axes silently dropped them)."""
