@@ -48,6 +48,15 @@ from apex_tpu.utils import cdiv, interpret_mode
 __all__ = ["flash_attention", "mha_reference"]
 
 _NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
+# The kernels work in BASE-2 log domain: the dot's scalar scale absorbs
+# log2(e), and every softmax exp is jnp.exp2.  The VPU lowers exp(x) as
+# exp2(x * log2e) anyway, so folding the constant into the (free) score
+# scale deletes one full [bq, bk] vector multiply per exp site — fwd p,
+# rescale alpha, and the backward recompute — pure VPU savings exactly
+# where PERF.md locates the d=64 attention floor.  lse is produced and
+# consumed in base 2 strictly inside the kernels; the public API and the
+# oracle stay in natural log.
+_LOG2E = 1.4426950408889634
 # a row whose max score is below this is FULLY masked (causal sq > sk,
 # fully-masked varlen rows): it must emit 0 output and 0 grads.  One
 # definition shared by the oracle, the forward kernel, and the backward
@@ -127,8 +136,11 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid,
         # product (linear, identical math).
         q = q_ref[0]
         kb = k_ref[0]
+        # base-2 log domain: log2e folded into the scalar scale (see
+        # _LOG2E note at the top of the module)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * (
+                                    scale * _LOG2E)
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -139,13 +151,13 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid,
         m_prev = m_scr[...]                              # [bq, LANES]
         m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)               # lane-replicated
-        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])    # [bq, 1]
+        alpha = jnp.exp2(m_prev[:, :1] - m_new[:, :1])   # [bq, 1]
         # _NEG_INF is finite, so a fully-masked row would get
-        # exp(s - m) = exp(0) = 1 everywhere and emit mean(v) instead
+        # exp2(s - m) = exp2(0) = 1 everywhere and emit mean(v) instead
         # of 0 (hit by causal sq > sk: queries before the first key);
         # force p = 0 there so l stays 0 and _finish emits 0
         p = jnp.where(m_new[:, :1] <= _MASKED_ROW_THRESH, 0.0,
-                      jnp.exp(s - m_new[:, :1]))         # [bq, bk]
+                      jnp.exp2(s - m_new[:, :1]))        # [bq, bk]
         l_scr[...] = l_scr[...] * alpha + \
             jnp.sum(p, axis=1, keepdims=True)
         # p rounds to the input dtype for the MXU pass (the standard
@@ -163,7 +175,9 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid,
         # softmax-of-all--inf convention closely enough for padding rows
         o_ref[0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)
                     ).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[...] + jnp.log(jnp.where(l == 0.0, 1.0, l))
+        # lse in BASE 2 (m is a base-2 log max): consumed only by
+        # _recompute_p, which is in the same domain
+        lse_ref[0] = (m_scr[...] + jnp.log2(jnp.where(l == 0.0, 1.0, l))
                       )[:, :_STAT_LANES]
 
 
@@ -284,12 +298,13 @@ def _dkv_kernel(causal, off, scale, bq, bk, nq, masked, valid,
 
 def _recompute_p(causal, off, scale, bq, bk, masked, valid, qi, ki,
                  q_ref, k_ref, lse_ref, mask_ref):
-    """Shared backward score recompute: p = exp(s - lse) for one
-    (qi, ki) block pair, with causal/mask/valid-window masking.  One
+    """Shared backward score recompute: p = exp2(s - lse) for one
+    (qi, ki) block pair, with causal/mask/valid-window masking — base-2
+    log domain throughout, matching the forward (lse is base 2).  One
     definition so the three backward kernels can never drift apart."""
     s = jax.lax.dot_general(
         q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+        preferred_element_type=jnp.float32) * (scale * _LOG2E)
     if causal:
         rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -297,10 +312,10 @@ def _recompute_p(causal, off, scale, bq, bk, masked, valid, qi, ki,
     if masked:
         s = jnp.where(mask_ref[0], _NEG_INF, s)
     s = _valid_mask(s, valid, qi, ki, bq, bk)
-    # fully-masked rows carry lse = _NEG_INF (finite), so exp(s - lse)
+    # fully-masked rows carry lse = _NEG_INF (finite), so exp2(s - lse)
     # would be 1, not 0 — mirror the forward's guard
     return jnp.where(lse_ref[0][:, :1] <= _MASKED_ROW_THRESH, 0.0,
-                     jnp.exp(s - lse_ref[0][:, :1]))
+                     jnp.exp2(s - lse_ref[0][:, :1]))
 
 
 def _bwd_fused_kernel(causal, off, scale, bq, bk, nq, nk, masked, valid,

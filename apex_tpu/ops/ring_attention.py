@@ -52,12 +52,15 @@ def _local_flash(q3, k3, v3, causal, scale, bq, bk):
 
 
 def _merge(out_a, lse_a, out_b, lse_b):
-    """Log-space combine of two attention partials over the same queries."""
+    """Log-space combine of two attention partials over the same queries.
+
+    The flash kernel's lse is BASE 2 (log2e folded into its score scale),
+    so the merge runs in base 2 too — the algebra is base-invariant."""
     m = jnp.maximum(lse_a, lse_b)
-    wa = jnp.exp(lse_a - m)[..., None]
-    wb = jnp.exp(lse_b - m)[..., None]
+    wa = jnp.exp2(lse_a - m)[..., None]
+    wb = jnp.exp2(lse_b - m)[..., None]
     out = (out_a * wa + out_b * wb) / (wa + wb)
-    return out, m + jnp.log(wa[..., 0] + wb[..., 0])
+    return out, m + jnp.log2(wa[..., 0] + wb[..., 0])
 
 
 def ring_attention(q, k, v, *, causal: bool = False,

@@ -30,6 +30,7 @@ def test_degraded_capture_parses_and_carries_history():
     out = _run_main(False, [{"metric": "m", "value": 1.0, "unit": "u",
                              "vs_baseline": 0.5,
                              "extras": {"layernorm_gbps": 21.0,
+                                        "layernorm_gbps_median": 19.0,
                                         "flash_attn_speedup": 0.5,
                                         "adam_roofline": 0.02,
                                         "mfu": 0.001}}])
@@ -42,7 +43,8 @@ def test_degraded_capture_parses_and_carries_history():
     assert hist["source"].startswith("bench_captures/")
     # CPU-measured kernel ratios/bandwidths are suppressed (r3 weak #6):
     # interpret-mode "speedups" read as regressions on the scoreboard
-    for k in ("layernorm_gbps", "flash_attn_speedup", "adam_roofline"):
+    for k in ("layernorm_gbps", "layernorm_gbps_median",
+              "flash_attn_speedup", "adam_roofline"):
         assert k not in out["extras"]
 
 
