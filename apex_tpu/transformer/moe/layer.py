@@ -41,7 +41,8 @@ from apex_tpu.transformer.parallel_state import (DATA_AXIS, EXPERT_AXIS,
 from apex_tpu.transformer.tensor_parallel import mappings
 from apex_tpu.utils import round_up
 
-__all__ = ["MoELayer", "compute_dispatch_and_combine", "reduce_moe_grads"]
+__all__ = ["MoELayer", "compute_dispatch_and_combine",
+           "compute_dispatch_indices", "reduce_moe_grads"]
 
 
 def reduce_moe_grads(grads, *, dense_axes=None, expert_axes=None):
@@ -119,6 +120,22 @@ def reduce_moe_grads(grads, *, dense_axes=None, expert_axes=None):
     return jtu.tree_map_with_path(f, grads)
 
 
+def _slot_positions(expert_index, num_experts: int):
+    """Shared slot-assignment prelude for BOTH dispatch forms: GShard
+    priority — (k-slot, token) order, one cumsum over the k-major
+    flattened one-hot.  Returns ``(onehot [S,k,E], pos [S,k,E])`` where
+    ``pos`` counts the higher-priority claims on each expert.  Keeping
+    this in one place is what makes the one-hot and gather dispatch
+    modes provably route identically."""
+    s, k = expert_index.shape
+    onehot = jax.nn.one_hot(expert_index, num_experts,
+                            dtype=jnp.float32)          # [S, k, E]
+    km = onehot.transpose(1, 0, 2).reshape(k * s, num_experts)
+    pos = jnp.cumsum(km, axis=0) - km                    # slots before me
+    pos = pos.reshape(k, s, num_experts).transpose(1, 0, 2)  # [S, k, E]
+    return onehot, pos
+
+
 def compute_dispatch_and_combine(gates, expert_index, num_experts: int,
                                  capacity: int):
     """Turn top-k routing decisions into dense dispatch/combine tensors.
@@ -133,12 +150,7 @@ def compute_dispatch_and_combine(gates, expert_index, num_experts: int,
     over experts.  Tokens past an expert's capacity are dropped (zero
     rows in both tensors).
     """
-    s, k = gates.shape
-    onehot = jax.nn.one_hot(expert_index, num_experts,
-                            dtype=jnp.float32)          # [S, k, E]
-    km = onehot.transpose(1, 0, 2).reshape(k * s, num_experts)
-    pos = jnp.cumsum(km, axis=0) - km                    # slots before me
-    pos = pos.reshape(k, s, num_experts).transpose(1, 0, 2)  # [S, k, E]
+    onehot, pos = _slot_positions(expert_index, num_experts)
     within = onehot * (pos < capacity)                   # kept choices
     # An expert appears at most once in a token's top-k, so the k axis
     # collapses to [S, E] before the capacity one-hot — the biggest
@@ -150,6 +162,43 @@ def compute_dispatch_and_combine(gates, expert_index, num_experts: int,
         pos_se.astype(jnp.int32), capacity, dtype=jnp.float32)
     combine = gate_se[..., None] * dispatch
     return dispatch, combine
+
+
+def compute_dispatch_indices(gates, expert_index, num_experts: int,
+                             capacity: int):
+    """Index-form routing: the SAME slot assignment as
+    :func:`compute_dispatch_and_combine` (GShard priority, identical
+    drops), emitted as gather indices instead of [S, E, C] one-hots.
+
+    The dense formulation's dispatch/combine einsums do
+    ``2*S*E*C*h`` MACs each against a 0/1 operand — linear in E at
+    fixed per-expert capacity, which is exactly what the bench's
+    ``moe_dispatch_sweep`` shows degrading at Switch-scale E.  The
+    index form moves only the O(E*C*h) rows that exist.
+
+    Returns:
+
+    * ``slot_token`` [E, C] int32 — token id feeding each slot, or S
+      (a sentinel one past the last token) for empty slots;
+    * ``token_slot`` [S, k] int32 — flat slot ``e*C + c`` of each
+      routing choice, or E*C (sentinel) when dropped;
+    * ``token_gate`` [S, k] — the gate, 0 when dropped.
+    """
+    s, k = gates.shape
+    onehot, pos = _slot_positions(expert_index, num_experts)
+    kept = ((onehot * (pos < capacity)).sum(-1) > 0)     # [S, k] bool
+    c_sk = (pos * onehot).sum(-1).astype(jnp.int32)      # [S, k]
+    flat = expert_index.astype(jnp.int32) * capacity + c_sk
+    token_slot = jnp.where(kept, flat, num_experts * capacity)
+    token_gate = gates * kept
+    tok_ids = jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32)[:, None], (s, k))
+    # kept slots are unique, so the scatter has no collisions except at
+    # the sentinel row (sliced off)
+    slot_token = jnp.full((num_experts * capacity + 1,), s, jnp.int32) \
+        .at[token_slot.reshape(-1)].set(tok_ids.reshape(-1))
+    return (slot_token[:num_experts * capacity].reshape(
+        num_experts, capacity), token_slot, token_gate)
 
 
 class MoELayer(nn.Module):
@@ -201,6 +250,12 @@ class MoELayer(nn.Module):
     params_dtype: Any = jnp.float32
     jitter_eps: float = 0.0
     load_balancing_type: str = "aux_loss"     # | "sinkhorn" | "none"
+    # "onehot": GShard dense dispatch/combine einsums (MXU-friendly,
+    # O(S*E*C*h) MACs — best at small E).  "gather": index-based
+    # dispatch (same routing, same drops) moving only O(E*C*h) rows —
+    # wins at Switch-scale E; measured crossover in PERF.md /
+    # moe_dispatch_sweep.
+    dispatch_mode: str = "onehot"             # | "gather"
 
     def _expert_init(self, init: Callable) -> Callable:
         """Fold the expert-axis and tensor-axis ranks into the init key
@@ -230,6 +285,10 @@ class MoELayer(nn.Module):
         if self.ffn_hidden_size % tp:
             raise ValueError(f"ffn_hidden_size ({self.ffn_hidden_size}) "
                              f"not divisible by tensor_parallel_size ({tp})")
+        if self.dispatch_mode not in ("onehot", "gather"):
+            raise ValueError(
+                f"dispatch_mode must be 'onehot' or 'gather', got "
+                f"{self.dispatch_mode!r}")
         if self.sequence_parallel:
             # gather the sequence shards so all TP ranks route the same
             # tokens.  tensor_parallel_output_grad=False: by the time
@@ -253,17 +312,29 @@ class MoELayer(nn.Module):
             jitter_eps=self.jitter_eps,
             load_balancing_type=self.load_balancing_type, name="router")(
                 tokens, deterministic=deterministic)
-        dispatch, combine = compute_dispatch_and_combine(
-            gates, expert_index, self.num_experts, cap)
+        dt = tokens.dtype
+        gather = self.dispatch_mode == "gather"
+        if gather:
+            slot_token, token_slot, token_gate = compute_dispatch_indices(
+                gates, expert_index, self.num_experts, cap)
+            # one zero pad row: empty slots (sentinel index s) read it,
+            # and its gradient is discarded by the slice in take's VJP
+            pad = jnp.concatenate([tokens, jnp.zeros((1, h), dt)])
+            buf = jnp.take(pad, slot_token, axis=0)          # [E, C, h]
+            slots = jax.lax.stop_gradient(
+                (slot_token < s).sum(axis=1).astype(jnp.float32))
+        else:
+            dispatch, combine = compute_dispatch_and_combine(
+                gates, expert_index, self.num_experts, cap)
+            slots = jax.lax.stop_gradient(dispatch.sum(axis=(0, 2)))
         # routing statistics for the metrics/logging subsystem
         # (Megatron-core logs the same per-expert load + drop counters);
         # stop_gradient: diagnostics must not leak into the loss
-        slots = jax.lax.stop_gradient(dispatch.sum(axis=(0, 2)))  # [E]
         aux["expert_load"] = slots / cap          # fill fraction per expert
         aux["dropped_fraction"] = 1.0 - slots.sum() / (s * self.top_k)
 
-        dt = tokens.dtype
-        buf = jnp.einsum("sec,sh->ech", dispatch.astype(dt), tokens)
+        if not gather:
+            buf = jnp.einsum("sec,sh->ech", dispatch.astype(dt), tokens)
         e_local = self.num_experts // ep
         if ep > 1:
             # [E, C, h] -> rows grouped by destination rank -> exchange ->
@@ -305,7 +376,15 @@ class MoELayer(nn.Module):
             expert_out = jax.lax.all_to_all(expert_out, self.expert_axis,
                                             split_axis=0, concat_axis=0)
             expert_out = expert_out.reshape(self.num_experts, cap, h)
-        y = jnp.einsum("sec,ech->sh", combine.astype(dt), expert_out)
+        if gather:
+            out_pad = jnp.concatenate([
+                expert_out.reshape(self.num_experts * cap, h),
+                jnp.zeros((1, h), expert_out.dtype)])
+            picked = jnp.take(out_pad, token_slot, axis=0)   # [S, k, h]
+            y = jnp.einsum("skh,sk->sh", picked,
+                           token_gate.astype(picked.dtype))
+        else:
+            y = jnp.einsum("sec,ech->sh", combine.astype(dt), expert_out)
         y = y.reshape(*lead, h)
         if self.sequence_parallel:
             # output is already full (tensor psum above): just slice my

@@ -357,9 +357,9 @@ def _microbench_moe(rtt: float, on_tpu: bool):
     sweep = (8, 32, 64) if on_tpu else (4, 8)
     x = jax.random.normal(jax.random.PRNGKey(0), (tokens, h), jnp.bfloat16)
 
-    def run_one(e, iters):
+    def run_one(e, iters, mode="onehot"):
         layer = MoELayer(num_experts=e, hidden_size=h, ffn_hidden_size=ffn,
-                         top_k=k)
+                         top_k=k, dispatch_mode=mode)
         params = jax.jit(layer.init)(jax.random.PRNGKey(1), x)
 
         def fwd_bwd(x, params):
@@ -394,6 +394,14 @@ def _microbench_moe(rtt: float, on_tpu: bool):
             sweep_rows.append({"num_experts": e,
                                "us": round(te.best * 1e6, 1),
                                "tokens_per_s": round(tokens / te.best, 1)})
+    # index-based dispatch (dispatch_mode="gather") at each sweep point:
+    # the measured crossover vs the dense one-hot einsums
+    for row in sweep_rows:
+        tg = _aux(lambda e=row["num_experts"]: run_one(
+            e, 5 if on_tpu else 2, mode="gather"),
+            f"moe-sweep-gather-E{row['num_experts']}")
+        if tg is not None:
+            row["us_gather"] = round(tg.best * 1e6, 1)
     out["moe_dispatch_sweep"] = sweep_rows
     return out
 

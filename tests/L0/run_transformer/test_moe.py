@@ -102,15 +102,19 @@ def test_moe_ep1_matches_dense_reference():
     assert np.isfinite(float(aux["z_loss"]))
 
 
-def test_moe_ep4_matches_dense_per_shard():
+@pytest.mark.parametrize("mode", ["onehot", "gather"])
+def test_moe_ep4_matches_dense_per_shard(mode):
     """The all_to_all machinery: ep=4 sharded layer ≡ dense layer run on
-    each shard's tokens with the reassembled global expert weights."""
+    each shard's tokens with the reassembled global expert weights —
+    for BOTH dispatch modes (the [E, C, h] buffer contract feeding the
+    all_to_all is mode-independent)."""
     mesh = parallel_state.get_mesh()
     dp = mesh.shape["data"]
     t_local, cap = 8, 8
     tokens = jax.random.normal(jax.random.key(2), (dp * EP * t_local, H))
     layer = MoELayer(num_experts=E, hidden_size=H, ffn_hidden_size=F,
-                     top_k=K, capacity=cap, expert_parallel_size=EP)
+                     top_k=K, capacity=cap, expert_parallel_size=EP,
+                     dispatch_mode=mode)
 
     def body(x):
         params = layer.init(jax.random.key(3), x)
@@ -462,6 +466,46 @@ def test_reduce_moe_grads_syncs_router_replicas():
         np.testing.assert_allclose(red_g[0], red_g[r], rtol=1e-6)
     np.testing.assert_allclose(red_g[0], raw_g.mean(axis=0), rtol=1e-5,
                                atol=1e-6)
+
+
+@pytest.mark.parametrize("tight", [False, True])
+def test_gather_dispatch_matches_onehot(tight):
+    """dispatch_mode='gather' (index form) must reproduce the dense
+    one-hot einsum path EXACTLY — same routing, same capacity drops
+    (``tight`` forces drops), same output, same grads for tokens,
+    router, and experts."""
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cap = 2 if tight else 16
+    tokens = jax.random.normal(jax.random.key(80), (24, H))
+    kw = dict(num_experts=E, hidden_size=H, ffn_hidden_size=F, top_k=K,
+              capacity=cap)
+    dense = MoELayer(dispatch_mode="onehot", **kw)
+    gather = MoELayer(dispatch_mode="gather", **kw)
+    params = dense.init(jax.random.key(81), tokens)   # same param tree
+
+    def loss_fn(layer):
+        def f(p, x):
+            y, aux = layer.apply(p, x)
+            return (jnp.sum(y * y) + 0.01 * aux["load_balancing_loss"],
+                    (y, aux))
+        return f
+
+    (ld, (yd, auxd)), gd = jax.jit(jax.value_and_grad(
+        loss_fn(dense), argnums=(0, 1), has_aux=True))(params, tokens)
+    (lg, (yg, auxg)), gg = jax.jit(jax.value_and_grad(
+        loss_fn(gather), argnums=(0, 1), has_aux=True))(params, tokens)
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(yd),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(lg), float(ld), rtol=1e-6)
+    np.testing.assert_allclose(float(auxg["dropped_fraction"]),
+                               float(auxd["dropped_fraction"]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(auxg["expert_load"]),
+                               np.asarray(auxd["expert_load"]), atol=1e-6)
+    if tight:
+        assert float(auxd["dropped_fraction"]) > 0.0   # drops exercised
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), gg, gd)
 
 
 def test_reduce_moe_grads_expert_scale_matches_dense():
