@@ -81,6 +81,21 @@ def run_capture(n: int) -> bool:
     out.write_text(line + "\n")
     log(f"capture saved to {out.name} backend={backend} "
         f"value={payload.get('value')} vs_baseline={payload.get('vs_baseline')}")
+    if backend == "tpu":
+        # commit immediately: the tunnel has died late in every round —
+        # an uncommitted on-chip capture is one session crash from lost
+        extras = payload.get("extras") or {}
+        msg = (f"r4 on-chip capture: {payload.get('value')} tokens/s, "
+               f"mfu {extras.get('mfu')}, bert_mfu {extras.get('bert_mfu')}")
+        r = subprocess.run(["git", "-C", str(REPO), "add", str(out)],
+                           capture_output=True, text=True)
+        r2 = subprocess.run(
+            ["git", "-C", str(REPO), "commit", "-m", msg,
+             "-m", "No-Verification-Needed: committing a measurement "
+                   "artifact, no source change"],
+            capture_output=True, text=True)
+        log(f"git commit rc={r.returncode}/{r2.returncode}: "
+            f"{(r2.stdout or r2.stderr)[-160:]}")
     return backend == "tpu"
 
 
