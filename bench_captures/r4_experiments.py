@@ -1,0 +1,161 @@
+"""On-chip experiment runner for the next healthy tunnel window (r4).
+
+The watcher's standard capture records the official numbers; this script
+answers the open tuning questions in one go, each in its own subprocess
+(a wedge kills one experiment, not the batch):
+
+1. GPT flagship main leg at batch 8 vs 16 vs 24 — bigger GEMM M dims
+   may lift MFU past the exp2 savings alone.
+2. Flash attention fwd+bwd at the flagship shape with block 512 vs 1024
+   — re-validate the r3 block choice under the base-2 kernels.
+3. The bert leg (north-star config) — standalone, so a partial window
+   still captures it.
+
+Usage:  python bench_captures/r4_experiments.py [--quick]
+Writes: bench_captures/r4_experiments_out.json (one JSON object per key)
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+OUT = REPO / "bench_captures" / "r4_experiments_out.json"
+
+SNIPPETS = {
+    "gpt_batch_sweep": """
+import json, time
+import jax, jax.numpy as jnp, jax.flatten_util
+import sys; sys.path.insert(0, {repo!r})
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+from apex_tpu.ops.fused_update import fused_adam_flat
+
+assert jax.default_backend() in ("tpu", "axon")
+cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                num_attention_heads=16, max_seq_length=1024,
+                hidden_dropout=0.0, attention_dropout=0.0,
+                params_dtype=jnp.bfloat16)
+parallel_state.destroy_model_parallel()
+parallel_state.initialize_model_parallel(1)
+model = gpt_model_provider(cfg)
+res = {{}}
+for batch in (8, 16, 24):
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, 1024), 0,
+                                cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), tokens, labels)
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    flat = flat.astype(jnp.float32)
+
+    def step(state, _):
+        fp, m, v = state
+        loss, g = jax.value_and_grad(
+            lambda fp: model.apply(unravel(fp), tokens, labels))(fp)
+        return fused_adam_flat(fp, g.astype(jnp.float32), m, v, lr=1e-4,
+                               beta1=0.9, beta2=0.999, eps=1e-8,
+                               weight_decay=0.0, step=1), None
+
+    @jax.jit
+    def loop(state):
+        state, _ = jax.lax.scan(step, state, None, length=8)
+        return jax.tree.map(lambda x: jnp.sum(x[:1]) if x.ndim else x,
+                            state)
+
+    state = (flat, jnp.zeros_like(flat), jnp.zeros_like(flat))
+    jax.device_get(loop(state))
+    best = 1e9
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(loop(state))
+        best = min(best, time.perf_counter() - t0)
+    sec = best / 8
+    tps = batch * 1024 / sec
+    n = int(flat.size)
+    mfu = tps * (6 * n + 6 * 8 * 1024 * 1024) / 197e12
+    res[str(batch)] = {{"sec_per_step": round(sec, 5),
+                        "tokens_per_s": round(tps, 1),
+                        "mfu": round(mfu, 4)}}
+print("RESULT" + json.dumps(res))
+""",
+    "attn_block_ab": """
+import json, time
+import jax, jax.numpy as jnp
+import sys; sys.path.insert(0, {repo!r})
+from apex_tpu.ops.attention import flash_attention
+
+assert jax.default_backend() in ("tpu", "axon")
+b, h, s, d = 8, 16, 1024, 64
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16) for kk in ks)
+res = {{}}
+for blk in (512, 1024):
+    def fb(q, k, v):
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=blk,
+                block_k=blk).astype(jnp.float32))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(c, _):
+            dq, dk, dv = fb(q + c * 1e-30, k, v)
+            return c + jnp.sum(dq.ravel()[:1].astype(jnp.float32)), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=20)
+        return c
+
+    jax.device_get(loop(q, k, v))
+    best = 1e9
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(loop(q, k, v))
+        best = min(best, time.perf_counter() - t0)
+    res[str(blk)] = {{"fwd_bwd_us": round(best / 20 * 1e6, 1)}}
+print("RESULT" + json.dumps(res))
+""",
+    "bert_leg": """
+import json, sys; sys.path.insert(0, {repo!r})
+import bench
+bench._bench_micro_leg("bert", force_cpu=False)
+""",
+}
+
+
+def run(name: str, code: str, timeout: int):
+    try:
+        r = subprocess.run([sys.executable, "-c", code.format(repo=str(REPO))],
+                           capture_output=True, text=True, timeout=timeout,
+                           cwd=str(REPO))
+    except subprocess.TimeoutExpired:
+        return {"error": f"timed out after {timeout}s"}
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        if line.startswith("RESULT"):
+            return json.loads(line[len("RESULT"):])
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict):
+                return obj
+        except json.JSONDecodeError:
+            continue
+    return {"error": f"rc={r.returncode}; stderr tail: {r.stderr[-300:]}"}
+
+
+def main():
+    quick = "--quick" in sys.argv
+    out = {}
+    for name, timeout in (("bert_leg", 900), ("gpt_batch_sweep", 1200),
+                          ("attn_block_ab", 700)):
+        if quick and name != "bert_leg":
+            continue
+        print(f"=== {name} ===", flush=True)
+        out[name] = run(name, SNIPPETS[name], timeout)
+        print(json.dumps({name: out[name]}), flush=True)
+        OUT.write_text(json.dumps(out, indent=1) + "\n")
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
