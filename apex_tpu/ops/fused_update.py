@@ -472,11 +472,12 @@ def fused_sgd_flat(p, g, buf, *, lr, momentum, dampening, weight_decay,
 def _lamb1_kernel(p_ref, g_ref, m_ref, v_ref, hp_ref, mo_ref, vo_ref, u_ref):
     b1, b2, eps, wd = hp_ref[0], hp_ref[1], hp_ref[2], hp_ref[3]
     inv_bc1, inv_sqrt_bc2, gscale = hp_ref[4], hp_ref[5], hp_ref[6]
+    beta3 = hp_ref[7]      # 1-b1 normally; 1.0 when grad_averaging=False
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32) * gscale
     m = m_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
-    m_new = b1 * m + (1.0 - b1) * g
+    m_new = b1 * m + beta3 * g
     v_new = b2 * v + (1.0 - b2) * g * g
     u = (m_new * inv_bc1) / (jnp.sqrt(v_new) * inv_sqrt_bc2 + eps) + wd * p
     mo_ref[...] = m_new.astype(mo_ref.dtype)
@@ -485,7 +486,8 @@ def _lamb1_kernel(p_ref, g_ref, m_ref, v_ref, hp_ref, mo_ref, vo_ref, u_ref):
 
 
 def fused_lamb_phase1_flat(p, g, m, v, *, beta1, beta2, eps, weight_decay,
-                           step, bias_correction=True, grad_scale=1.0):
+                           step, bias_correction=True, grad_scale=1.0,
+                           grad_averaging=True):
     """LAMB stage 1: moments + raw update direction ``u``.
 
     Parity: ``amp_C.multi_tensor_lamb_stage_1`` / the fused
@@ -508,6 +510,7 @@ def fused_lamb_phase1_flat(p, g, m, v, *, beta1, beta2, eps, weight_decay,
         jnp.asarray(inv_bc1, jnp.float32),
         jnp.asarray(inv_sqrt_bc2, jnp.float32),
         jnp.asarray(grad_scale, jnp.float32),
+        jnp.asarray((1.0 - beta1) if grad_averaging else 1.0, jnp.float32),
     ])
     p2, n = p, p.shape[0]
     g2 = g

@@ -31,6 +31,32 @@ def _leaf_sizes(tree) -> tuple[int, ...]:
     return tuple(int(x.size) for x in jax.tree_util.tree_leaves(tree))
 
 
+def _materialize_params(params):
+    """(params, is_torch) — generators (``model.parameters()``) are
+    materialized so detection doesn't consume them; torch tensors are
+    detected WITHOUT importing torch (by the leaf type's module)."""
+    if params is None:
+        return params, False
+    if not isinstance(params, (list, tuple, dict)) \
+            and not hasattr(params, "shape") \
+            and hasattr(params, "__iter__"):
+        params = list(params)
+    probe = params
+    if isinstance(params, (list, tuple)) and params \
+            and isinstance(params[0], dict) and "params" in params[0]:
+        # torch param-group dicts; materialize each group's params too
+        params = [dict(g, params=_materialize_params(g["params"])[0])
+                  for g in params]
+        # probe the first NON-empty group (a decay/no-decay split can
+        # legitimately leave an earlier group empty)
+        probe = next((g["params"] for g in params
+                      if jax.tree_util.tree_leaves(g["params"])), [])
+    leaves = jax.tree_util.tree_leaves(probe)
+    is_torch = bool(leaves) and \
+        type(leaves[0]).__module__.partition(".")[0] == "torch"
+    return params, is_torch
+
+
 class _Group:
     """One parameter group: flat fp32 master + per-leaf layout info."""
 
@@ -85,7 +111,34 @@ class FusedOptimizerBase:
     reference: ``apex/optimizers/fused_adam.py :: FusedAdam.__init__``).
     """
 
+    #: name of the torch-mode twin in ``_torch_mode`` (reference scripts
+    #: pass ``model.parameters()`` — torch tensors — to these classes)
+    _TORCH_IMPL: str | None = None
+
+    def __new__(cls, params=None, *args, **kwargs):
+        kw_params = params is None and "params" in kwargs
+        if kw_params:
+            params = kwargs["params"]
+        params, is_torch = _materialize_params(params)
+        if is_torch:
+            if kw_params:
+                kwargs = {k: v for k, v in kwargs.items() if k != "params"}
+            if cls._TORCH_IMPL is None:
+                raise TypeError(
+                    f"{cls.__name__} received torch parameters but has no "
+                    "torch-mode implementation; pass jax arrays (or use "
+                    "FusedAdam/FusedLAMB/FusedSGD, which accept both).")
+            from apex_tpu.optimizers import _torch_mode
+            return getattr(_torch_mode, cls._TORCH_IMPL)(
+                params, *args, **kwargs)
+        obj = super().__new__(cls)
+        # hand the (possibly materialized) params to __init__ — a
+        # consumed generator can't be iterated twice
+        obj.__dict__["_materialized_params"] = params
+        return obj
+
     def __init__(self, params, defaults: dict[str, Any]):
+        params = self.__dict__.pop("_materialized_params", params)
         self.defaults = dict(defaults)
         if isinstance(params, (list, tuple)) and params and \
                 isinstance(params[0], dict):

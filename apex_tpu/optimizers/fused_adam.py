@@ -31,6 +31,10 @@ def _adam_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
 
 
 class FusedAdam(FusedOptimizerBase):
+    #: torch params (reference scripts: ``FusedAdam(model.parameters())``)
+    #: route to the torch-mode twin — see ``_torch_mode.py``
+    _TORCH_IMPL = "FusedAdamTorch"
+
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
                  weight_decay=0.0, amsgrad=False, set_grad_none=True,

@@ -7,6 +7,18 @@ as one Pallas kernel over the flat buffer; per-tensor w/u norms and the
 global-grad-norm clip are static-sliced reductions XLA fuses; phase 2 applies
 ``p -= lr * trust_ratio * u`` with the per-tensor ratio broadcast through a
 ``jnp.repeat`` over static leaf sizes.
+
+Scope notes (shared verbatim by the torch-mode twin in
+``_torch_mode.py`` — the two entry points are kept numerically
+interchangeable):
+
+* the grad-norm clip is PER PARAM GROUP (each group's flat buffer owns
+  its norm); single-group construction — the common case — matches the
+  reference's global clip exactly;
+* the trust ratio applies to every param with nonzero ``|w|``/``|u|``
+  regardless of that group's weight-decay setting (and ``use_nvlamb``
+  uses ``|w|/max(|u|, 1e-12)``) — the simplification both
+  implementations share.
 """
 from __future__ import annotations
 
@@ -23,10 +35,11 @@ __all__ = ["FusedLAMB"]
 
 @functools.partial(
     jax.jit, donate_argnums=(0, 1, 2),
-    static_argnames=("bias_correction", "offsets", "sizes", "use_nvlamb"))
+    static_argnames=("bias_correction", "offsets", "sizes", "use_nvlamb",
+                     "grad_averaging"))
 def _lamb_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
                max_grad_norm, noop_flag, grad_scale, *, bias_correction,
-               offsets, sizes, use_nvlamb):
+               offsets, sizes, use_nvlamb, grad_averaging=True):
     g32 = g.astype(jnp.float32) * grad_scale
     # global grad norm clip (reference: first multi_tensor_l2norm launch)
     gnorm = jnp.sqrt(jnp.sum(g32 * g32))
@@ -37,7 +50,8 @@ def _lamb_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
     m_new, v_new, u = fused_lamb_phase1_flat(
         p, g32, m, v, beta1=beta1, beta2=beta2, eps=eps,
         weight_decay=weight_decay, step=step,
-        bias_correction=bias_correction, grad_scale=clip)
+        bias_correction=bias_correction, grad_scale=clip,
+        grad_averaging=grad_averaging)
 
     def sq_norms(flat):
         return jnp.stack([
@@ -62,6 +76,10 @@ def _lamb_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
 
 
 class FusedLAMB(FusedOptimizerBase):
+    #: torch params (reference BERT: ``FusedLAMB(model.parameters())``)
+    #: route to the torch-mode twin — see ``_torch_mode.py``
+    _TORCH_IMPL = "FusedLAMBTorch"
+
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
                  amsgrad=False, adam_w_mode=True, grad_averaging=True,
@@ -71,7 +89,8 @@ class FusedLAMB(FusedOptimizerBase):
                                "variant.")
         defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
                         eps=eps, weight_decay=weight_decay,
-                        max_grad_norm=max_grad_norm)
+                        max_grad_norm=max_grad_norm,
+                        grad_averaging=grad_averaging)
         self.use_nvlamb = bool(use_nvlamb)
         super().__init__(params, defaults)
 
@@ -96,7 +115,8 @@ class FusedLAMB(FusedOptimizerBase):
             jnp.asarray(grad_scale, jnp.float32),
             bias_correction=bool(o["bias_correction"]),
             offsets=tuple(group.offsets), sizes=tuple(group.sizes),
-            use_nvlamb=self.use_nvlamb)
+            use_nvlamb=self.use_nvlamb,
+            grad_averaging=bool(o.get("grad_averaging", True)))
         group.master = p
         group.state["exp_avg"] = m
         group.state["exp_avg_sq"] = v

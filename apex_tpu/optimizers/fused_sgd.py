@@ -24,6 +24,9 @@ def _sgd_step(p, buf, g, lr, momentum, dampening, weight_decay, first,
 
 
 class FusedSGD(FusedOptimizerBase):
+    #: torch params route to the torch-mode twin — see ``_torch_mode.py``
+    _TORCH_IMPL = "FusedSGDTorch"
+
     def __init__(self, params, lr, momentum=0.0, dampening=0.0,
                  weight_decay=0.0, nesterov=False,
                  wd_after_momentum=False, materialize_master_grads=True,
