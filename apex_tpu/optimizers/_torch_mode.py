@@ -27,7 +27,9 @@ import math
 
 import torch
 
-__all__ = ["FusedAdamTorch", "FusedLAMBTorch", "FusedSGDTorch"]
+__all__ = ["FusedAdamTorch", "FusedLAMBTorch", "FusedSGDTorch",
+           "FusedAdagradTorch", "FusedNovoGradTorch",
+           "FusedMixedPrecisionLambTorch"]
 
 
 class _TorchFusedBase(torch.optim.Optimizer):
@@ -62,7 +64,7 @@ class _TorchFusedBase(torch.optim.Optimizer):
         super().load_state_dict(state_dict)
         for st in self.state.values():
             for k in ("master", "exp_avg", "exp_avg_sq",
-                      "momentum_buffer"):
+                      "momentum_buffer", "sum"):
                 if k in st and torch.is_tensor(st[k]) \
                         and st[k].dtype != torch.float32:
                     st[k] = st[k].float()
@@ -169,6 +171,106 @@ class FusedSGDTorch(_TorchFusedBase):
         return loss
 
 
+class FusedAdagradTorch(_TorchFusedBase):
+    """Reference: ``apex/optimizers/fused_adagrad.py`` — mirrors the JAX
+    ``_adagrad_kernel`` exactly: L2 mode folds decay into the grad
+    BEFORE the accumulator update; ``adagrad_w_mode`` decouples it into
+    the update instead."""
+
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=True, adagrad_w_mode=False):
+        defaults = dict(lr=lr, eps=eps, weight_decay=weight_decay)
+        self.adagrad_w_mode = bool(adagrad_w_mode)
+        super().__init__(params, defaults, set_grad_none)
+
+    @torch.no_grad()
+    def step(self, closure=None, grad_scale=1.0):
+        loss = closure() if closure is not None else None
+        for group in self.param_groups:
+            lr, eps, wd = group["lr"], group["eps"], group["weight_decay"]
+            for p in group["params"]:
+                if p.grad is None:
+                    continue
+                state = self.state[p]
+                master = self._master(p, state)
+                g = p.grad.float()
+                if grad_scale != 1.0:
+                    g = g * grad_scale
+                if wd != 0.0 and not self.adagrad_w_mode:
+                    g = g.add(master, alpha=wd)
+                if "sum" not in state:
+                    state["sum"] = torch.zeros_like(master)
+                h = state["sum"]
+                h.addcmul_(g, g, value=1.0)
+                update = g / (h.sqrt() + eps)
+                if wd != 0.0 and self.adagrad_w_mode:
+                    update = update.add(master, alpha=wd)
+                master.add_(update, alpha=-lr)
+                self._writeback(p, master)
+        return loss
+
+
+class FusedNovoGradTorch(_TorchFusedBase):
+    """Reference: ``apex/optimizers/fused_novograd.py`` — mirrors the
+    JAX ``_novograd_step``: per-TENSOR second moment (||g||² EMA,
+    initialized from the first grad unless ``init_zero``), decay folded
+    into the normalized grad, bias correction on the first moment."""
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 amsgrad=False, reg_inside_moment=False,
+                 grad_averaging=True, norm_type=2, init_zero=False,
+                 set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the "
+                               "AMSGrad variant.")
+        if norm_type != 2:
+            raise ValueError("FusedNovoGrad only supports norm_type=2")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay)
+        self.grad_averaging = bool(grad_averaging)
+        self.init_zero = bool(init_zero)
+        super().__init__(params, defaults, set_grad_none)
+
+    @torch.no_grad()
+    def step(self, closure=None, grad_scale=1.0):
+        loss = closure() if closure is not None else None
+        for group in self.param_groups:
+            beta1, beta2 = group["betas"]
+            lr, eps, wd = group["lr"], group["eps"], group["weight_decay"]
+            coef = (1 - beta1) if self.grad_averaging else 1.0
+            for p in group["params"]:
+                if p.grad is None:
+                    continue
+                state = self.state[p]
+                master = self._master(p, state)
+                g = p.grad.float()
+                if grad_scale != 1.0:
+                    g = g * grad_scale
+                gsq = float(torch.sum(g * g))
+                if "exp_avg" not in state:
+                    state["step"] = 0
+                    state["exp_avg"] = torch.zeros_like(master)
+                    state["exp_avg_sq"] = 0.0
+                state["step"] += 1
+                t = state["step"]
+                if t == 1:
+                    v = 0.0 if self.init_zero else gsq
+                else:
+                    v = beta2 * state["exp_avg_sq"] + (1 - beta2) * gsq
+                state["exp_avg_sq"] = v
+                ghat = g / (math.sqrt(v) + eps)
+                if wd != 0.0:
+                    ghat = ghat.add(master, alpha=wd)
+                m = state["exp_avg"]
+                m.mul_(beta1).add_(ghat, alpha=coef)
+                step_size = lr / (1 - beta1 ** t) \
+                    if group["bias_correction"] else lr
+                master.add_(m, alpha=-step_size)
+                self._writeback(p, master)
+        return loss
+
+
 class FusedLAMBTorch(_TorchFusedBase):
     """Reference: ``apex/optimizers/fused_lamb.py :: FusedLAMB`` — the
     same two-phase math as the JAX class (``fused_lamb.py ::
@@ -248,3 +350,36 @@ class FusedLAMBTorch(_TorchFusedBase):
                 master.add_(u, alpha=-lr * ratio)
                 self._writeback(p, master)
         return loss
+
+
+class FusedMixedPrecisionLambTorch(FusedLAMBTorch):
+    """Reference: ``apex/contrib .. fused_mixed_precision_lamb`` — LAMB
+    with an explicit starting ``step`` and a ``reduced_precision_dtype``
+    knob (the internal fp32 masters already provide the mixed-precision
+    behavior; the dtype knob is accepted for signature parity)."""
+
+    def __init__(self, params, lr=1e-3, step=0, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 amsgrad=False, grad_averaging=True, max_grad_norm=1.0,
+                 use_nvlamb=False, reduced_precision_dtype=None):
+        super().__init__(params, lr=lr, bias_correction=bias_correction,
+                         betas=betas, eps=eps, weight_decay=weight_decay,
+                         amsgrad=amsgrad, grad_averaging=grad_averaging,
+                         max_grad_norm=max_grad_norm, use_nvlamb=use_nvlamb)
+        self.reduced_precision_dtype = reduced_precision_dtype
+        self._initial_step = int(step)
+
+    @torch.no_grad()
+    def step(self, closure=None, grad_scale=1.0):
+        # advance every param's step counter past the configured start
+        # the first time through (reference resumes mid-schedule)
+        if self._initial_step and not any(
+                "step" in s for s in self.state.values()):
+            for group in self.param_groups:
+                for p in group["params"]:
+                    self.state[p]["step"] = self._initial_step
+                    self.state[p]["exp_avg"] = torch.zeros_like(
+                        self._master(p, self.state[p]))
+                    self.state[p]["exp_avg_sq"] = torch.zeros_like(
+                        self._master(p, self.state[p]))
+        return super().step(closure=closure, grad_scale=grad_scale)

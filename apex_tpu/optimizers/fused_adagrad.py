@@ -23,6 +23,10 @@ def _adagrad_step(p, h, g, lr, eps, weight_decay, noop_flag, grad_scale, *,
 
 
 class FusedAdagrad(FusedOptimizerBase):
+    #: torch params route to the torch-mode twin — see
+    #: ``_torch_mode.py``
+    _TORCH_IMPL = "FusedAdagradTorch"
+
     def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0,
                  set_grad_none=True, adagrad_w_mode=False):
         defaults = dict(lr=lr, eps=eps, weight_decay=weight_decay)

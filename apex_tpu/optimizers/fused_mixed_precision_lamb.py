@@ -21,6 +21,10 @@ __all__ = ["FusedMixedPrecisionLamb"]
 
 
 class FusedMixedPrecisionLamb(FusedLAMB):
+    #: torch params route to the torch-mode twin — see
+    #: ``_torch_mode.py``
+    _TORCH_IMPL = "FusedMixedPrecisionLambTorch"
+
     def __init__(self, params, lr=1e-3, step=0, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
                  amsgrad=False, grad_averaging=True, max_grad_norm=1.0,

@@ -50,6 +50,10 @@ def _novograd_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
 
 
 class FusedNovoGrad(FusedOptimizerBase):
+    #: torch params route to the torch-mode twin — see
+    #: ``_torch_mode.py``
+    _TORCH_IMPL = "FusedNovoGradTorch"
+
     def __init__(self, params, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                  amsgrad=False, reg_inside_moment=False, grad_averaging=True,

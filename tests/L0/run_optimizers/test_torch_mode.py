@@ -48,9 +48,75 @@ def test_generator_params_accepted():
 
 
 def test_no_torch_impl_raises_cleanly():
+    from apex_tpu.optimizers.base import FusedOptimizerBase
+
+    class _NoTwin(FusedOptimizerBase):
+        def __init__(self, params):
+            super().__init__(params, {})
+
     m = _model()
     with pytest.raises(TypeError, match="torch-mode"):
-        FusedNovoGrad(m.parameters(), lr=1e-3)
+        _NoTwin(m.parameters())
+
+
+def test_fused_novograd_torch_matches_jax():
+    """Two steps (the second exercises the per-tensor ||g||2 EMA, the
+    first its grad-seeded init) must match the jax class."""
+    rng = np.random.default_rng(3)
+    shapes = [(5, 4), (4,)]
+    params_np = [rng.normal(size=s).astype(np.float32) for s in shapes]
+    g1 = [rng.normal(size=s).astype(np.float32) * 0.1 for s in shapes]
+    g2 = [rng.normal(size=s).astype(np.float32) * 0.1 for s in shapes]
+
+    tp = [torch.nn.Parameter(torch.tensor(p)) for p in params_np]
+    topt = FusedNovoGrad(tp, lr=1e-2, weight_decay=0.01)
+    for grads in (g1, g2):
+        for p, g in zip(tp, grads):
+            p.grad = torch.tensor(g)
+        topt.step()
+
+    jopt = FusedNovoGrad([jnp.asarray(p) for p in params_np], lr=1e-2,
+                         weight_decay=0.01)
+    jnew = jopt.step([jnp.asarray(g) for g in g1])
+    jnew = jopt.step([jnp.asarray(g) for g in g2])
+    for t, j in zip(tp, jnew):
+        np.testing.assert_allclose(t.detach().numpy(), np.asarray(j),
+                                   rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("w_mode", [False, True])
+def test_fused_adagrad_torch_matches_jax(w_mode):
+    from apex_tpu.optimizers import FusedAdagrad
+
+    rng = np.random.default_rng(4)
+    p_np = rng.normal(size=(6, 3)).astype(np.float32)
+    g_np = rng.normal(size=(6, 3)).astype(np.float32) * 0.1
+
+    tp = torch.nn.Parameter(torch.tensor(p_np))
+    tp.grad = torch.tensor(g_np)
+    topt = FusedAdagrad([tp], lr=1e-2, weight_decay=0.01,
+                        adagrad_w_mode=w_mode)
+    topt.step()
+
+    jopt = FusedAdagrad([jnp.asarray(p_np)], lr=1e-2, weight_decay=0.01,
+                        adagrad_w_mode=w_mode)
+    jnew = jopt.step([jnp.asarray(g_np)])
+    np.testing.assert_allclose(tp.detach().numpy(), np.asarray(jnew[0]),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_fused_mixed_precision_lamb_routes_with_step_arg():
+    from apex_tpu.optimizers import FusedMixedPrecisionLamb
+
+    m = _model()
+    # positional `step` arg: inherited routing must not feed it into
+    # the LAMB twin's bias_correction slot
+    opt = FusedMixedPrecisionLamb(m.parameters(), 1e-3, 5)
+    assert isinstance(opt, torch.optim.Optimizer)
+    assert opt._initial_step == 5
+    _run(m, opt, steps=2)
+    assert all("step" in opt.state[p] and opt.state[p]["step"] >= 6
+               for g in opt.param_groups for p in g["params"])
 
 
 def test_fused_adam_matches_torch_adamw():
@@ -182,6 +248,22 @@ def test_load_state_dict_keeps_fp32_master():
     # the override must restore fp32 for master and moments
     for k in ("master", "exp_avg", "exp_avg_sq"):
         assert st[k].dtype == torch.float32, k
+
+
+def test_adagrad_sum_stays_fp32_after_load():
+    from apex_tpu.optimizers import FusedAdagrad
+
+    p = torch.nn.Parameter(torch.randn(8, 8).bfloat16())
+    opt = FusedAdagrad([p], lr=1e-2)
+    p.grad = torch.randn_like(p)
+    opt.step()
+    sd = opt.state_dict()
+    p2 = torch.nn.Parameter(p.detach().clone())
+    opt2 = FusedAdagrad([p2], lr=1e-2)
+    p2.grad = torch.randn_like(p2)
+    opt2.step()
+    opt2.load_state_dict(sd)
+    assert opt2.state[p2]["sum"].dtype == torch.float32
 
 
 def test_half_params_keep_fp32_masters():
