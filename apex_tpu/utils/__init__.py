@@ -6,6 +6,7 @@ from .common import (
     cdiv,
     tree_ravel,
 )
+from .prefetcher import DevicePrefetcher
 
 __all__ = [
     "interpret_mode",
@@ -14,4 +15,5 @@ __all__ = [
     "pad_rows",
     "cdiv",
     "tree_ravel",
+    "DevicePrefetcher",
 ]

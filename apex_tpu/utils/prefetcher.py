@@ -1,0 +1,121 @@
+"""Host→device prefetcher — the reference example's ``data_prefetcher``
+rebuilt TPU-native.
+
+Reference: ``examples/imagenet/main_amp.py :: data_prefetcher`` — a
+side CUDA stream that issues the next batch's H2D copies (and
+normalization) while the current step computes, double-buffered.
+
+On TPU the async substrate is different but the overlap is the same
+idea: ``jax.device_put`` dispatches asynchronously (the returned arrays
+are futures over an in-flight transfer), so a daemon thread walking the
+host iterator ``depth`` steps ahead keeps PCIe/DMA busy under the step's
+compute window, and the train loop blocks only if it outruns the
+loader.  Works with numpy arrays, jax arrays, torch CPU tensors (zero-
+copy numpy bridge), and arbitrary pytrees of them; an optional
+``sharding`` places batches directly into a mesh layout so multi-chip
+feeds skip the host-replication hop.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Optional
+
+import jax
+
+__all__ = ["DevicePrefetcher"]
+
+_END = object()
+
+
+def _to_host_array(x):
+    """torch CPU tensors -> numpy (zero-copy when possible); everything
+    else passes through for jax.device_put to handle."""
+    if type(x).__module__.partition(".")[0] == "torch":
+        return x.detach().cpu().numpy()
+    return x
+
+
+class DevicePrefetcher:
+    """Iterate ``iterable``, staying ``depth`` device_put's ahead.
+
+    >>> for images, target in DevicePrefetcher(loader, depth=2):
+    ...     state = train_step(state, images, target)
+
+    ``sharding``: optional ``jax.sharding.Sharding`` (e.g. a
+    ``NamedSharding`` over the data axis) applied to every leaf;
+    ``None`` targets the default device.  Exceptions from the source
+    iterator surface in the consumer thread, at the position they
+    occurred.  The worker is a daemon thread, so an abandoned (half-
+    consumed) prefetcher never blocks interpreter exit; ``close()``
+    releases it eagerly.
+    """
+
+    def __init__(self, iterable: Iterable, depth: int = 2,
+                 sharding: Optional[Any] = None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._sharding = sharding
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, args=(iter(iterable),), daemon=True)
+        self._thread.start()
+
+    def _put(self, batch):
+        batch = jax.tree.map(_to_host_array, batch)
+        if self._sharding is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    def _worker(self, it):
+        try:
+            for batch in it:
+                item = (self._put(batch), None)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — reraised consumer-side
+            self._q.put((None, e))
+            return
+        self._q.put((_END, None))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # terminal states must KEEP raising (iterator protocol) — a
+        # bare queue.get() after exhaustion/error/close would hang
+        # forever on a queue no dead worker will ever fill
+        if self._stop.is_set():
+            raise StopIteration
+        item, err = self._q.get()
+        if err is not None:
+            self.close()
+            raise err
+        if item is _END:
+            self._stop.set()
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Stop the worker without draining (safe to call repeatedly)."""
+        self._stop.set()
+        # unblock a worker stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

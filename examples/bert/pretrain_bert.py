@@ -102,8 +102,13 @@ def main(argv=None):
     grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
     heldout = synthetic_mlm_batch(rng, args)   # never trained on
     losses = []
-    for it in range(args.iters):
-        tokens, labels = synthetic_mlm_batch(rng, args)   # fresh data
+    # the reference example's `data_prefetcher` flow: batches are staged
+    # on-device a couple of steps ahead so H2D rides the compute window
+    from apex_tpu.utils import DevicePrefetcher
+    batches = DevicePrefetcher(
+        (synthetic_mlm_batch(rng, args) for _ in range(args.iters)),
+        depth=2)
+    for it, (tokens, labels) in enumerate(batches):
         (_, loss), grads = grad_fn(params, tokens, labels,
                                    scaler.state.loss_scale)
         grads = scaler.unscale_(grads)   # fused unscale + overflow check

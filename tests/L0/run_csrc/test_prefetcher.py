@@ -1,0 +1,104 @@
+"""DevicePrefetcher (reference: ``examples/imagenet/main_amp.py ::
+data_prefetcher`` — side-stream H2D overlap, rebuilt as an async
+device_put pipeline)."""
+import queue
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.utils import DevicePrefetcher
+
+
+def test_order_and_values_preserved():
+    batches = [(np.full((4,), i, np.float32), {"y": np.int32(i)})
+               for i in range(10)]
+    out = list(DevicePrefetcher(iter(batches), depth=3))
+    assert len(out) == 10
+    for i, (x, d) in enumerate(out):
+        assert isinstance(x, jax.Array)
+        np.testing.assert_array_equal(np.asarray(x), batches[i][0])
+        assert int(d["y"]) == i
+
+
+def test_torch_tensors_bridge_to_device():
+    batches = [(torch.full((2, 3), float(i)), torch.tensor([i]))
+               for i in range(4)]
+    out = list(DevicePrefetcher(iter(batches)))
+    for i, (x, y) in enumerate(out):
+        assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+        assert float(x[0, 0]) == float(i)
+
+
+def test_feeds_jit_consumer():
+    @jax.jit
+    def f(x):
+        return jnp.sum(x * 2)
+
+    total = sum(float(f(x)) for x in DevicePrefetcher(
+        (np.ones((8,), np.float32) * i for i in range(5))))
+    assert total == 2 * 8 * (0 + 1 + 2 + 3 + 4)
+
+
+def test_source_exception_propagates_in_order():
+    def gen():
+        yield np.zeros(2, np.float32)
+        raise RuntimeError("loader died")
+
+    pf = DevicePrefetcher(gen())
+    next(pf)
+    with pytest.raises(RuntimeError, match="loader died"):
+        next(pf)
+
+
+def test_empty_iterator():
+    assert list(DevicePrefetcher(iter(()))) == []
+
+
+def test_sharding_places_batches():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]).reshape(4),
+                             ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    out = list(DevicePrefetcher(
+        (np.arange(8, dtype=np.float32) + i for i in range(3)),
+        sharding=sh))
+    for x in out:
+        assert x.sharding == sh
+
+
+def test_close_releases_blocked_worker():
+    def endless():
+        i = 0
+        while True:
+            yield np.float32(i)
+            i += 1
+
+    pf = DevicePrefetcher(endless(), depth=1)
+    next(pf)
+    pf.close()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+
+
+def test_depth_validation():
+    with pytest.raises(ValueError, match="depth"):
+        DevicePrefetcher(iter(()), depth=0)
+
+
+def test_terminal_states_keep_raising_stopiteration():
+    # exhausted: must not hang on a queue the dead worker won't refill
+    pf = DevicePrefetcher(iter([np.float32(1)]))
+    assert len(list(pf)) == 1
+    with pytest.raises(StopIteration):
+        next(pf)
+    assert list(pf) == []
+    # closed mid-stream: same contract
+    pf2 = DevicePrefetcher(iter([np.float32(1), np.float32(2)]), depth=1)
+    next(pf2)
+    pf2.close()
+    with pytest.raises(StopIteration):
+        next(pf2)
