@@ -106,9 +106,37 @@ def main() -> None:
                      for f in CAPDIR.glob("r4_watch_capture_*.json"))
     n = indices[-1] if indices else 0
     log(f"watcher started (next capture index {n + 1})")
+    bert_done = False
     while True:
         if probe():
-            log("probe OK — running full bench capture")
+            if not bert_done:
+                # the north-star leg FIRST: a brief tunnel window must
+                # not be eaten by the 20+ min main-leg compile before
+                # the >=50%-MFU BERT number is captured
+                log("probe OK — running quick bert leg first")
+                try:
+                    r = subprocess.run(
+                        [sys.executable,
+                         str(CAPDIR / "r4_experiments.py"), "--quick"],
+                        capture_output=True, text=True, timeout=1000,
+                        cwd=str(REPO))
+                    log(f"bert leg rc={r.returncode}: "
+                        f"{(r.stdout or '').strip().splitlines()[-1:]}"
+                    )
+                    outf = CAPDIR / "r4_experiments_out.json"
+                    if outf.exists() and "bert_mfu" in outf.read_text():
+                        bert_done = True
+                        subprocess.run(["git", "-C", str(REPO), "add",
+                                        str(outf)], capture_output=True)
+                        subprocess.run(
+                            ["git", "-C", str(REPO), "commit", "-m",
+                             "r4 on-chip bert leg capture",
+                             "-m", "No-Verification-Needed: measurement "
+                                   "artifact, no source change"],
+                            capture_output=True)
+                except subprocess.TimeoutExpired:
+                    log("bert leg timed out")
+            log("running full bench capture")
             n += 1
             ok = run_capture(n)
             log(f"capture {'TPU-green' if ok else 'degraded'}; sleeping 1200s")
