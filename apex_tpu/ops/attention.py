@@ -72,6 +72,17 @@ _LANES = 128              # TPU lane width; m/l scratch is lane-replicated
 _STAT_LANES = 8
 
 
+def _rows_can_be_fully_masked(causal, off, masked, valid) -> bool:
+    """Statically decide whether ANY query row could end up fully
+    masked — only then do the kernels pay the [bq, bk] zero-forcing
+    ``where`` on p (fwd) / the recompute (bwd).  Possible sources: an
+    explicit mask, a validity window (padded rows), or causal with
+    sq > sk (queries before the first key).  The flagship causal
+    sq == sk unpadded path — the VPU-bound case PERF.md profiles —
+    skips the select entirely."""
+    return masked or (valid is not None) or (causal and off < 0)
+
+
 def mha_reference(q, k, v, *, causal: bool = False, mask=None,
                   sm_scale: Optional[float] = None):
     """Pure-jnp oracle: softmax(scale·QKᵀ + mask)·V, fp32 accumulation.
@@ -155,9 +166,11 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid,
         # _NEG_INF is finite, so a fully-masked row would get
         # exp2(s - m) = exp2(0) = 1 everywhere and emit mean(v) instead
         # of 0 (hit by causal sq > sk: queries before the first key);
-        # force p = 0 there so l stays 0 and _finish emits 0
-        p = jnp.where(m_new[:, :1] <= _MASKED_ROW_THRESH, 0.0,
-                      jnp.exp2(s - m_new[:, :1]))        # [bq, bk]
+        # force p = 0 there so l stays 0 and _finish emits 0.  Shapes
+        # that can't produce such rows skip the [bq, bk] select.
+        p = jnp.exp2(s - m_new[:, :1])                   # [bq, bk]
+        if _rows_can_be_fully_masked(causal, off, masked, valid):
+            p = jnp.where(m_new[:, :1] <= _MASKED_ROW_THRESH, 0.0, p)
         l_scr[...] = l_scr[...] * alpha + \
             jnp.sum(p, axis=1, keepdims=True)
         # p rounds to the input dtype for the MXU pass (the standard
@@ -313,9 +326,12 @@ def _recompute_p(causal, off, scale, bq, bk, masked, valid, qi, ki,
         s = jnp.where(mask_ref[0], _NEG_INF, s)
     s = _valid_mask(s, valid, qi, ki, bq, bk)
     # fully-masked rows carry lse = _NEG_INF (finite), so exp2(s - lse)
-    # would be 1, not 0 — mirror the forward's guard
-    return jnp.where(lse_ref[0][:, :1] <= _MASKED_ROW_THRESH, 0.0,
-                     jnp.exp2(s - lse_ref[0][:, :1]))
+    # would be 1, not 0 — mirror the forward's guard (and its static
+    # skip for shapes that can't produce such rows)
+    p = jnp.exp2(s - lse_ref[0][:, :1])
+    if _rows_can_be_fully_masked(causal, off, masked, valid):
+        p = jnp.where(lse_ref[0][:, :1] <= _MASKED_ROW_THRESH, 0.0, p)
+    return p
 
 
 def _bwd_fused_kernel(causal, off, scale, bq, bk, nq, nk, masked, valid,
