@@ -1,0 +1,113 @@
+"""Thin-but-load-bearing auxiliary surfaces that had no direct tests:
+contrib nccl_p2p ppermute wrappers, the model-parallel GradScaler's
+shared skip decision, the distributed-init no-op path, log_util."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size_=4)
+    yield
+    parallel_state.destroy_model_parallel()
+
+
+def test_left_right_halo_exchange_routes_neighbors():
+    from apex_tpu.contrib.nccl_p2p import left_right_halo_exchange
+
+    mesh = parallel_state.get_mesh()
+    n = 4
+    tops = jnp.arange(n, dtype=jnp.float32).reshape(n, 1) + 100
+    btms = jnp.arange(n, dtype=jnp.float32).reshape(n, 1) + 200
+
+    def body(top, btm):
+        from_prev, from_next = left_right_halo_exchange(
+            top[0], btm[0], "tensor")
+        return from_prev[None], from_next[None]
+
+    from_prev, from_next = jax.jit(
+        functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh, in_specs=(P("tensor"), P("tensor")),
+            out_specs=(P("tensor"), P("tensor"))))(tops, btms)
+    # rank i receives prev's bottom halo and next's top halo
+    np.testing.assert_array_equal(
+        np.asarray(from_prev).ravel(),
+        [200 + (i - 1) % n for i in range(n)])
+    np.testing.assert_array_equal(
+        np.asarray(from_next).ravel(),
+        [100 + (i + 1) % n for i in range(n)])
+
+
+def test_grad_scaler_shares_skip_decision_across_tp_ranks():
+    """One rank's inf must make EVERY tensor rank skip (the reference's
+    allreduce-found_inf delta over torch's GradScaler)."""
+    from apex_tpu.transformer.amp import GradScaler
+
+    mesh = parallel_state.get_mesh()
+    scaler = GradScaler(model_parallel_axes=("tensor",))
+    # rank 2's grad shard carries an inf
+    grads = jnp.zeros((4, 8), jnp.float32).at[2, 3].set(jnp.inf)
+
+    def body(g):
+        state = scaler.init()
+        _, state = scaler.unscale_({"w": g[0]}, state)
+        return state.found_inf[None]
+
+    found = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("tensor"),),
+        out_specs=P("tensor")))(grads)
+    assert np.asarray(found).shape == (4,)
+    assert np.all(np.asarray(found) > 0), found   # EVERY rank skips
+
+
+def test_grad_scaler_clean_grads_no_skip():
+    from apex_tpu.transformer.amp import GradScaler
+
+    mesh = parallel_state.get_mesh()
+    scaler = GradScaler(model_parallel_axes=("tensor",))
+    grads = jnp.ones((4, 8), jnp.float32)
+
+    def body(g):
+        state = scaler.init()
+        ug, state = scaler.unscale_({"w": g[0]}, state)
+        return state.found_inf[None], ug["w"][None]
+
+    found, ug = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("tensor"),),
+        out_specs=(P("tensor"), P("tensor"))))(grads)
+    assert np.all(np.asarray(found) == 0)
+    # unscale divides by the initial 2^16 scale
+    np.testing.assert_allclose(np.asarray(ug), 1.0 / 2.0 ** 16, rtol=1e-6)
+
+
+def test_initialize_distributed_backend_single_process_noop():
+    from apex_tpu.transformer._ucc_util import (
+        HAS_UCC, initialize_distributed_backend)
+
+    assert HAS_UCC is False
+    # single-process: returns without touching jax.distributed
+    initialize_distributed_backend()
+    initialize_distributed_backend(num_processes=1)
+
+
+def test_log_util_roundtrip():
+    import logging
+
+    from apex_tpu.transformer.log_util import (
+        get_transformer_logger, set_logging_level)
+
+    logger = get_transformer_logger("test_aux")
+    assert logger.name == "apex_tpu.transformer.test_aux"
+    set_logging_level(logging.WARNING)
+    assert logging.getLogger(
+        "apex_tpu.transformer").level == logging.WARNING
+    set_logging_level(logging.INFO)
+    assert logger is get_transformer_logger("test_aux")
