@@ -104,10 +104,13 @@ def test_log_util_roundtrip():
     from apex_tpu.transformer.log_util import (
         get_transformer_logger, set_logging_level)
 
-    logger = get_transformer_logger("test_aux")
-    assert logger.name == "apex_tpu.transformer.test_aux"
-    set_logging_level(logging.WARNING)
-    assert logging.getLogger(
-        "apex_tpu.transformer").level == logging.WARNING
-    set_logging_level(logging.INFO)
-    assert logger is get_transformer_logger("test_aux")
+    pkg = logging.getLogger("apex_tpu.transformer")
+    prev = pkg.level
+    try:
+        logger = get_transformer_logger("test_aux")
+        assert logger.name == "apex_tpu.transformer.test_aux"
+        set_logging_level(logging.WARNING)
+        assert pkg.level == logging.WARNING
+        assert logger is get_transformer_logger("test_aux")
+    finally:
+        pkg.setLevel(prev)   # don't leak a level into the session
