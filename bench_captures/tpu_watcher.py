@@ -1,17 +1,32 @@
-"""Detached TPU-tunnel watcher for round 4.
+"""Detached TPU-tunnel watcher (round 5, hardened per r4 verdict Weak #2).
 
-The axon tunnel has died late in ALL prior rounds (VERDICT r3 "do this" #2:
-capture early, commit immediately).  This watcher probes the backend in a
-disposable subprocess every PROBE_INTERVAL seconds; the moment the chip
-answers, it runs the full ``bench.py`` capture, saves the raw JSON line to
-``bench_captures/r4_watch_capture_<n>.json``, and keeps watching (later
-captures are upgrades — bench.py itself picks its own best numbers).
+The axon tunnel has died mid-session in ALL prior rounds.  This watcher
+probes the backend in a disposable subprocess every PROBE_INTERVAL
+seconds; the moment the chip answers it
 
-Run detached:  nohup python bench_captures/tpu_watcher.py >> bench_captures/watcher.log 2>&1 &
+1. runs the quick BERT north-star leg (``r4_experiments.py --quick``)
+   first — a brief window must not be eaten by the main-leg compile,
+2. runs the full ``bench.py`` capture and saves the JSON line to
+   ``bench_captures/r5_watch_capture_<n>.json``,
+3. on a TPU-green capture, ALSO writes ``BENCH_r05.json`` at the repo
+   root so the driver artifact has on-chip provenance the moment the
+   first capture lands (r4 verdict Missing #2), and commits everything.
+
+Hardening vs the r4 version:
+- a pid lockfile (``watcher.lock``) prevents two instances racing the
+  same capture numbering; stale locks (dead pid) are reclaimed,
+- capture files are written via temp+rename and the index is re-scanned
+  immediately before each write, tolerating a concurrent writer,
+- the capture/commit path is factored into pure-ish functions exercised
+  by ``tests/L1/test_watcher.py`` with a stubbed runner.
+
+Run detached:
+  nohup python bench_captures/tpu_watcher.py >> bench_captures/watcher.log 2>&1 &
 """
 from __future__ import annotations
 
 import datetime
+import fcntl
 import json
 import os
 import pathlib
@@ -21,6 +36,8 @@ import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 CAPDIR = REPO / "bench_captures"
+LOCKFILE = CAPDIR / "watcher.lock"
+ROUND = "r5"
 PROBE_TIMEOUT = 90
 BENCH_TIMEOUT = 1800
 PROBE_INTERVAL = 240
@@ -37,11 +54,61 @@ def log(msg: str) -> None:
     print(f"[{datetime.datetime.utcnow().isoformat()}] {msg}", flush=True)
 
 
-def probe() -> bool:
+_lock_fd = None  # held open for the watcher's lifetime
+
+
+def acquire_lock() -> bool:
+    """flock the lockfile (no TOCTOU window; the kernel releases the
+    lock automatically when the holder dies, so no stale-pid logic)."""
+    global _lock_fd
+    fd = os.open(LOCKFILE, os.O_CREAT | os.O_WRONLY)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return False
+    os.ftruncate(fd, 0)
+    os.write(fd, str(os.getpid()).encode())  # diagnostic only
+    _lock_fd = fd
+    return True
+
+
+def release_lock() -> None:
+    global _lock_fd
+    if _lock_fd is not None:
+        try:
+            os.close(_lock_fd)  # drops the flock
+            LOCKFILE.unlink()
+        except OSError:
+            pass
+        _lock_fd = None
+
+
+def next_capture_path() -> pathlib.Path:
+    """Concurrent-writer-safe: re-scan indices at call time across ALL
+    round prefixes (r4 leftovers included) and claim the next slot with
+    O_EXCL so two scanners can never agree on the same file."""
+    while True:
+        indices = [0]
+        for f in CAPDIR.glob("r?_watch_capture_*.json"):
+            try:
+                indices.append(int(f.stem.rsplit("_", 1)[1]))
+            except ValueError:
+                continue
+        n = max(indices) + 1
+        path = CAPDIR / f"{ROUND}_watch_capture_{n:03d}.json"
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            return path
+        except FileExistsError:
+            continue  # concurrent writer claimed n — rescan
+
+
+def probe(runner=subprocess.run) -> bool:
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # a cpu override would fail the assert
     try:
-        r = subprocess.run(
+        r = runner(
             [sys.executable, "-c", PROBE_SRC],
             capture_output=True, text=True, timeout=PROBE_TIMEOUT, env=env,
         )
@@ -50,11 +117,55 @@ def probe() -> bool:
     return r.returncode == 0 and "PROBE_OK" in r.stdout
 
 
-def run_capture(n: int) -> bool:
+def extract_json_line(stdout: str):
+    """Last {...} line of bench.py output, parsed; None if absent/bad."""
+    for cand in reversed(stdout.strip().splitlines()):
+        cand = cand.strip()
+        if cand.startswith("{") and cand.endswith("}"):
+            try:
+                return json.loads(cand)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def save_and_commit(payload: dict, runner=subprocess.run) -> bool:
+    """Persist one bench payload; on TPU provenance also refresh
+    BENCH_r05.json at the repo root and git-commit both.  Returns
+    whether the capture was TPU-green."""
+    line = json.dumps(payload)
+    out = next_capture_path()
+    tmp = out.with_suffix(".tmp")
+    tmp.write_text(line + "\n")
+    os.replace(tmp, out)  # atomic: readers never see a partial file
+    backend = (payload.get("extras") or {}).get("backend")
+    log(f"capture saved to {out.name} backend={backend} "
+        f"value={payload.get('value')} vs_baseline={payload.get('vs_baseline')}")
+    if backend != "tpu":
+        return False
+    bench_artifact = REPO / "BENCH_r05.json"
+    btmp = bench_artifact.with_suffix(".json.tmp")
+    btmp.write_text(line + "\n")
+    os.replace(btmp, bench_artifact)
+    extras = payload.get("extras") or {}
+    msg = (f"{ROUND} on-chip capture: {payload.get('value')} tokens/s, "
+           f"mfu {extras.get('mfu')}, bert_mfu {extras.get('bert_mfu')}")
+    runner(["git", "-C", str(REPO), "add", str(out), str(bench_artifact)],
+           capture_output=True, text=True)
+    r2 = runner(
+        ["git", "-C", str(REPO), "commit", "-m", msg,
+         "-m", "No-Verification-Needed: committing a measurement "
+               "artifact, no source change"],
+        capture_output=True, text=True)
+    log(f"git commit rc={r2.returncode}: {(r2.stdout or r2.stderr)[-160:]}")
+    return True
+
+
+def run_capture(runner=subprocess.run) -> bool:
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # bench manages its own backend choice
     try:
-        r = subprocess.run(
+        r = runner(
             [sys.executable, str(REPO / "bench.py")],
             capture_output=True, text=True, timeout=BENCH_TIMEOUT, env=env,
             cwd=str(REPO),
@@ -62,88 +173,69 @@ def run_capture(n: int) -> bool:
     except subprocess.TimeoutExpired:
         log("bench.py timed out")
         return False
-    line = None
-    for cand in reversed(r.stdout.strip().splitlines()):
-        cand = cand.strip()
-        if cand.startswith("{") and cand.endswith("}"):
-            line = cand
-            break
-    if line is None:
+    payload = extract_json_line(r.stdout)
+    if payload is None:
         log(f"no JSON line (rc={r.returncode}); stderr tail: {r.stderr[-400:]}")
         return False
+    return save_and_commit(payload, runner=runner)
+
+
+def run_bert_leg(runner=subprocess.run) -> bool:
+    """North-star leg first: BERT phase-1 MFU must survive a short window."""
     try:
-        payload = json.loads(line)
-    except json.JSONDecodeError:
-        log("JSON parse failed")
-        return False
-    backend = (payload.get("extras") or {}).get("backend")
-    out = CAPDIR / f"r4_watch_capture_{n:03d}.json"
-    out.write_text(line + "\n")
-    log(f"capture saved to {out.name} backend={backend} "
-        f"value={payload.get('value')} vs_baseline={payload.get('vs_baseline')}")
-    if backend == "tpu":
-        # commit immediately: the tunnel has died late in every round —
-        # an uncommitted on-chip capture is one session crash from lost
-        extras = payload.get("extras") or {}
-        msg = (f"r4 on-chip capture: {payload.get('value')} tokens/s, "
-               f"mfu {extras.get('mfu')}, bert_mfu {extras.get('bert_mfu')}")
-        r = subprocess.run(["git", "-C", str(REPO), "add", str(out)],
-                           capture_output=True, text=True)
-        r2 = subprocess.run(
-            ["git", "-C", str(REPO), "commit", "-m", msg,
-             "-m", "No-Verification-Needed: committing a measurement "
-                   "artifact, no source change"],
-            capture_output=True, text=True)
-        log(f"git commit rc={r.returncode}/{r2.returncode}: "
-            f"{(r2.stdout or r2.stderr)[-160:]}")
-    return backend == "tpu"
+        r = runner(
+            [sys.executable, str(CAPDIR / "r4_experiments.py"), "--quick"],
+            capture_output=True, text=True, timeout=1000, cwd=str(REPO))
+        log(f"bert leg rc={r.returncode}: "
+            f"{(r.stdout or '').strip().splitlines()[-1:]}")
+        outf = CAPDIR / "r4_experiments_out.json"
+        if outf.exists() and "bert_mfu" in outf.read_text():
+            runner(["git", "-C", str(REPO), "add", str(outf)],
+                   capture_output=True)
+            runner(
+                ["git", "-C", str(REPO), "commit", "-m",
+                 f"{ROUND} on-chip bert leg capture",
+                 "-m", "No-Verification-Needed: measurement "
+                       "artifact, no source change"],
+                capture_output=True)
+            return True
+    except subprocess.TimeoutExpired:
+        log("bert leg timed out")
+    return False
 
 
 def main() -> None:
-    # resume numbering after a restart — never clobber a saved capture
-    # (numeric sort: lexicographic mis-orders once indices pass the pad)
-    indices = sorted(int(f.stem.rsplit("_", 1)[1])
-                     for f in CAPDIR.glob("r4_watch_capture_*.json"))
-    n = indices[-1] if indices else 0
-    log(f"watcher started (next capture index {n + 1})")
+    if not acquire_lock():
+        log(f"another watcher holds {LOCKFILE.name}; exiting")
+        return
+    log(f"watcher started (round {ROUND}, pid {os.getpid()})")
     bert_done = False
-    while True:
-        if probe():
-            if not bert_done:
-                # the north-star leg FIRST: a brief tunnel window must
-                # not be eaten by the 20+ min main-leg compile before
-                # the >=50%-MFU BERT number is captured
-                log("probe OK — running quick bert leg first")
-                try:
-                    r = subprocess.run(
-                        [sys.executable,
-                         str(CAPDIR / "r4_experiments.py"), "--quick"],
-                        capture_output=True, text=True, timeout=1000,
-                        cwd=str(REPO))
-                    log(f"bert leg rc={r.returncode}: "
-                        f"{(r.stdout or '').strip().splitlines()[-1:]}"
-                    )
-                    outf = CAPDIR / "r4_experiments_out.json"
-                    if outf.exists() and "bert_mfu" in outf.read_text():
-                        bert_done = True
-                        subprocess.run(["git", "-C", str(REPO), "add",
-                                        str(outf)], capture_output=True)
-                        subprocess.run(
-                            ["git", "-C", str(REPO), "commit", "-m",
-                             "r4 on-chip bert leg capture",
-                             "-m", "No-Verification-Needed: measurement "
-                                   "artifact, no source change"],
-                            capture_output=True)
-                except subprocess.TimeoutExpired:
-                    log("bert leg timed out")
-            log("running full bench capture")
-            n += 1
-            ok = run_capture(n)
-            log(f"capture {'TPU-green' if ok else 'degraded'}; sleeping 1200s")
-            time.sleep(1200)
-        else:
-            log("probe failed (tunnel dead/wedged)")
-            time.sleep(PROBE_INTERVAL)
+    try:
+        while True:
+            # one bad iteration (ENOSPC, git hiccup, transient OSError)
+            # must not end the vigil — the whole point is to survive
+            # unattended until the tunnel comes back
+            try:
+                if probe():
+                    if not bert_done:
+                        log("probe OK — running quick bert leg first")
+                        bert_done = run_bert_leg()
+                    log("running full bench capture")
+                    ok = run_capture()
+                    log(f"capture {'TPU-green' if ok else 'degraded'}; "
+                        "sleeping 1200s")
+                    time.sleep(1200)
+                else:
+                    log("probe failed (tunnel dead/wedged)")
+                    time.sleep(PROBE_INTERVAL)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001
+                log(f"iteration error ({type(e).__name__}: {e}); "
+                    "sleeping and continuing")
+                time.sleep(PROBE_INTERVAL)
+    finally:
+        release_lock()
 
 
 if __name__ == "__main__":
