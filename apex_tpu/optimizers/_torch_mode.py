@@ -24,6 +24,7 @@ internal master logic engages only for bare-fp16 usage.
 from __future__ import annotations
 
 import math
+from itertools import chain
 
 import torch
 
@@ -56,15 +57,42 @@ class _TorchFusedBase(torch.optim.Optimizer):
         if master is not p:
             p.data.copy_(master.to(p.dtype))
 
+    _FP32_STATE_KEYS = ("master", "exp_avg", "exp_avg_sq",
+                        "momentum_buffer", "sum")
+
     def load_state_dict(self, state_dict):
         """torch's base casts floating state to each param's dtype on
         load — for half params that would silently demote the fp32
-        master (and moments) to bf16/fp16, losing exactly the precision
-        the master exists to keep.  Restore fp32 after the cast."""
+        master (and moments) to bf16/fp16, losing exactly the mantissa
+        the master exists to keep, BEFORE any after-the-fact upcast
+        could recover it.  So: snapshot the fp32 tensors from the
+        INCOMING state_dict (keyed by its param indices), let the base
+        do its load/remap, then reassign the saved values through the
+        same saved-index → live-param mapping the base used."""
+        saved = {
+            idx: {k: v.detach().clone()
+                  for k, v in st.items()
+                  if k in self._FP32_STATE_KEYS and torch.is_tensor(v)
+                  and v.is_floating_point() and v.dtype == torch.float32}
+            for idx, st in state_dict["state"].items()
+        }
         super().load_state_dict(state_dict)
+        saved_ids = chain.from_iterable(
+            g["params"] for g in state_dict["param_groups"])
+        live = chain.from_iterable(
+            g["params"] for g in self.param_groups)
+        id_map = dict(zip(saved_ids, live))
+        for idx, tensors in saved.items():
+            p = id_map.get(idx)
+            if p is None or p not in self.state:
+                continue
+            st = self.state[p]
+            for k, v in tensors.items():
+                st[k] = v.to(device=p.device, dtype=torch.float32)
+        # checkpoints written already-demoted (no fp32 copy to restore)
+        # still get the dtype recovered so subsequent math runs in fp32
         for st in self.state.values():
-            for k in ("master", "exp_avg", "exp_avg_sq",
-                      "momentum_buffer", "sum"):
+            for k in self._FP32_STATE_KEYS:
                 if k in st and torch.is_tensor(st[k]) \
                         and st[k].dtype != torch.float32:
                     st[k] = st[k].float()
@@ -274,9 +302,10 @@ class FusedNovoGradTorch(_TorchFusedBase):
 class FusedLAMBTorch(_TorchFusedBase):
     """Reference: ``apex/optimizers/fused_lamb.py :: FusedLAMB`` — the
     same two-phase math as the JAX class (``fused_lamb.py ::
-    _lamb_step``), kept numerically interchangeable with it: per-GROUP
-    grad-norm clip, Adam-style direction with decoupled decay folded
-    into the update (always — see the scope notes in ``fused_lamb.py``),
+    _lamb_step``): GLOBAL grad-norm clip across all param groups (the
+    reference's scope — the BERT decay/no-decay two-group flow depends
+    on it), Adam-style direction with decoupled decay folded into the
+    update (always — see the scope notes in ``fused_lamb.py``),
     per-tensor trust ratio ``|w|/|u|`` (skipped for zero norms unless
     ``use_nvlamb``)."""
 
@@ -298,16 +327,19 @@ class FusedLAMBTorch(_TorchFusedBase):
     @torch.no_grad()
     def step(self, closure=None, grad_scale=1.0):
         loss = closure() if closure is not None else None
+        # GLOBAL grad-norm clip across ALL param groups — the reference
+        # FusedLAMB's scope (one multi_tensor_l2norm over every grad),
+        # and the one the BERT decay/no-decay two-group flow depends on.
+        # (The JAX flat-buffer class clips per _step_group; its scope
+        # note lives in fused_lamb.py.)
+        sq = 0.0
         for group in self.param_groups:
-            # PER-GROUP grad-norm clip, matching the JAX class (each
-            # _step_group clips by its own flat buffer's norm); note in
-            # fused_lamb.py on the multi-group clip scope
-            sq = 0.0
             for p in group["params"]:
                 if p.grad is not None:
                     g = p.grad.float()
                     sq += float(torch.sum(g * g)) * (grad_scale ** 2)
-            gnorm = math.sqrt(sq)
+        gnorm = math.sqrt(sq)
+        for group in self.param_groups:
             beta1, beta2 = group["betas"]
             lr, eps, wd = group["lr"], group["eps"], group["weight_decay"]
             max_gn = group["max_grad_norm"]

@@ -197,6 +197,38 @@ def test_fused_lamb_global_norm_clip_matches_jax():
                                    rtol=2e-5, atol=2e-6)
 
 
+def test_fused_lamb_torch_clips_by_global_norm_across_groups():
+    """The reference FusedLAMB computes ONE grad norm across ALL param
+    groups (the BERT decay/no-decay split depends on it).  With identical
+    hyperparams, a two-group construction must therefore update each
+    param exactly as the single-group construction does; a per-group
+    clip would scale the two groups differently."""
+    rng = np.random.default_rng(3)
+    shapes = [(12, 4), (4,), (4, 6), (6,)]
+    params_np = [rng.normal(size=s).astype(np.float32) for s in shapes]
+    # big grads so the clip actually engages, asymmetric between groups
+    grads_np = [rng.normal(size=s).astype(np.float32) * (9.0 if i < 2 else 0.3)
+                for i, s in enumerate(shapes)]
+
+    def run(groups):
+        tparams = [torch.nn.Parameter(torch.tensor(p)) for p in params_np]
+        for p, g in zip(tparams, grads_np):
+            p.grad = torch.tensor(g)
+        if groups == 1:
+            opt = FusedLAMB(tparams, lr=1e-2, weight_decay=0.01,
+                            max_grad_norm=1.0)
+        else:
+            opt = FusedLAMB([{"params": tparams[:2]},
+                             {"params": tparams[2:]}],
+                            lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+        opt.step()
+        return [p.detach().numpy() for p in tparams]
+
+    one, two = run(1), run(2)
+    for a, b in zip(one, two):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_fused_lamb_grad_averaging_false_matches_jax():
     """grad_averaging=False (m += g, not (1-b1)*g) must take effect on
     BOTH entry points — the jax path silently dropped the flag pre-r4."""
@@ -244,10 +276,17 @@ def test_load_state_dict_keeps_fp32_master():
     opt2.step()
     opt2.load_state_dict(sd)
     st = opt2.state[p2]
-    # torch's load casts floating state to the param dtype (bf16);
-    # the override must restore fp32 for master and moments
+    src = opt.state[p]
+    # torch's load casts floating state to the param dtype (bf16) BEFORE
+    # any override runs; the override must restore the VALUES from the
+    # incoming state_dict, not just upcast the demoted tensors — a
+    # dtype-only restore would leave master == bf16-rounded master
     for k in ("master", "exp_avg", "exp_avg_sq"):
         assert st[k].dtype == torch.float32, k
+        assert torch.equal(st[k], src[k]), k
+    assert not torch.equal(st["master"],
+                           st["master"].bfloat16().float()) \
+        or torch.equal(src["master"], src["master"].bfloat16().float())
 
 
 def test_adagrad_sum_stays_fp32_after_load():
