@@ -36,8 +36,13 @@ def test_degraded_capture_parses_and_carries_history():
                                         "mfu": 0.001}}])
     assert out["extras"]["backend"] == "cpu"
     assert "probe err" in out["error"]
-    # history is loaded from the newest committed on-chip capture file
-    hist = out["extras"]["last_recorded_tpu_capture"]
+    # a reader parsing ONLY top-level fields must see the provenance and
+    # the recorded on-chip vs_baseline (r4 verdict weak #1)
+    assert out["value_provenance"].startswith("cpu-degraded")
+    assert out["vs_baseline_tpu_best_recorded"] > 1.0
+    # history is loaded from committed on-chip capture files, with the
+    # selection policy in the label (best ≠ "last" — advisor r4)
+    hist = out["extras"]["recorded_tpu_captures"]["best"]
     assert hist["value_tokens_per_s"] > 0
     assert set(hist) >= {"source", "vs_baseline", "mfu"}
     assert hist["source"].startswith("bench_captures/")
@@ -48,10 +53,18 @@ def test_degraded_capture_parses_and_carries_history():
         assert k not in out["extras"]
 
 
-def test_history_loader_prefers_newest_tpu_capture():
-    hist = bench._load_last_tpu_capture()
+def test_history_loader_returns_best_and_newest():
+    hist = bench._load_tpu_capture_history()
     assert hist is not None
-    assert hist["value_tokens_per_s"] > 0 and hist["mfu"] > 0
+    best = hist["best"]
+    assert best["value_tokens_per_s"] > 0 and best["mfu"] > 0
+    # "newest" present only when it differs from "best"; when present it
+    # must be no older and no faster than best
+    if "newest" in hist:
+        newest = hist["newest"]
+        assert newest["source"] != best["source"]
+        assert newest["value_tokens_per_s"] <= best["value_tokens_per_s"]
+        assert newest["date"] >= best["date"]
 
 
 def test_healthy_capture_untouched():
@@ -59,11 +72,14 @@ def test_healthy_capture_untouched():
                             "vs_baseline": 1.4,
                             "extras": {"backend": "tpu"}}])
     assert out["value"] == 2.0
+    assert out["value_provenance"] == "tpu"
     assert "error" not in out
-    assert "last_recorded_tpu_capture" not in out["extras"]
+    assert "recorded_tpu_captures" not in out["extras"]
+    assert "vs_baseline_tpu_best_recorded" not in out
 
 
 def test_total_failure_still_emits_json():
     out = _run_main(False, [None])
     assert out["value"] is None
+    assert out["value_provenance"].startswith("none")
     assert "probe err" in out["error"]
