@@ -24,10 +24,25 @@ from apex_tpu.ops.attention import flash_attention, mha_reference
 __all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]
 
 
-def _core(q, k, v, mask, impl):
+def _core(q, k, v, mask, impl, dropout=0.0, seed=None):
+    """Attention core with reference-parity dropout placement: the
+    probabilities are dropped (``fast_multihead_attn``'s in-kernel
+    philox softmax+dropout fusion — here the Pallas kernel's counter
+    hash), NOT the context output.  The two impls draw different masks
+    (kernel blocks vs one full-matrix block), matching the reference,
+    where the 'default' impl uses torch's own RNG."""
     if impl == "fast":
-        return flash_attention(q, k, v, mask=mask)
-    return mha_reference(q, k, v, mask=mask)
+        return flash_attention(q, k, v, mask=mask, dropout_rate=dropout,
+                               dropout_seed=seed)
+    return mha_reference(q, k, v, mask=mask, dropout_rate=dropout,
+                         dropout_seed=seed)
+
+
+def _dropout_seed(mod, dropout):
+    if not dropout:
+        return None
+    return jax.random.bits(mod.make_rng("dropout"),
+                           dtype=jnp.uint32).astype(jnp.int32)
 
 
 class SelfMultiheadAttn(nn.Module):
@@ -68,9 +83,9 @@ class SelfMultiheadAttn(nn.Module):
         elif attn_mask is not None:
             mask = jnp.broadcast_to(attn_mask.astype(bool)[None, None],
                                     (1, 1, s, s))
-        ctx = _core(q, k, v, mask, self.impl)
-        if is_training and self.dropout > 0.0:
-            ctx = nn.Dropout(self.dropout)(ctx, deterministic=False)
+        drop = self.dropout if (is_training and self.dropout > 0.0) else 0.0
+        ctx = _core(q, k, v, mask, self.impl, drop,
+                    _dropout_seed(self, drop))
         out = ctx.transpose(2, 0, 1, 3).reshape(s, b, h)
         out = nn.Dense(h, use_bias=self.bias,
                        param_dtype=self.params_dtype,
@@ -119,9 +134,9 @@ class EncdecMultiheadAttn(nn.Module):
         elif attn_mask is not None:
             mask = jnp.broadcast_to(attn_mask.astype(bool)[None, None],
                                     (1, 1, sq, sk))
-        ctx = _core(q, k, v, mask, self.impl)
-        if is_training and self.dropout > 0.0:
-            ctx = nn.Dropout(self.dropout)(ctx, deterministic=False)
+        drop = self.dropout if (is_training and self.dropout > 0.0) else 0.0
+        ctx = _core(q, k, v, mask, self.impl, drop,
+                    _dropout_seed(self, drop))
         out = ctx.transpose(2, 0, 1, 3).reshape(sq, b, h)
         out = nn.Dense(h, use_bias=self.bias,
                        param_dtype=self.params_dtype,

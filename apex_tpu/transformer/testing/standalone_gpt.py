@@ -159,6 +159,9 @@ class ParallelAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         # [s, b, n, d] -> [b, n, s, d]
         q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        attn_dropout = (cfg.attention_dropout
+                        if not deterministic and cfg.attention_dropout > 0.0
+                        else 0.0)
         if cfg.context_parallel and _cp() > 1:
             # sequence sharded over the context axis: exact attention via
             # the K/V ring (apex_tpu.ops.ring_attention); padding masks
@@ -167,15 +170,27 @@ class ParallelAttention(nn.Module):
                 "context_parallel supports causal masking only"
             from apex_tpu.ops.ring_attention import ring_attention
             ctx = ring_attention(q, k, v, causal=self.causal)
+            if attn_dropout:
+                # the ring merge has no in-kernel prob-dropout; dropping
+                # the context output is a DIFFERENT regularizer (drops
+                # features, not attention weights) — documented deviation,
+                # MIGRATION.md "attention dropout under context parallel"
+                ctx = nn.Dropout(attn_dropout)(ctx, deterministic=False)
+        elif attn_dropout:
+            # reference parity: dropout on the softmax PROBABILITIES
+            # inside the kernel (philox-style counter stream, see
+            # ops/attention.py); the tracker-seeded per-rank rng keeps
+            # TP ranks decorrelated, and the counter hash keeps the
+            # recompute-for-backward mask identical
+            seed = jax.random.bits(
+                self.make_rng("dropout"), dtype=jnp.uint32).astype(jnp.int32)
+            ctx = flash_attention(q, k, v, causal=self.causal,
+                                  mask=attention_mask,
+                                  dropout_rate=attn_dropout,
+                                  dropout_seed=seed)
         else:
             ctx = flash_attention(q, k, v, causal=self.causal,
                                   mask=attention_mask)
-        if not deterministic and cfg.attention_dropout > 0.0:
-            # reference applies dropout on probs inside the kernel; the
-            # flash path applies it on the context (same expectation), the
-            # tracker-seeded rng keeps TP ranks decorrelated
-            ctx = nn.Dropout(cfg.attention_dropout)(
-                ctx, deterministic=False)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)   # [s, b, h/tp]
         out, _ = RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True,

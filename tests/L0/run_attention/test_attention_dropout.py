@@ -1,0 +1,173 @@
+"""In-kernel attention-probability dropout (reference parity:
+``apex/contrib/csrc/multihead_attn/philox.h`` — the CUDA kernels drop
+softmax *probabilities* inside the fused kernel and regenerate the same
+mask in the backward from a counter-based stream).
+
+The TPU kernels use a keyed counter hash over global (bh, row, col)
+coordinates (pure int32 ops — identical bits in CPU interpret mode and
+on chip), so these tests cover the exact mask generation the chip runs.
+``mha_reference`` draws the same mask on the materialized probability
+matrix, giving a bit-matched oracle (block-independent: the mask is a
+pure function of global coordinates, so every kernel blocking agrees).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.attention import (flash_attention, mha_reference,
+                                    _FUSED_BWD_MAX_BYTES)
+import apex_tpu.ops.attention as attention_mod
+
+B, H, S, D = 1, 2, 256, 64
+BLOCKS = dict(block_q=128, block_k=128)
+RATE, SEED = 0.15, 1234
+
+
+def _qkv(key=0, s=S):
+    return jax.random.normal(jax.random.PRNGKey(key), (3, B, H, s, D),
+                             jnp.float32)
+
+
+def _oracle(q, k, v, **kw):
+    return mha_reference(q, k, v, dropout_rate=RATE, dropout_seed=SEED,
+                         **kw)
+
+
+def _kernel(q, k, v, **kw):
+    return flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=SEED,
+                           **BLOCKS, **kw)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_masked_oracle(causal):
+    q, k, v = _qkv()
+    out = _kernel(q, k, v, causal=causal)
+    ref = _oracle(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-6)
+
+
+def test_backward_regenerates_identical_mask():
+    """All three grads must equal the oracle's — only possible if every
+    backward kernel redraws the exact forward mask."""
+    q, k, v = _qkv(1)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+    gk = jax.grad(loss(_kernel), argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss(_oracle), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gk, go):
+        np.testing.assert_allclose(a, b, atol=5e-6, err_msg=f"d{name}")
+
+
+def test_split_backward_matches_fused(monkeypatch):
+    """The split dq/dkv kernels draw the same mask as the fused one-pass
+    backward (both derive it from (seed, bh, qi, ki), not grid order)."""
+    q, k, v = _qkv(2)
+
+    def g(q, k, v):
+        return jnp.sum(jnp.sin(_kernel(q, k, v, causal=True)))
+
+    fused = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(attention_mod, "_FUSED_BWD_MAX_BYTES", 0)
+    split = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", fused, split):
+        np.testing.assert_allclose(a, b, atol=1e-5, err_msg=f"d{name}")
+
+
+def test_block_independent_and_large_bh():
+    """The mask depends on global coordinates only: different kernel
+    blockings agree bit-for-bit, and bh >= 3 works (a python-int bh
+    once overflowed int32 in the oracle's hash)."""
+    q, k, v = jax.random.normal(jax.random.PRNGKey(9), (3, 2, 3, 256, 64),
+                                jnp.float32)
+    a = flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=SEED,
+                        block_q=256, block_k=256)
+    b = flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=SEED,
+                        block_q=128, block_k=128)
+    ref = mha_reference(q, k, v, dropout_rate=RATE, dropout_seed=SEED)
+    # same mask, different online-softmax accumulation order: agreement
+    # is float-rounding-tight, not bitwise (a dropped entry differing
+    # between blockings would show up as O(p/keep) ≈ 1e-2, not 1e-6)
+    np.testing.assert_allclose(a, b, atol=2e-6)
+    np.testing.assert_allclose(a, ref, atol=2e-6)
+    np.testing.assert_allclose(b, ref, atol=2e-6)
+
+
+def test_deterministic_and_seed_sensitive():
+    q, k, v = _qkv(3)
+    a = _kernel(q, k, v)
+    b = _kernel(q, k, v)
+    c = flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=SEED + 1,
+                        **BLOCKS)
+    assert bool(jnp.all(a == b))
+    assert bool(jnp.any(a != c))
+
+
+def test_rate_zero_identical_to_no_dropout():
+    q, k, v = _qkv(4)
+    a = flash_attention(q, k, v, **BLOCKS)
+    b = flash_attention(q, k, v, dropout_rate=0.0, **BLOCKS)
+    assert bool(jnp.all(a == b))
+
+
+def test_drop_fraction_and_rescale():
+    """v = I recovers the dropped probability matrix directly (its first
+    D of S columns): entries are either 0 or clean-p/(1-rate); the zero
+    fraction tracks rate."""
+    s = 128
+    q, k, _ = _qkv(5, s=s)
+    v = jnp.broadcast_to(jnp.eye(s, D, dtype=jnp.float32), (B, H, s, D))
+    pd = flash_attention(q, k, v, dropout_rate=RATE, dropout_seed=SEED,
+                         **BLOCKS)
+    p_clean = flash_attention(q, k, v, **BLOCKS)
+    pd, p_clean = np.asarray(pd), np.asarray(p_clean)
+    dropped = pd == 0.0
+    frac = dropped.mean()
+    assert abs(frac - RATE) < 0.02, frac
+    np.testing.assert_allclose(pd[~dropped],
+                               p_clean[~dropped] / (1.0 - RATE), rtol=1e-4)
+
+
+def test_seed_required_and_rate_validated():
+    q, k, v = _qkv(6)
+    with pytest.raises(ValueError, match="dropout_seed"):
+        flash_attention(q, k, v, dropout_rate=0.1)
+    with pytest.raises(ValueError, match="dropout_rate"):
+        flash_attention(q, k, v, dropout_rate=1.0, dropout_seed=0)
+
+
+def test_traced_seed_no_retrace():
+    """The seed is a traced operand: stepping it inside jit must reuse
+    the compiled kernel (one trace) and still change the mask."""
+    q, k, v = _qkv(7)
+    traces = []
+
+    @jax.jit
+    def f(q, k, v, seed):
+        traces.append(1)
+        return flash_attention(q, k, v, dropout_rate=RATE,
+                               dropout_seed=seed, **BLOCKS)
+
+    a = f(q, k, v, jnp.int32(1))
+    b = f(q, k, v, jnp.int32(2))
+    assert len(traces) == 1
+    assert bool(jnp.any(a != b))
+
+
+def test_padded_shape_with_dropout():
+    """Non-lane-multiple sequence: padding + validity window + dropout
+    compose; grads stay finite and zero in the padded region."""
+    s = 200                      # pads to 256
+    q, k, v = _qkv(8, s=s)
+
+    def g(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       dropout_rate=RATE,
+                                       dropout_seed=SEED))
+
+    val, grads = jax.value_and_grad(g, argnums=(0, 1, 2))(q, k, v)
+    assert np.isfinite(float(val))
+    for a in grads:
+        assert bool(jnp.all(jnp.isfinite(a)))
