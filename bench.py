@@ -46,6 +46,7 @@ Timing notes: the axon TPU tunnel has ~60-70 ms dispatch RTT and its
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -82,6 +83,30 @@ def _chip_spec():
         if key in kind:
             return spec
     return _CHIP_SPECS["v5e"]
+
+
+# experiment knobs settable from the CLI without editing leg code
+# (``--override batch=16 --override block_q=512``): the on-chip tuning
+# sweeps drive the REAL bench legs instead of duplicating their setup
+# as templated source (r4 verdict weak #7).  Values are parsed int ->
+# float -> str; legs opt in via _ov(name, default).
+_OVERRIDES: dict = {}
+
+
+def _ov(name, default):
+    v = _OVERRIDES.get(name)
+    return default if v is None else v
+
+
+def _parse_override(kv: str) -> None:
+    k, _, v = kv.partition("=")
+    for cast in (int, float):
+        try:
+            _OVERRIDES[k] = cast(v)
+            return
+        except ValueError:
+            continue
+    _OVERRIDES[k] = v
 
 
 def _retry(fn, *args, tries: int = 4, tag: str = ""):
@@ -272,12 +297,18 @@ def _microbench_attention(rtt: float, on_tpu: bool):
     """Flash attention fwd+bwd vs materialized-softmax oracle."""
     from apex_tpu.ops.attention import flash_attention, mha_reference
 
-    b, h, s, d = (4, 16, 2048, 64) if on_tpu else (1, 2, 128, 32)
+    b, h, s, d = ((_ov("batch", 4), 16, _ov("seq", 2048), 64) if on_tpu
+                  else (1, 2, 128, 32))
     qkey, kkey, vkey = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(qkey, (b, h, s, d), jnp.bfloat16)
     k = jax.random.normal(kkey, (b, h, s, d), jnp.bfloat16)
     v = jax.random.normal(vkey, (b, h, s, d), jnp.bfloat16)
     iters = 10 if on_tpu else 2
+    bq, bk = _ov("block_q", None), _ov("block_k", None)
+    if bq or bk:
+        fused = functools.partial(flash_attention, block_q=bq, block_k=bk)
+    else:
+        fused = flash_attention
 
     def fb(attn):
         def run(q, k, v):
@@ -287,12 +318,15 @@ def _microbench_attention(rtt: float, on_tpu: bool):
             return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
         return run
 
-    t_flash = _bench_fn(fb(flash_attention), (q, k, v), iters, rtt)
+    t_flash = _bench_fn(fb(fused), (q, k, v), iters, rtt)
     t_ref = _bench_fn(fb(mha_reference), (q, k, v), iters, rtt)
-    return {"flash_attn_us": round(t_flash.best * 1e6, 1),
-            "flash_attn_us_median": round(t_flash.median * 1e6, 1),
-            "flash_attn_speedup": round(t_ref.best / t_flash.best, 3),
-            "flash_attn_shape": [b, h, s, d]}
+    out = {"flash_attn_us": round(t_flash.best * 1e6, 1),
+           "flash_attn_us_median": round(t_flash.median * 1e6, 1),
+           "flash_attn_speedup": round(t_ref.best / t_flash.best, 3),
+           "flash_attn_shape": [b, h, s, d]}
+    if bq or bk:
+        out["flash_attn_blocks"] = [bq, bk]
+    return out
 
 
 def _microbench_xentropy(rtt: float, on_tpu: bool):
@@ -355,6 +389,8 @@ def _microbench_moe(rtt: float, on_tpu: bool):
     tokens, h, ffn, k = ((8192, 1024, 4096, 2) if on_tpu
                          else (256, 64, 128, 2))
     sweep = (8, 32, 64) if on_tpu else (4, 8)
+    if _ov("experts", None):        # e.g. --override experts=8;32;64
+        sweep = tuple(int(e) for e in str(_ov("experts", "")).split(";"))
     x = jax.random.normal(jax.random.PRNGKey(0), (tokens, h), jnp.bfloat16)
 
     def run_one(e, iters, mode="onehot"):
@@ -424,7 +460,7 @@ def _microbench_bert(rtt: float, on_tpu: bool):
     if on_tpu:
         cfg = BertConfig(max_seq_length=128, hidden_dropout=0.0,
                          attention_dropout=0.0, params_dtype=jnp.bfloat16)
-        batch, seq, iters = 32, 128, 8
+        batch, seq, iters = _ov("batch", 32), 128, _ov("iters", 8)
     else:
         cfg = BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                          num_attention_heads=4, max_seq_length=128,
@@ -503,10 +539,12 @@ def _bench_main(force_cpu: bool = False) -> None:
     # shapes sized for the single dev chip; CPU fallback shrinks
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
-                        num_attention_heads=16, max_seq_length=1024,
+                        num_attention_heads=16,
+                        max_seq_length=_ov("seq", 1024),
                         hidden_dropout=0.0, attention_dropout=0.0,
                         params_dtype=jnp.bfloat16)
-        batch, seq, iters = 8, 1024, 8
+        batch, seq, iters = (_ov("batch", 8), _ov("seq", 1024),
+                             _ov("iters", 8))
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_attention_heads=4, max_seq_length=128,
@@ -599,6 +637,8 @@ def _bench_main(force_cpu: bool = False) -> None:
         "chip": jax.devices()[0].device_kind,
         "backend": "tpu" if on_tpu else "cpu",
     }
+    if _OVERRIDES:
+        extras["overrides"] = dict(_OVERRIDES)   # capture self-describes
     print(json.dumps({
         "metric": "gpt_train_tokens_per_sec_1chip",
         "value": round(value, 1),
@@ -647,9 +687,13 @@ def _run_leg(mode: str, leg: str, timeout: float, key=None):
     key = key or ("metric" if leg == "main" else "_leg")
     timed_out_err = None
     try:
+        # forward any --override knobs so the orchestrator invocation
+        # (`python bench.py --override batch=16`) reaches the inner legs
+        ov_args = [a for kv in sorted(_OVERRIDES.items())
+                   for a in ("--override", f"{kv[0]}={kv[1]}")]
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
-             "--inner", mode, "--leg", leg],
+             "--inner", mode, "--leg", leg, *ov_args],
             capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired as e:
@@ -835,6 +879,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    for i, a in enumerate(sys.argv):
+        if a == "--override":
+            if i + 1 >= len(sys.argv):
+                sys.exit("--override requires a key=value argument")
+            _parse_override(sys.argv[i + 1])
     if "--inner" in sys.argv:
         mode = sys.argv[sys.argv.index("--inner") + 1]
         leg = (sys.argv[sys.argv.index("--leg") + 1]
