@@ -101,7 +101,7 @@ def _rows_can_be_fully_masked(causal, off, masked, valid) -> bool:
     return masked or (valid is not None) or (causal and off < 0)
 
 
-def _keep_mask(seed, bi, qi, ki, bq, bk, rate):
+def _keep_mask(seed, bi, qi, ki, bq, bk, rate, row_off=0, col_off=0):
     """Counter-based keep mask for one (qi, ki) block of batch·head bi.
 
     The philox-equivalent: bits are a pure function of
@@ -109,10 +109,17 @@ def _keep_mask(seed, bi, qi, ki, bq, bk, rate):
     every backward recompute regenerate the identical mask regardless
     of grid order.  murmur3's 32-bit finalizer over the coordinates
     gives well-mixed bits in ~10 int32 VPU ops per element; the top 24
-    bits form the uniform variate (2^-24 rate resolution)."""
+    bits form the uniform variate (2^-24 rate resolution).
+
+    ``row_off``/``col_off`` translate LOCAL kernel coordinates to the
+    GLOBAL sequence position — ring attention sets them per shard pair
+    so a context-sharded run draws the exact mask the unsharded run
+    would (the coordinates, not the blocking, define the stream)."""
     bi = jnp.asarray(bi, jnp.int32)   # python ints would overflow in *_H1
-    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    rows = (row_off + qi * bq
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+    cols = (col_off + ki * bk
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
     h = seed ^ (bi * _H1) ^ (rows * _H2) ^ (cols * _H3)
     h = h ^ jax.lax.shift_right_logical(h, 16)
     h = h * _H2
@@ -245,7 +252,8 @@ def _fwd_kernel(causal, off, scale, bq, bk, nk, masked, valid, rate,
         # dropout(softmax(s)) @ V exactly
         pv = p
         if rate:
-            keep = _keep_mask(seed_ref[0], bi, qi, ki, bq, bk, rate)
+            keep = _keep_mask(seed_ref[0], bi, qi, ki, bq, bk, rate,
+                              seed_ref[1], seed_ref[2])
             pv = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - rate))
         # p rounds to the input dtype for the MXU pass (the standard
         # flash-on-TPU precision: probabilities in [0,1] lose ~3 decimal
@@ -342,7 +350,8 @@ def _dropped_dp(rate, seed_ref, bi, qi, ki, bq, bk, p, dp):
     so the saved-residual contract is unchanged)."""
     if not rate:
         return p, dp
-    keep = _keep_mask(seed_ref[0], bi, qi, ki, bq, bk, rate)
+    keep = _keep_mask(seed_ref[0], bi, qi, ki, bq, bk, rate,
+                      seed_ref[1], seed_ref[2])
     inv = 1.0 / (1.0 - rate)
     return jnp.where(keep, p, 0.0) * inv, jnp.where(keep, dp * inv, 0.0)
 
@@ -634,6 +643,15 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
 # public entry: custom VJP over the kernel pair, oracle fallback for odd shapes
 # --------------------------------------------------------------------------
 
+def _seed_operand(seed, row_off=0, col_off=0):
+    """SMEM dropout operand: [seed, global row offset, global col
+    offset].  Offsets are 0 for unsharded attention; ring attention sets
+    them per shard pair (see _keep_mask)."""
+    return jnp.stack([jnp.asarray(seed, jnp.int32),
+                      jnp.asarray(row_off, jnp.int32),
+                      jnp.asarray(col_off, jnp.int32)])
+
+
 def _fit_block(s: int, preferred: int):
     """Largest block <= preferred that divides s and is a lane multiple
     (or s itself when s < 128); None -> needs padding."""
@@ -693,7 +711,7 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                 "dropout_rate > 0 requires dropout_seed (reusing an "
                 "implicit constant seed would repeat the same mask "
                 "every training step)")
-        seed3 = jnp.reshape(jnp.asarray(dropout_seed, jnp.int32), (1,))
+        seed3 = _seed_operand(dropout_seed)
     # default 1024x1024 blocks: measured ~21% faster fwd+bwd than
     # 512x512 at [*, 16, 1024-2048, 64] on v5e (fewer online-softmax
     # rescale rounds, larger MXU feeds).  Verified to fit scoped VMEM

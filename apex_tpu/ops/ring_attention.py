@@ -31,24 +31,32 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import _bwd_impl, _fwd, _fit_block, mha_reference
+from apex_tpu.ops.attention import (_bwd_impl, _fwd, _fit_block,
+                                    _seed_operand, mha_reference)
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
 
 __all__ = ["ring_attention", "ring_attention_reference"]
 
 
 def ring_attention_reference(q, k, v, *, causal=False,
-                             sm_scale: Optional[float] = None):
-    """Oracle: plain attention on the FULL (already gathered) sequence."""
-    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+                             sm_scale: Optional[float] = None,
+                             dropout_rate: float = 0.0,
+                             dropout_seed=None):
+    """Oracle: plain attention on the FULL (already gathered) sequence.
+
+    With dropout, this draws the same global-coordinate mask the
+    sharded ring draws — sharded-vs-dense stays an exact comparison."""
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                         dropout_rate=dropout_rate,
+                         dropout_seed=dropout_seed)
 
 
-def _local_flash(q3, k3, v3, causal, scale, bq, bk):
+def _local_flash(q3, k3, v3, causal, scale, bq, bk, rate=0.0, seed3=None):
     """One shard-pair partial: (out [bh,s,d] fp32, lse [bh,s]) — partials
     stay fp32 so the cp-step ring accumulation doesn't round through the
     input dtype at every merge."""
     return _fwd(q3, k3, v3, None, causal, scale, bq, bk,
-                out_dtype=jnp.float32)
+                out_dtype=jnp.float32, rate=rate, seed3=seed3)
 
 
 def _merge(out_a, lse_a, out_b, lse_b):
@@ -67,20 +75,38 @@ def ring_attention(q, k, v, *, causal: bool = False,
                    sm_scale: Optional[float] = None,
                    axis_name: str = CONTEXT_AXIS,
                    block_q: Optional[int] = None,
-                   block_k: Optional[int] = None):
+                   block_k: Optional[int] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_seed=None):
     """Exact attention over a context-sharded sequence.
 
     ``q, k, v``: ``[b, h, s_local, d]`` — this rank's sequence shard (rank
     i holds tokens ``[i*s_local, (i+1)*s_local)``).  Must run inside
     ``shard_map`` binding ``axis_name``; returns the local output shard.
-    """
+
+    ``dropout_rate`` > 0 drops attention probabilities in-kernel at
+    GLOBAL sequence coordinates (each shard pair offsets the counter
+    hash by its global row/col position), so the context-sharded result
+    equals the unsharded ``flash_attention`` / ``mha_reference`` run
+    with the same seed — exactness survives dropout.  The merge algebra
+    still holds because the l/lse statistics accumulate clean p; only
+    the p·V feeds see the dropped probabilities.  ``dropout_seed`` must
+    be IDENTICAL on every cp rank (one global mask, not per-rank
+    streams)."""
     b, h, s_local, d = q.shape
     scale = (d ** -0.5) if sm_scale is None else sm_scale
     cp = jax.lax.axis_size(axis_name) if axis_name else 1
     if cp == 1:
         from apex_tpu.ops.attention import flash_attention
         return flash_attention(q, k, v, causal=causal, sm_scale=scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(
+            f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
 
     # None inherits flash_attention's tuned default (1024 inside its
     # verified VMEM envelope, 512 beyond it)
@@ -110,6 +136,15 @@ def ring_attention(q, k, v, *, causal: bool = False,
         out, _ = _ring_fwd(q3, k3in, v3in)
         return out
 
+    def _drop_seed3(my, t):
+        """Dropout operand for the step-t pair: global row offset is this
+        rank's query origin; global col offset is the HELD shard's origin
+        (source rank (my - t) mod cp)."""
+        if not dropout_rate:
+            return None
+        src = jax.lax.rem(my - t + cp, cp)
+        return _seed_operand(dropout_seed, my * s_local, src * s_local)
+
     def _ring_fwd(q3, k3in, v3in):
         my = jax.lax.axis_index(axis_name)
         out = jnp.zeros((b * h, s_local, d), jnp.float32)
@@ -117,20 +152,22 @@ def ring_attention(q, k, v, *, causal: bool = False,
         kv = (k3in, v3in)
         for t in range(cp):
             k3, v3 = kv
+            s3 = _drop_seed3(my, t)
             if causal and t > 0:
                 # invisible shards: skip the kernel entirely (lax.cond on
                 # the traced rank): no wasted FLOPs, and no exp(s - lse)
                 # overflow from scores the global lse never bounded
                 o_t, l_t = jax.lax.cond(
                     my >= t,
-                    lambda k3=k3, v3=v3: _local_flash(
-                        q3, k3, v3, False, scale, bq, bk),
+                    lambda k3=k3, v3=v3, s3=s3: _local_flash(
+                        q3, k3, v3, False, scale, bq, bk,
+                        dropout_rate, s3),
                     lambda: (jnp.zeros((b * h, s_local, d), jnp.float32),
                              jnp.full((b * h, s_local), -1e30,
                                       jnp.float32)))
             else:
                 o_t, l_t = _local_flash(q3, k3, v3, causal and t == 0,
-                                        scale, bq, bk)
+                                        scale, bq, bk, dropout_rate, s3)
             out, lse = _merge(out, lse, o_t, l_t)
             if t < cp - 1:
                 kv = jax.tree.map(rot, kv)
@@ -157,20 +194,22 @@ def ring_attention(q, k, v, *, causal: bool = False,
                           jnp.zeros_like(v3in, dtype=jnp.float32))
         for t in range(cp):
             k3, v3, dk_acc, dv_acc = kv_dkv
+            s3 = _drop_seed3(my, t)
             if causal and t > 0:
                 # skip invisible pairs (see forward): avoids inf partials
                 # from exp(s - lse) on unbounded scores AND the FLOPs
                 dq_t, dk_t, dv_t = jax.lax.cond(
                     my >= t,
-                    lambda k3=k3, v3=v3: _bwd_impl(
+                    lambda k3=k3, v3=v3, s3=s3: _bwd_impl(
                         q3, k3, v3, None, out, lse, do3, False, scale,
-                        bq, bk, out_dtype=jnp.float32),
+                        bq, bk, out_dtype=jnp.float32,
+                        rate=dropout_rate, seed3=s3),
                     zeros3)
             else:
                 dq_t, dk_t, dv_t = _bwd_impl(
                     q3, k3, v3, None, out, lse, do3,
                     causal and t == 0, scale, bq, bk,
-                    out_dtype=jnp.float32)
+                    out_dtype=jnp.float32, rate=dropout_rate, seed3=s3)
             dq = dq + dq_t
             kv_dkv = (k3, v3, dk_acc + dk_t, dv_acc + dv_t)
             kv_dkv = jax.tree.map(rot, kv_dkv)   # cp rotations total

@@ -41,7 +41,9 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                       sm_scale: Optional[float] = None,
                       axis_name: str = CONTEXT_AXIS,
                       block_q: Optional[int] = None,
-                      block_k: Optional[int] = None):
+                      block_k: Optional[int] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_seed=None):
     """Exact attention over a context-sharded sequence via head/sequence
     all-to-all resharding.
 
@@ -50,7 +52,13 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
     :func:`ring_attention`).  Must run inside ``shard_map`` binding
     ``axis_name``; requires ``h % cp == 0``.  Returns the local output
     shard ``[b, h, s_local, d]``.
-    """
+
+    ``dropout_rate`` > 0 drops attention probabilities in-kernel.  The
+    seed is folded with the rank index, so each rank's head subset draws
+    an independent stream — a valid regularizer with fwd/bwd mask
+    consistency, but NOT bit-matched to an unsharded run (the local
+    head index enters the counter hash; use :func:`ring_attention` when
+    sharded-vs-dense bit parity under dropout matters)."""
     if axis_name is None:
         cp = 1
     else:
@@ -71,7 +79,11 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                 raise
     if cp == 1:
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               dropout_rate=dropout_rate,
+                               dropout_seed=dropout_seed)
+    if dropout_rate and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     b, h, s_local, d = q.shape
     if h % cp != 0:
         raise ValueError(
@@ -83,9 +95,19 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
     qkv = jnp.stack([q, k, v])           # [3, b, h, s/cp, d]
     qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2,
                              concat_axis=3, tiled=True)
+    drop_kw = {}
+    if dropout_rate:
+        from apex_tpu.ops.attention import _H2
+        # rank-decorrelated stream (see docstring): the keep-mask hash's
+        # own odd multiplier keeps distinct ranks' seeds well separated
+        drop_kw = dict(
+            dropout_rate=dropout_rate,
+            dropout_seed=(jnp.asarray(dropout_seed, jnp.int32)
+                          ^ (jax.lax.axis_index(axis_name)
+                             * jnp.int32(_H2))))
     o = flash_attention(qkv[0], qkv[1], qkv[2],
                         causal=causal, sm_scale=sm_scale,
-                        block_q=block_q, block_k=block_k)
+                        block_q=block_q, block_k=block_k, **drop_kw)
     # [b, h/cp, s, d] -> [b, h, s/cp, d]
     return jax.lax.all_to_all(o, axis_name, split_axis=2,
                               concat_axis=1, tiled=True)

@@ -162,6 +162,12 @@ class ParallelAttention(nn.Module):
         attn_dropout = (cfg.attention_dropout
                         if not deterministic and cfg.attention_dropout > 0.0
                         else 0.0)
+        attn_seed = None
+        if attn_dropout:
+            # one make_rng call whether or not CP is active, so the rng
+            # stream stays identical across topologies
+            attn_seed = jax.random.bits(
+                self.make_rng("dropout"), dtype=jnp.uint32).astype(jnp.int32)
         if cfg.context_parallel and _cp() > 1:
             # sequence sharded over the context axis: exact attention via
             # the K/V ring (apex_tpu.ops.ring_attention); padding masks
@@ -169,25 +175,24 @@ class ParallelAttention(nn.Module):
             assert attention_mask is None, \
                 "context_parallel supports causal masking only"
             from apex_tpu.ops.ring_attention import ring_attention
-            ctx = ring_attention(q, k, v, causal=self.causal)
-            if attn_dropout:
-                # the ring merge has no in-kernel prob-dropout; dropping
-                # the context output is a DIFFERENT regularizer (drops
-                # features, not attention weights) — documented deviation,
-                # MIGRATION.md "attention dropout under context parallel"
-                ctx = nn.Dropout(attn_dropout)(ctx, deterministic=False)
+            # in-kernel prob dropout at GLOBAL coordinates: the ring
+            # result equals the unsharded run with the same seed.  The
+            # dropout rng must be CP-UNIFORM (the same key on every cp
+            # rank — the tracker's un-forked key is); the ring hashes
+            # global positions so ranks stay consistent
+            drop_kw = (dict(dropout_rate=attn_dropout,
+                            dropout_seed=attn_seed) if attn_dropout else {})
+            ctx = ring_attention(q, k, v, causal=self.causal, **drop_kw)
         elif attn_dropout:
             # reference parity: dropout on the softmax PROBABILITIES
             # inside the kernel (philox-style counter stream, see
             # ops/attention.py); the tracker-seeded per-rank rng keeps
             # TP ranks decorrelated, and the counter hash keeps the
             # recompute-for-backward mask identical
-            seed = jax.random.bits(
-                self.make_rng("dropout"), dtype=jnp.uint32).astype(jnp.int32)
             ctx = flash_attention(q, k, v, causal=self.causal,
                                   mask=attention_mask,
                                   dropout_rate=attn_dropout,
-                                  dropout_seed=seed)
+                                  dropout_seed=attn_seed)
         else:
             ctx = flash_attention(q, k, v, causal=self.causal,
                                   mask=attention_mask)

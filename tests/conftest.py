@@ -96,6 +96,8 @@ SLOW = {
     "tests/L0/run_transformer/test_fused_rope.py::test_cached_matches_uncached",
     "tests/L0/run_attention/test_ulysses_attention.py::test_grads_match_full_attention",
     "tests/L0/run_attention/test_attention_dropout.py::test_split_backward_matches_fused",
+    "tests/L0/run_attention/test_attention_dropout.py::test_ring_dropout_matches_unsharded",
+    "tests/L0/run_attention/test_attention_dropout.py::test_ulysses_dropout_reproducible_and_finite",
     "tests/L0/run_attention/test_attention_dropout.py::test_backward_regenerates_identical_mask",
     "tests/L0/run_attention/test_attention_dropout.py::test_forward_matches_masked_oracle[False]",
     "tests/L0/run_attention/test_attention_dropout.py::test_deterministic_and_seed_sensitive",
