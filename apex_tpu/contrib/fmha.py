@@ -21,12 +21,23 @@ __all__ = ["FMHAFun", "fmha_packed"]
 
 
 def fmha_packed(qkv, cu_seqlens, max_s: int, *, is_training: bool = True,
-                p_dropout: float = 0.0):
+                p_dropout: float = 0.0, dropout_seed=None):
     """Packed-varlen attention (reference: ``fmhalib.fwd`` signature).
 
     ``qkv``: [total, 3, h, d]; ``cu_seqlens``: [b+1] token offsets.
     Returns [total, h, d] context in the packed layout.
+
+    ``p_dropout`` drops attention probabilities in-kernel during
+    training (the reference kernels' philox softmax+dropout fusion —
+    here the counter-hash stream in ``ops/attention.py``).  JAX has no
+    ambient RNG to pull from, so training-time dropout needs an explicit
+    ``dropout_seed`` (int32; pass a fresh value per step).
     """
+    if p_dropout and is_training and dropout_seed is None:
+        raise ValueError(
+            "fmha_packed: p_dropout > 0 with is_training requires "
+            "dropout_seed (JAX has no implicit philox state to draw "
+            "from; pass a per-step int32 seed)")
     total, three, h, d = qkv.shape
     b = cu_seqlens.shape[0] - 1
     # unpack to dense [b, max_s] with a validity mask
@@ -40,7 +51,9 @@ def fmha_packed(qkv, cu_seqlens, max_s: int, *, is_training: bool = True,
     q, k, v = (dense[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
     mask = jnp.broadcast_to((~valid)[:, None, None, :],
                             (b, 1, max_s, max_s))
-    ctx = flash_attention(q, k, v, mask=mask)                # [b,h,s,d]
+    rate = p_dropout if is_training else 0.0     # eval ignores dropout
+    ctx = flash_attention(q, k, v, mask=mask, dropout_rate=rate,
+                          dropout_seed=dropout_seed)         # [b,h,s,d]
     ctx = ctx.transpose(0, 2, 1, 3)                          # [b,s,h,d]
     # repack: scatter each valid dense token to its packed offset; invalid
     # positions index `total`, which mode="drop" discards
@@ -57,6 +70,7 @@ class FMHAFun:
 
     @staticmethod
     def apply(qkv, cu_seqlens, seqlens, p_dropout, max_s, is_training,
-              zero_tensors=False):
+              zero_tensors=False, dropout_seed=None):
         return fmha_packed(qkv, cu_seqlens, max_s,
-                           is_training=is_training, p_dropout=p_dropout)
+                           is_training=is_training, p_dropout=p_dropout,
+                           dropout_seed=dropout_seed)

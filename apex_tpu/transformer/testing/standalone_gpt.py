@@ -180,9 +180,9 @@ class ParallelAttention(nn.Module):
             # dropout rng must be CP-UNIFORM (the same key on every cp
             # rank — the tracker's un-forked key is); the ring hashes
             # global positions so ranks stay consistent
-            drop_kw = (dict(dropout_rate=attn_dropout,
-                            dropout_seed=attn_seed) if attn_dropout else {})
-            ctx = ring_attention(q, k, v, causal=self.causal, **drop_kw)
+            ctx = ring_attention(q, k, v, causal=self.causal,
+                                 dropout_rate=attn_dropout,
+                                 dropout_seed=attn_seed)
         elif attn_dropout:
             # reference parity: dropout on the softmax PROBABILITIES
             # inside the kernel (philox-style counter stream, see

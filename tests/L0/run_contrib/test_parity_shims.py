@@ -34,6 +34,23 @@ class TestFMHA:
                                        atol=2e-5, rtol=2e-5)
             off += L
 
+    def test_p_dropout_wired_and_needs_seed(self):
+        import pytest
+        h, d = 2, 64
+        cu = jnp.array([0, 96, 224], jnp.int32)
+        qkv = jax.random.normal(jax.random.PRNGKey(0), (224, 3, h, d))
+        with pytest.raises(ValueError, match="dropout_seed"):
+            fmha_packed(qkv, cu, max_s=128, p_dropout=0.1)
+        a = fmha_packed(qkv, cu, max_s=128, p_dropout=0.1, dropout_seed=5)
+        b = fmha_packed(qkv, cu, max_s=128, p_dropout=0.1, dropout_seed=5)
+        c = fmha_packed(qkv, cu, max_s=128)
+        assert bool(jnp.all(a == b))         # deterministic per seed
+        assert bool(jnp.any(a != c))         # dropout actually engaged
+        # eval mode ignores dropout like the reference
+        e = fmha_packed(qkv, cu, max_s=128, p_dropout=0.1,
+                        is_training=False)
+        assert bool(jnp.all(e == c))
+
 
 class TestConvBiasReLU:
     def test_conv_bias_relu(self):
