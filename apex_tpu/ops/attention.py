@@ -81,6 +81,10 @@ _LANES = 128              # TPU lane width; m/l scratch is lane-replicated
 _H1 = 0x9E3779B9 - (1 << 32)
 _H2 = 0x85EBCA6B - (1 << 32)
 _H3 = 0xC2B2AE35 - (1 << 32)
+# seed-fold multiplier for fold_rank_seed — murmur3's c1, deliberately
+# distinct from the coordinate multipliers above so a rank fold can't
+# alias a row/col shift in the pre-finalizer state
+_HF = 0xCC9E2D51 - (1 << 32)
 # lane width for the per-row softmax stats (lse, delta) at the kernel
 # HBM boundary.  Full 128-lane replication cost real bandwidth: at
 # [8,16,1024,64] the two broadcast stats were 134 MB of HBM traffic per
@@ -642,6 +646,17 @@ def _bwd_impl(q3, k3, v3, mask3, o3, lse, do3, causal, scale, bq, bk,
 # --------------------------------------------------------------------------
 # public entry: custom VJP over the kernel pair, oracle fallback for odd shapes
 # --------------------------------------------------------------------------
+
+def fold_rank_seed(seed, axis_name):
+    """Derive a per-rank dropout seed from a replicated one (Megatron's
+    per-tensor-rank rng stream): distinct ranks get well-separated
+    streams; rank 0 keeps ``seed`` unchanged.  Must run inside
+    ``shard_map`` binding ``axis_name``.  Do NOT fold the context axis —
+    ring attention's sharded-equals-dense dropout needs a CP-uniform
+    seed."""
+    return (jnp.asarray(seed, jnp.int32)
+            ^ (jax.lax.axis_index(axis_name) * jnp.int32(_HF)))
+
 
 def _seed_operand(seed, row_off=0, col_off=0):
     """SMEM dropout operand: [seed, global row offset, global col

@@ -97,14 +97,10 @@ def ulysses_attention(q, k, v, *, causal: bool = False,
                              concat_axis=3, tiled=True)
     drop_kw = {}
     if dropout_rate:
-        from apex_tpu.ops.attention import _H2
-        # rank-decorrelated stream (see docstring): the keep-mask hash's
-        # own odd multiplier keeps distinct ranks' seeds well separated
-        drop_kw = dict(
-            dropout_rate=dropout_rate,
-            dropout_seed=(jnp.asarray(dropout_seed, jnp.int32)
-                          ^ (jax.lax.axis_index(axis_name)
-                             * jnp.int32(_H2))))
+        from apex_tpu.ops.attention import fold_rank_seed
+        # rank-decorrelated stream (see docstring)
+        drop_kw = dict(dropout_rate=dropout_rate,
+                       dropout_seed=fold_rank_seed(dropout_seed, axis_name))
     o = flash_attention(qkv[0], qkv[1], qkv[2],
                         causal=causal, sm_scale=sm_scale,
                         block_q=block_q, block_k=block_k, **drop_kw)

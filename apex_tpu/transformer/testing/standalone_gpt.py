@@ -168,6 +168,16 @@ class ParallelAttention(nn.Module):
             # stream stays identical across topologies
             attn_seed = jax.random.bits(
                 self.make_rng("dropout"), dtype=jnp.uint32).astype(jnp.int32)
+            if tp > 1:
+                # Megatron semantics: attention dropout draws from the
+                # TENSOR-PARALLEL rng stream — the flax "dropout" rng is
+                # replicated across TP ranks, so fold the rank in here
+                # (each rank holds different heads and must drop
+                # independently; the keep-mask hash only sees the LOCAL
+                # head index).  CP rank is deliberately NOT folded —
+                # ring exactness needs a CP-uniform seed.
+                from apex_tpu.ops.attention import fold_rank_seed
+                attn_seed = fold_rank_seed(attn_seed, TENSOR_AXIS)
         if cfg.context_parallel and _cp() > 1:
             # sequence sharded over the context axis: exact attention via
             # the K/V ring (apex_tpu.ops.ring_attention); padding masks

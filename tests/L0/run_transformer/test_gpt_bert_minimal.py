@@ -24,10 +24,11 @@ VOCAB, HIDDEN, LAYERS, HEADS, SEQ, BATCH = 64, 32, 2, 4, 16, 2
 
 
 def _gpt_cfg(**kw):
+    kw.setdefault("hidden_dropout", 0.0)
+    kw.setdefault("attention_dropout", 0.0)
     return GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
                      num_layers=LAYERS, num_attention_heads=HEADS,
-                     max_seq_length=SEQ, hidden_dropout=0.0,
-                     attention_dropout=0.0, **kw)
+                     max_seq_length=SEQ, **kw)
 
 
 def _data(seed=0):
@@ -106,6 +107,74 @@ class TestGPTMinimal:
                 first = float(loss)
             params = opt.step(grads)
         assert float(loss) < first, (first, float(loss))
+
+    def test_trains_with_dropout(self):
+        """Train-mode path with hidden + in-kernel attention prob
+        dropout: learns, and is reproducible per dropout rng."""
+        parallel_state.initialize_model_parallel(1)
+        model = gpt_model_provider(_gpt_cfg(hidden_dropout=0.1,
+                                            attention_dropout=0.1))
+        tokens, labels = _data()
+        params = model.init({"params": jax.random.PRNGKey(1),
+                             "dropout": jax.random.PRNGKey(2)},
+                            tokens, labels)
+        opt = FusedAdam(params, lr=1e-3)
+        lg = jax.jit(lambda p, key: jax.value_and_grad(
+            lambda p: model.apply(p, tokens, labels, deterministic=False,
+                                  rngs={"dropout": key}))(p))
+        first = None
+        for i in range(10):
+            loss, grads = lg(params, jax.random.PRNGKey(100 + i))
+            if first is None:
+                first = float(loss)
+            params = opt.step(grads)
+        assert float(loss) < first, (first, float(loss))
+        # same dropout rng -> identical loss; different -> different
+        l1, _ = lg(params, jax.random.PRNGKey(7))
+        l2, _ = lg(params, jax.random.PRNGKey(7))
+        l3, _ = lg(params, jax.random.PRNGKey(8))
+        assert float(l1) == float(l2) and float(l1) != float(l3)
+
+    def test_tp2_dropout_decorrelates_ranks(self, monkeypatch):
+        """Attention prob dropout under TP: the rank is folded into the
+        seed (Megatron's tensor-parallel rng stream).  The regression
+        check is a CONTROL run with the fold neutralized (identity) —
+        re-correlating the ranks' masks must change the loss, so a
+        future edit that drops the fold cannot ship green."""
+        import apex_tpu.ops.attention as attn_mod
+        parallel_state.initialize_model_parallel(2)
+        mesh = parallel_state.get_mesh()
+        model = gpt_model_provider(_gpt_cfg(attention_dropout=0.3))
+        tokens, labels = _data()
+
+        def body(tokens, labels):
+            p = model.init({"params": jax.random.PRNGKey(1),
+                            "dropout": jax.random.PRNGKey(2)},
+                           tokens, labels)
+            return model.apply(p, tokens, labels, deterministic=False,
+                               rngs={"dropout": jax.random.PRNGKey(5)})
+
+        def run():
+            return float(jax.jit(
+                functools.partial(jax.shard_map, check_vma=False)(
+                    body, mesh=mesh, in_specs=(P(), P()),
+                    out_specs=P()))(tokens, labels))
+
+        folded_a, folded_b = run(), run()
+        real_fold = attn_mod.fold_rank_seed
+        monkeypatch.setattr(
+            attn_mod, "fold_rank_seed",
+            lambda seed, axis_name: jnp.asarray(seed, jnp.int32))
+        # the model imports the symbol at call time, so the patch takes
+        import apex_tpu.transformer.testing.standalone_gpt as gpt_mod
+        assert "fold_rank_seed" not in vars(gpt_mod)
+        shared = run()
+        monkeypatch.setattr(attn_mod, "fold_rank_seed", real_fold)
+        assert np.isfinite(folded_a) and folded_a == folded_b
+        assert abs(folded_a - np.log(VOCAB)) < 1.5
+        assert folded_a != shared, (
+            "identity fold did not change the loss — the TP rank fold "
+            "is not reaching the kernel")
 
     def test_remat_matches_baseline(self):
         parallel_state.initialize_model_parallel(1)
