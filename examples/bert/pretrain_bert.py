@@ -38,6 +38,12 @@ def parse_args(argv=None):
     p.add_argument("--iters", type=int, default=40)
     p.add_argument("--lr", type=float, default=5e-3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="hidden + attention dropout (the reference BERT "
+                        "recipe uses 0.1; attention dropout runs "
+                        "IN-KERNEL on the softmax probabilities). The "
+                        "toy default stays 0 so the smoke run converges "
+                        "in tens of steps")
     p.add_argument("--loss-scale", type=str, default="dynamic")
     p.add_argument("--platform", type=str, default=None,
                    help="force a jax platform (e.g. cpu); the axon TPU "
@@ -74,8 +80,8 @@ def main(argv=None):
     cfg = BertConfig(
         vocab_size=args.vocab, hidden_size=args.hidden,
         num_layers=args.layers, num_attention_heads=args.heads,
-        max_seq_length=args.seq, hidden_dropout=0.0,
-        attention_dropout=0.0, params_dtype=jnp.bfloat16)
+        max_seq_length=args.seq, hidden_dropout=args.dropout,
+        attention_dropout=args.dropout, params_dtype=jnp.bfloat16)
     model = bert_model_provider(cfg, add_binary_head=False)
 
     rng = np.random.RandomState(args.seed)
@@ -86,11 +92,21 @@ def main(argv=None):
     # vocab_parallel_cross_entropy has no ignore_index: weight the loss
     # to the masked positions via loss_mask (attention stays FULL — the
     # model must see the unmasked neighbors to solve the task)
-    def loss_fn(params, tokens, labels, scale):
+    train_mode = args.dropout > 0.0
+
+    def masked_lm_loss(params, tokens, labels, **apply_kw):
         valid = labels >= 0
         safe = jnp.where(valid, labels, 0)
         loss, _ = model.apply(params, tokens, lm_labels=safe,
-                              loss_mask=valid.astype(jnp.int32))
+                              loss_mask=valid.astype(jnp.int32),
+                              **apply_kw)
+        return loss
+
+    def loss_fn(params, tokens, labels, scale, dropout_key):
+        apply_kw = (dict(deterministic=False,
+                         rngs={"dropout": dropout_key})
+                    if train_mode else {})
+        loss = masked_lm_loss(params, tokens, labels, **apply_kw)
         return loss * scale, loss        # scaled loss drives the backward
 
     # FusedLAMB keeps fp32 masters of the bf16 params (the O2 regime)
@@ -108,9 +124,11 @@ def main(argv=None):
     batches = DevicePrefetcher(
         (synthetic_mlm_batch(rng, args) for _ in range(args.iters)),
         depth=2)
+    dropout_root = jax.random.PRNGKey(args.seed + 1)
     for it, (tokens, labels) in enumerate(batches):
         (_, loss), grads = grad_fn(params, tokens, labels,
-                                   scaler.state.loss_scale)
+                                   scaler.state.loss_scale,
+                                   jax.random.fold_in(dropout_root, it))
         grads = scaler.unscale_(grads)   # fused unscale + overflow check
         params = optimizer.step(grads, noop_flag=scaler.found_inf)
         scaler.update_scale()
@@ -118,7 +136,10 @@ def main(argv=None):
         if it % 5 == 0:
             print(f"iter {it:3d} loss {losses[-1]:.4f} "
                   f"scale {scaler.loss_scale():.0f}")
-    _, heldout_loss = loss_fn(params, heldout[0], heldout[1], 1.0)
+    # held-out eval is ALWAYS deterministic (dropout off), so the number
+    # is comparable across dropout settings; one eager call — a second
+    # jit compile would never amortize
+    heldout_loss = masked_lm_loss(params, heldout[0], heldout[1])
     heldout_loss = float(heldout_loss)
     print(f"final loss {losses[-1]:.4f} (first {losses[0]:.4f}) "
           f"held-out {heldout_loss:.4f}")

@@ -22,3 +22,13 @@ def test_bert_pretrain_generalizes():
     assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
     # chance level is log(1024) ~ 6.93; held-out must clearly beat it
     assert heldout < 6.5, heldout
+
+
+def test_bert_pretrain_with_dropout_learns():
+    """The reference recipe's dropout=0.1 regime: hidden dropout plus
+    IN-KERNEL attention-probability dropout, through the same amp/LAMB
+    loop.  Noisier, so the bar is just 'clearly learning' (the held-out
+    eval itself runs deterministic)."""
+    losses, heldout = main(["--iters", "40", "--dropout", "0.1"])
+    assert np.all(np.isfinite(losses))
+    assert heldout < 6.6, heldout
