@@ -408,19 +408,24 @@ def fused_adagrad_flat(p, g, h, *, lr, eps, weight_decay, w_mode=False,
 # SGD (momentum, nesterov)
 # ---------------------------------------------------------------------------
 
-def _sgd_kernel(nesterov, p_ref, g_ref, b_ref, hp_ref, po_ref, bo_ref):
+def _sgd_kernel(nesterov, wd_after, p_ref, g_ref, b_ref, hp_ref, po_ref,
+                bo_ref):
     lr, mom, damp, wd = hp_ref[0], hp_ref[1], hp_ref[2], hp_ref[3]
     first, noop, gscale = hp_ref[4], hp_ref[5], hp_ref[6]
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32) * gscale
     buf = b_ref[...].astype(jnp.float32)
-    d = g + wd * p
+    # wd_after_momentum (reference multi_tensor_sgd flag): decay joins
+    # AFTER the momentum update instead of inside the momentum input
+    d = g if wd_after else g + wd * p
     buf_new = jnp.where(first > 0.0, d, mom * buf + (1.0 - damp) * d)
     if nesterov:
         step_dir = d + mom * buf_new
     else:
         step_dir = buf_new
     step_dir = jnp.where(mom == 0.0, d, step_dir)
+    if wd_after:
+        step_dir = step_dir + wd * p
     p_new = p - lr * step_dir
     skip = noop > 0.0
     po_ref[...] = jnp.where(skip, p, p_new).astype(po_ref.dtype)
@@ -428,12 +433,13 @@ def _sgd_kernel(nesterov, p_ref, g_ref, b_ref, hp_ref, po_ref, bo_ref):
 
 
 def fused_sgd_flat(p, g, buf, *, lr, momentum, dampening, weight_decay,
-                   nesterov=False, first_run=False, noop_flag=0.0,
-                   grad_scale=1.0):
+                   nesterov=False, wd_after_momentum=False,
+                   first_run=False, noop_flag=0.0, grad_scale=1.0):
     """Fused SGD step, torch-SGD semantics.
 
     Parity: ``amp_C.multi_tensor_sgd`` (csrc/multi_tensor_sgd_kernel.cu) as
-    driven by ``apex/optimizers/fused_sgd.py :: FusedSGD``.
+    driven by ``apex/optimizers/fused_sgd.py :: FusedSGD``, including the
+    ``wd_after_momentum`` decay-placement flag.
     """
     hp = jnp.stack([
         jnp.asarray(lr, jnp.float32),
@@ -450,7 +456,8 @@ def fused_sgd_flat(p, g, buf, *, lr, momentum, dampening, weight_decay,
     g2 = g
     b2 = buf
     po, bo = pl.pallas_call(
-        functools.partial(_sgd_kernel, bool(nesterov)),
+        functools.partial(_sgd_kernel, bool(nesterov),
+                          bool(wd_after_momentum)),
         grid=(_grid(p2),),
         in_specs=[_vspec(), _vspec(), _vspec(), _sspec()],
         out_specs=[_vspec(), _vspec()],

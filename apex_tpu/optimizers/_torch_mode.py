@@ -163,9 +163,11 @@ class FusedSGDTorch(_TorchFusedBase):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and "
                              "zero dampening")
+        # wd_after_momentum is a GROUP option (the jax class treats it as
+        # one), so per-group overrides behave identically on both paths
         defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
-                        weight_decay=weight_decay, nesterov=nesterov)
-        self.wd_after_momentum = bool(wd_after_momentum)
+                        weight_decay=weight_decay, nesterov=nesterov,
+                        wd_after_momentum=bool(wd_after_momentum))
         super().__init__(params, defaults, set_grad_none)
 
     @torch.no_grad()
@@ -175,6 +177,7 @@ class FusedSGDTorch(_TorchFusedBase):
             mom, damp = group["momentum"], group["dampening"]
             lr, wd, nesterov = (group["lr"], group["weight_decay"],
                                 group["nesterov"])
+            wd_after = group["wd_after_momentum"]
             for p in group["params"]:
                 if p.grad is None:
                     continue
@@ -183,7 +186,7 @@ class FusedSGDTorch(_TorchFusedBase):
                 d = p.grad.float()
                 if grad_scale != 1.0:
                     d = d * grad_scale    # multiplier, the jax convention
-                if wd != 0.0 and not self.wd_after_momentum:
+                if wd != 0.0 and not wd_after:
                     d = d.add(master, alpha=wd)
                 if mom != 0.0:
                     buf = state.get("momentum_buffer")
@@ -192,7 +195,7 @@ class FusedSGDTorch(_TorchFusedBase):
                     else:
                         buf.mul_(mom).add_(d, alpha=1 - damp)
                     d = d.add(buf, alpha=mom) if nesterov else buf
-                if wd != 0.0 and self.wd_after_momentum:
+                if wd != 0.0 and wd_after:
                     d = d.add(master, alpha=wd)
                 master.add_(d, alpha=-lr)
                 self._writeback(p, master)

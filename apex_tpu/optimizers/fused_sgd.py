@@ -14,12 +14,13 @@ __all__ = ["FusedSGD"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("nesterov",))
+                   static_argnames=("nesterov", "wd_after_momentum"))
 def _sgd_step(p, buf, g, lr, momentum, dampening, weight_decay, first,
-              noop_flag, grad_scale, *, nesterov):
+              noop_flag, grad_scale, *, nesterov, wd_after_momentum):
     return fused_sgd_flat(
         p, g, buf, lr=lr, momentum=momentum, dampening=dampening,
-        weight_decay=weight_decay, nesterov=nesterov, first_run=first,
+        weight_decay=weight_decay, nesterov=nesterov,
+        wd_after_momentum=wd_after_momentum, first_run=first,
         noop_flag=noop_flag, grad_scale=grad_scale)
 
 
@@ -35,23 +36,39 @@ class FusedSGD(FusedOptimizerBase):
             raise ValueError(
                 "Nesterov momentum requires a momentum and zero dampening")
         defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
-                        weight_decay=weight_decay, nesterov=nesterov)
+                        weight_decay=weight_decay, nesterov=nesterov,
+                        wd_after_momentum=wd_after_momentum)
         super().__init__(params, defaults)
 
     def _init_group_state(self, group):
-        group.state = {"momentum_buffer": jnp.zeros_like(group.master)}
+        group.state = {"momentum_buffer": jnp.zeros_like(group.master),
+                       # torch clones the grad into a FRESH buffer on the
+                       # first EFFECTIVE step; step==1 is the wrong proxy
+                       # when amp noop-skips it (dampening would then
+                       # scale the seeding grad).  Traced so overflow
+                       # skips need no host sync.
+                       "seeded": jnp.zeros((), jnp.float32)}
 
     def _step_group(self, group, gflat, step, noop_flag, grad_scale):
         o = group.options
+        # pre-r5 checkpoints lack the flag: any step already taken seeded
+        # the buffer (their step 1 was never recorded as skipped)
+        seeded = group.state.get("seeded")
+        if seeded is None:
+            seeded = jnp.asarray(0.0 if step == 1 else 1.0, jnp.float32)
+        noop = jnp.asarray(noop_flag, jnp.float32)
         p, buf = _sgd_step(
             group.master, group.state["momentum_buffer"], gflat,
             jnp.asarray(o["lr"], jnp.float32),
             jnp.asarray(o["momentum"], jnp.float32),
             jnp.asarray(o["dampening"], jnp.float32),
             jnp.asarray(o["weight_decay"], jnp.float32),
-            jnp.asarray(1.0 if step == 1 else 0.0, jnp.float32),
-            jnp.asarray(noop_flag, jnp.float32),
+            1.0 - seeded,
+            noop,
             jnp.asarray(grad_scale, jnp.float32),
-            nesterov=bool(o["nesterov"]))
+            nesterov=bool(o["nesterov"]),
+            wd_after_momentum=bool(o["wd_after_momentum"]))
         group.master = p
         group.state["momentum_buffer"] = buf
+        group.state["seeded"] = jnp.maximum(
+            seeded, jnp.where(noop > 0.0, 0.0, 1.0))

@@ -153,6 +153,89 @@ def test_fused_sgd_matches_torch_sgd():
                                    atol=1e-7)
 
 
+def test_fused_sgd_wd_after_momentum_matches_across_paths():
+    """wd_after_momentum changes the decay placement; the JAX kernel
+    silently ignored it pre-r5.  Both entry points must honor it (the
+    ordering only diverges from step 2 on, so run 3 steps), and the
+    flag must actually change the update."""
+    from apex_tpu.optimizers import FusedSGD
+
+    rng = np.random.default_rng(4)
+    p_np = rng.normal(size=(6, 5)).astype(np.float32)
+    grads = [rng.normal(size=(6, 5)).astype(np.float32) for _ in range(3)]
+    kw = dict(lr=1e-2, momentum=0.9, weight_decay=0.1)
+
+    def run_torch(wd_after):
+        tp = torch.nn.Parameter(torch.tensor(p_np))
+        opt = FusedSGD([tp], wd_after_momentum=wd_after, **kw)
+        for g in grads:
+            tp.grad = torch.tensor(g)
+            opt.step()
+        return tp.detach().numpy()
+
+    def run_jax(wd_after):
+        jp = [jnp.asarray(p_np)]
+        opt = FusedSGD(jp, wd_after_momentum=wd_after, **kw)
+        for g in grads:
+            jp = opt.step([jnp.asarray(g)])
+        return np.asarray(jp[0])
+
+    for wd_after in (False, True):
+        np.testing.assert_allclose(run_torch(wd_after), run_jax(wd_after),
+                                   rtol=2e-5, atol=2e-6)
+    assert not np.allclose(run_jax(False), run_jax(True))
+
+
+def test_fused_sgd_noop_skipped_first_step_is_pure_noop():
+    """An amp overflow-skip on step 1 must leave the optimizer exactly
+    where it started: the next effective step seeds the momentum buffer
+    with d (torch clones into a FRESH buffer), not (1-dampening)*d —
+    the step==1 proxy got this wrong when dampening != 0."""
+    from apex_tpu.optimizers import FusedSGD
+
+    rng = np.random.default_rng(5)
+    p_np = rng.normal(size=(8,)).astype(np.float32)
+    g1 = rng.normal(size=(8,)).astype(np.float32)
+    g2 = rng.normal(size=(8,)).astype(np.float32)
+    kw = dict(lr=1e-2, momentum=0.9, dampening=0.2, weight_decay=0.1)
+
+    skip = FusedSGD([jnp.asarray(p_np)], **kw)
+    ps = skip.step([jnp.asarray(g1)], noop_flag=1.0)   # overflow: no-op
+    np.testing.assert_array_equal(np.asarray(ps[0]), p_np)
+    ps = skip.step([jnp.asarray(g2)])
+
+    fresh = FusedSGD([jnp.asarray(p_np)], **kw)
+    pf = fresh.step([jnp.asarray(g2)])
+    np.testing.assert_array_equal(np.asarray(ps[0]), np.asarray(pf[0]))
+
+
+def test_fused_sgd_wd_after_momentum_per_group_torch_path():
+    """Per-group wd_after_momentum overrides must reach the torch twin
+    too (it treats the flag as a group option, like the jax class)."""
+    rng = np.random.default_rng(6)
+    p1n = rng.normal(size=(4, 3)).astype(np.float32)
+    p2n = rng.normal(size=(3,)).astype(np.float32)
+    g1n = rng.normal(size=(4, 3)).astype(np.float32)
+    g2n = rng.normal(size=(3,)).astype(np.float32)
+    kw = dict(lr=1e-2, momentum=0.9, weight_decay=0.1)
+    from apex_tpu.optimizers import FusedSGD
+
+    def run(override):
+        p1 = torch.nn.Parameter(torch.tensor(p1n))
+        p2 = torch.nn.Parameter(torch.tensor(p2n))
+        groups = [{"params": [p1], **override}, {"params": [p2]}]
+        opt = FusedSGD(groups, **kw)
+        for _ in range(3):
+            p1.grad, p2.grad = torch.tensor(g1n), torch.tensor(g2n)
+            opt.step()
+        return p1.detach().numpy(), p2.detach().numpy()
+
+    base1, base2 = run({})
+    ov1, ov2 = run({"wd_after_momentum": True})
+    assert not np.allclose(base1, ov1)       # group 1 honors the override
+    np.testing.assert_array_equal(base2, ov2)  # group 2 untouched
+
+
 def test_fused_lamb_torch_matches_jax_kernel():
     """One step of the torch twin must equal the JAX `_lamb_step` kernel
     path on identical params/grads (numpy bridge, default knobs)."""
