@@ -257,6 +257,11 @@ class FusedNovoGradTorch(_TorchFusedBase):
                                "AMSGrad variant.")
         if norm_type != 2:
             raise ValueError("FusedNovoGrad only supports norm_type=2")
+        if reg_inside_moment:
+            raise NotImplementedError(
+                "FusedNovoGrad: reg_inside_moment=True is not "
+                "implemented (only the default decay placement, decay "
+                "added to the normalized gradient, is)")
         defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
                         eps=eps, weight_decay=weight_decay)
         self.grad_averaging = bool(grad_averaging)

@@ -63,6 +63,15 @@ class FusedNovoGrad(FusedOptimizerBase):
                                "variant.")
         if norm_type != 2:
             raise ValueError("FusedNovoGrad only supports norm_type=2")
+        if reg_inside_moment:
+            # the flag flips the kernel's decay placement (reference
+            # MOMENT_MODE split); only the default placement is
+            # implemented here — refusing beats silently running
+            # different math
+            raise NotImplementedError(
+                "FusedNovoGrad: reg_inside_moment=True is not "
+                "implemented (only the default decay placement, decay "
+                "added to the normalized gradient, is)")
         defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
                         eps=eps, weight_decay=weight_decay)
         self.grad_averaging = bool(grad_averaging)
