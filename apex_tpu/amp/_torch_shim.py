@@ -143,6 +143,33 @@ def _wrap_forward_cast_inputs(model, dtype):
     return model
 
 
+def _wrap_forward_cast_outputs(model, dtype):
+    """Cast every floating tensor in the model's output structure to
+    ``dtype`` (reference: ``amp.initialize(cast_model_outputs=...)`` —
+    applies regardless of opt level)."""
+    orig = model.forward
+    dtype = _to_torch_dtype(dtype)   # accept jnp/np dtypes like cast_model_type
+
+    def cast(x):
+        if isinstance(x, torch.Tensor) and x.is_floating_point():
+            return x.to(dtype)
+        if isinstance(x, tuple) and hasattr(x, "_fields"):   # namedtuple
+            return type(x)(*(cast(v) for v in x))
+        if isinstance(x, (list, tuple)):
+            return type(x)(cast(v) for v in x)
+        if isinstance(x, dict):
+            # preserve the subclass (OrderedDict / ModelOutput-style)
+            return type(x)((k, cast(v)) for k, v in x.items())
+        return x
+
+    @functools.wraps(orig)
+    def forward(*args, **kw):
+        return cast(orig(*args, **kw))
+
+    model.forward = forward
+    return model
+
+
 def _wrap_forward_autocast(model, dtype):
     orig = model.forward
 
@@ -245,7 +272,8 @@ def _patch_optimizer(optimizer, master_weights: bool):
 
 
 def initialize_torch(model, optimizer, props, num_losses=1,
-                     min_loss_scale=None, max_loss_scale=None):
+                     min_loss_scale=None, max_loss_scale=None,
+                     cast_model_outputs=None):
     """Apply an opt level to torch module(s) (+ optimizer(s)).
 
     Lists are the reference's multi-model/multi-optimizer contract
@@ -284,6 +312,11 @@ def initialize_torch(model, optimizer, props, num_losses=1,
         for m in models:
             _cast_module(m, half, keep_bn)
             _wrap_forward_cast_inputs(m, half)
+    if cast_model_outputs is not None:
+        # outermost wrapper: applies regardless of opt level (reference
+        # contract)
+        for m in models:
+            _wrap_forward_cast_outputs(m, cast_model_outputs)
     model_out = models if models_in_list else models[0]
 
     if optimizer is None:

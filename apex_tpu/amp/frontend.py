@@ -220,6 +220,12 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
     with params cast per the opt level and the optimizer wrapped in
     :class:`AmpOptimizer`.  torch path: ``models`` is a ``torch.nn.Module``
     (CPU parity shim).
+
+    ``cast_model_outputs`` wraps each torch model's forward to cast
+    floating outputs to the given dtype regardless of opt level
+    (reference contract).  On the JAX path it has no effect: initialize
+    only sees the params pytree, not the apply function — cast outputs
+    at the loss boundary instead (the examples' ``.float()`` pattern).
     """
     if not enabled:
         return (models, optimizers) if optimizers is not None else models
@@ -257,7 +263,8 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
         from apex_tpu.amp import _torch_shim
         return _torch_shim.initialize_torch(
             models, optimizers, props, num_losses=num_losses,
-            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale)
+            min_loss_scale=min_loss_scale, max_loss_scale=max_loss_scale,
+            cast_model_outputs=cast_model_outputs)
 
     # JAX path: params pytree (+ apex_tpu optimizer)
     keep = ("batchnorm", "bn") if props.keep_batchnorm_fp32 else ()

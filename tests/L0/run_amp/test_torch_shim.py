@@ -58,6 +58,24 @@ def test_o2_float16_loss_decreases_masters_fp32():
     assert losses[-1] < losses[0] * 0.7, (losses[:3], losses[-3:])
 
 
+def test_cast_model_outputs_honored():
+    """Reference contract: cast_model_outputs casts floating outputs to
+    the requested dtype regardless of opt level — previously the kwarg
+    was silently ignored."""
+    from apex_tpu.optimizers import FusedAdam
+
+    for opt_level in ("O1", "O2"):
+        model = _mlp()
+        opt = FusedAdam(model.parameters(), lr=1e-3)
+        model, opt = amp.initialize(model, opt, opt_level=opt_level,
+                                    cast_model_outputs=torch.float32)
+        out = model(torch.randn(8, 16))
+        assert out.dtype == torch.float32, opt_level
+        # still trains through the wrapper
+        losses = _train(model, opt, steps=10)
+        assert np.isfinite(losses).all()
+
+
 def test_o2_casts_model_keeps_bn_fp32():
     model = _mlp()
     opt = torch.optim.SGD(model.parameters(), lr=0.05)
