@@ -18,6 +18,7 @@ the scale — exactly the reference's skip-on-overflow contract.
 from __future__ import annotations
 
 import contextlib
+import copy
 import functools
 import types
 
@@ -158,8 +159,13 @@ def _wrap_forward_cast_outputs(model, dtype):
         if isinstance(x, (list, tuple)):
             return type(x)(cast(v) for v in x)
         if isinstance(x, dict):
-            # preserve the subclass (OrderedDict / ModelOutput-style)
-            return type(x)((k, cast(v)) for k, v in x.items())
+            # copy-then-assign preserves subclass state that pair-style
+            # reconstruction loses (defaultdict's default_factory,
+            # ModelOutput internals)
+            out = copy.copy(x)
+            for k, v in x.items():
+                out[k] = cast(v)
+            return out
         return x
 
     @functools.wraps(orig)

@@ -29,6 +29,14 @@ Every executor returns ``(mean_loss, grads)`` (or ``(mean_loss, None)``
 when ``forward_only``); grads are per-rank stage grads ready for the DP
 reduction / optimizer.  Run inside ``shard_map`` binding the pipe axis
 (the no-pipelining executor runs anywhere).
+
+Dropout under pipelining: give each microbatch its own PRNG key as a
+leaf of ``batch`` (``_microbatch`` slices every leaf), and fold the
+stage index (``jax.lax.axis_index("pipe")``) into it inside
+``stage_fn`` — every (stage, microbatch) pair then draws a distinct,
+replayable mask, and the schedules stay bitwise-equivalent to the dense
+replay (tested:
+``test_1f1b_with_per_microbatch_dropout_matches_reference``).
 """
 from __future__ import annotations
 

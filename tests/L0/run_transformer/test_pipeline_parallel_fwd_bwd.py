@@ -122,6 +122,57 @@ def test_1f1b_matches_reference(setup):
         grads, ref_grads)
 
 
+def test_1f1b_with_per_microbatch_dropout_matches_reference(setup):
+    """Dropout under pipelining: per-microbatch PRNG keys ride the batch
+    pytree (``_microbatch`` slices every leaf, so each microbatch — and
+    via a stage fold, each stage — draws its own mask).  The 1F1B run
+    must still match the dense replay exactly, proving the executors
+    route every (stage, microbatch) pair to the right dropout draw."""
+    params = _make_params(jax.random.key(0), PP)
+    batch = _batch(jax.random.key(1))
+    # legacy raw uint32[2] keys so the leaf slices like any array
+    batch["key"] = jax.vmap(jax.random.PRNGKey)(
+        jnp.arange(N_MICRO, dtype=jnp.uint32))
+    mesh = parallel_state.get_mesh()
+
+    def drop_stage(params, x, mb, stage):
+        y = jax.nn.gelu(x @ params["w"] + params["b"])
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(mb["key"], stage), 0.8, y.shape)
+        return jnp.where(keep, y / 0.8, 0.0)
+
+    def body(params, batch):
+        local = jax.tree.map(lambda p: p[0], params)
+        stage_fn = lambda p, x, mb: drop_stage(  # noqa: E731
+            p, x, mb, jax.lax.axis_index("pipe"))
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            stage_fn, _loss_fn, local, batch,
+            num_microbatches=N_MICRO, input_fn=_input_fn)
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    loss, grads = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh,
+        in_specs=(P("pipe"), P()),
+        out_specs=(P(), P("pipe"))))(params, batch)
+
+    def ref_loss_fn(params):
+        total = 0.0
+        for m in range(N_MICRO):
+            mb = jax.tree.map(lambda v, m=m: v[m], batch)
+            x = mb["x"]
+            for s in range(PP):
+                x = drop_stage(
+                    jax.tree.map(lambda p, s=s: p[s], params), x, mb, s)
+            total = total + _loss_fn(x, mb)
+        return total / N_MICRO
+
+    ref_loss, ref_grads = jax.value_and_grad(ref_loss_fn)(params)
+    np.testing.assert_allclose(loss, ref_loss, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        grads, ref_grads)
+
+
 # interleaving requires num_microbatches % PP == 0 (reference constraint)
 N_MICRO_I = 8
 
