@@ -65,6 +65,12 @@ def parse_args(argv=None):
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dropout", type=float, default=0.0,
+                   help="hidden + attention dropout through the pipeline "
+                        "(per-microbatch keys ride the batch pytree; the "
+                        "attention part runs IN-KERNEL on the softmax "
+                        "probabilities). Toy default 0 so the smoke run "
+                        "converges fast")
     p.add_argument("--platform", type=str, default=None,
                    help="force a jax platform (e.g. cpu)")
     return p.parse_args(argv)
@@ -114,25 +120,34 @@ def main(argv=None):
     print(f"mesh: tp={args.tp} pp={args.pp} dp={dp} vpp={args.vpp} "
           f"micro-batches/step={n_micro} executor={fwd_bwd.__name__}")
 
+    train_mode = args.dropout > 0.0
     cfg = GPTConfig(
         vocab_size=args.vocab, hidden_size=args.hidden,
         num_layers=args.pp * args.vpp,
         num_attention_heads=args.heads, max_seq_length=args.seq,
-        hidden_dropout=0.0, attention_dropout=0.0)
+        hidden_dropout=args.dropout, attention_dropout=args.dropout)
     layer = ParallelTransformerLayer(cfg, causal=True)
 
     def stage_fn(params, x, mb):
-        # injection at VIRTUAL stage 0 only: rank 0 AND the chunk whose
-        # params carry first_chunk=1 (with vpp=1 every rank's single
-        # chunk of params has it set iff rank 0 uses it — the flag is a
-        # param leaf precisely so the interleaved executor's per-chunk
-        # param slicing selects it)
+        # injection at VIRTUAL stage 0 only: rank 0 AND chunk 0 (the
+        # chunk identity is a param leaf precisely so the interleaved
+        # executor's per-chunk param slicing selects it)
         stage = jax.lax.axis_index("pipe") if args.pp > 1 else 0
         emb = jnp.take(params["embed"], mb["tokens"], axis=0)  # [b,s,h]
         emb = emb.transpose(1, 0, 2)                           # [s,b,h]
-        inject = (stage == 0) & (params["first_chunk"] > 0.5)
+        inject = (stage == 0) & (params["chunk_id"] < 0.5)
         x = jnp.where(inject, emb, x)
-        return layer.apply(params["layer"], x, None, True)
+        if not train_mode:
+            return layer.apply(params["layer"], x, None, True)
+        # dropout under pipelining (schedules.py contract): the
+        # per-microbatch key rides the batch, the (stage, chunk) fold
+        # decorrelates virtual stages, and the layer itself folds the
+        # TP rank for its in-kernel attention dropout
+        key = jax.random.fold_in(
+            jax.random.fold_in(mb["key"], stage),
+            params["chunk_id"].astype(jnp.int32))
+        return layer.apply(params["layer"], x, None, False,
+                           rngs={"dropout": key})
 
     def loss_fn(y, mb, params):
         # TIED head: logits through the same embedding table (3-arg loss
@@ -161,7 +176,7 @@ def main(argv=None):
             return {
                 "embed": embed0,
                 "layer": layer.init(key, x0, None, True),
-                "first_chunk": jnp.float32(1.0 if chunk == 0 else 0.0),
+                "chunk_id": jnp.float32(chunk),
             }
 
         if args.vpp > 1:
@@ -211,15 +226,26 @@ def main(argv=None):
         # out-spec's replication claim actually holds)
         return jax.lax.pmean(losses, "data")
 
+    batch_specs = {"tokens": P(None, None, "data"),
+                   "labels": P(None, None, "data")}
+    if train_mode:
+        batch_specs["key"] = P()         # keys are replicated, not sharded
     run = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
         body, mesh=mesh,
-        in_specs=(P(None, None, "data"),),
+        in_specs=(batch_specs,),
         out_specs=P()))
 
     rng = np.random.RandomState(args.seed)
     toks, labs = zip(*[cyclic_batch(rng, args, n_micro, dp)
                        for _ in range(args.iters)])
     all_batches = {"tokens": jnp.stack(toks), "labels": jnp.stack(labs)}
+    if train_mode:
+        # one key per (step, microbatch), sliced by the executors like
+        # any other batch leaf
+        all_batches["key"] = jax.vmap(jax.vmap(jax.random.PRNGKey))(
+            (args.seed + jnp.arange(args.iters * n_micro,
+                                    dtype=jnp.uint32))
+            .reshape(args.iters, n_micro))
     losses = [float(l) for l in np.asarray(run(all_batches))]
     for it in range(0, args.iters, 5):
         print(f"iter {it:3d} loss {losses[it]:.4f}")

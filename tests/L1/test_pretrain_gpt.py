@@ -28,3 +28,15 @@ def test_gpt_pretrain_learns_interleaved():
                    "--iters", "30"])
     assert np.all(np.isfinite(losses))
     assert losses[-1] < 1.0, (losses[0], losses[-1])
+
+
+def test_gpt_pretrain_learns_with_dropout():
+    """The full composition under the reference training regime:
+    dropout (hidden + in-kernel attention prob) through TP x PP x DP
+    with interleaved chunks — per-microbatch keys ride the batch, the
+    (stage, chunk) fold decorrelates virtual stages, the layer folds the
+    TP rank.  Noisier optimization, so the bar is clear learning."""
+    losses = main(["--tp", "2", "--pp", "2", "--vpp", "2",
+                   "--iters", "30", "--dropout", "0.1"])
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
