@@ -83,3 +83,27 @@ def test_total_failure_still_emits_json():
     assert out["value"] is None
     assert out["value_provenance"].startswith("none")
     assert "probe err" in out["error"]
+
+
+def test_overrides_forwarded_to_inner_leg_subprocess():
+    """--override knobs must reach the per-leg subprocesses — the
+    orchestrator invocation is what the on-chip experiment runner uses."""
+    captured = {}
+
+    class _P:
+        returncode = 0
+        stdout = '{"_leg": "attn", "ok": 1}\n'
+        stderr = ""
+
+    def fake_run(cmd, **kw):
+        captured["cmd"] = cmd
+        return _P()
+
+    with mock.patch.object(bench, "_OVERRIDES",
+                           {"batch": 16, "block_q": 512}), \
+         mock.patch.object(bench.subprocess, "run", fake_run):
+        obj, err = bench._run_leg("tpu", "attn", 60)
+    assert err is None and obj["ok"] == 1
+    cmd = captured["cmd"]
+    assert cmd[cmd.index("--override") + 1] == "batch=16"
+    assert "block_q=512" in cmd
