@@ -28,6 +28,7 @@ from apex_tpu.transformer.tensor_parallel.cross_entropy import (
 from apex_tpu.transformer.testing.standalone_gpt import (
     GPTConfig,
     ParallelTransformerLayer,
+    _hidden_dropout_rng,
 )
 
 __all__ = ["BertConfig", "BertModel", "bert_model_provider"]
@@ -93,7 +94,9 @@ class BertModel(nn.Module):
         if cfg.sequence_parallel:
             h = mappings.scatter_to_sequence_parallel_region(h)
         if not deterministic and cfg.hidden_dropout > 0.0:
-            h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=False)
+            h = nn.Dropout(cfg.hidden_dropout)(
+                h, deterministic=False,
+                rng=_hidden_dropout_rng(self, cfg))
 
         # padding mask [b, s] (1 = keep) -> flash-attention boolean
         # [b, 1, s, s] with True = masked

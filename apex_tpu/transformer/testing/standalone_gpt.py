@@ -109,6 +109,25 @@ def _ep() -> int:
     return 1
 
 
+def _hidden_dropout_rng(mod, cfg):
+    """Dropout rng for hidden activations.
+
+    When activations are replicated across an axis, every rank on it
+    MUST draw the same mask (the replicated make_rng key does that).
+    When they are sequence-SHARDED over an axis — the tensor axis under
+    sequence parallelism, the context axis under context parallelism —
+    each rank holds a different chunk, so the masks must be drawn
+    per-rank (Megatron's tensor-parallel rng stream); a shared key
+    would repeat one mask pattern across all chunks."""
+    key = mod.make_rng("dropout")
+    if cfg.sequence_parallel and _tp() > 1:
+        key = jax.random.fold_in(key, jax.lax.axis_index(TENSOR_AXIS))
+    if getattr(cfg, "context_parallel", False) and _cp() > 1:
+        key = jax.random.fold_in(
+            key, jax.lax.axis_index(parallel_state.CONTEXT_AXIS))
+    return key
+
+
 class ParallelMLP(nn.Module):
     """h -> 4h (column) -> gelu -> h (row); reference: Megatron ParallelMLP."""
     cfg: GPTConfig
@@ -229,7 +248,9 @@ class ParallelTransformerLayer(nn.Module):
         h = ParallelAttention(cfg, causal=self.causal, name="self_attention")(
             h, attention_mask, deterministic)
         if not deterministic and cfg.hidden_dropout > 0.0:
-            h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=False)
+            h = nn.Dropout(cfg.hidden_dropout)(
+                h, deterministic=False,
+                rng=_hidden_dropout_rng(self, cfg))
         x = x + h
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size,
                            name="post_attention_layernorm")(x)
@@ -254,7 +275,9 @@ class ParallelTransformerLayer(nn.Module):
         else:
             h = ParallelMLP(cfg, name="mlp")(h, deterministic)
         if not deterministic and cfg.hidden_dropout > 0.0:
-            h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=False)
+            h = nn.Dropout(cfg.hidden_dropout)(
+                h, deterministic=False,
+                rng=_hidden_dropout_rng(self, cfg))
         return x + h
 
 
@@ -299,7 +322,9 @@ class GPTEmbedding(nn.Module):
         if cfg.sequence_parallel:
             h = mappings.scatter_to_sequence_parallel_region(h)
         if not deterministic and cfg.hidden_dropout > 0.0:
-            h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=False)
+            h = nn.Dropout(cfg.hidden_dropout)(
+                h, deterministic=False,
+                rng=_hidden_dropout_rng(self, cfg))
         return h
 
 

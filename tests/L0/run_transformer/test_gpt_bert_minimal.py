@@ -176,6 +176,41 @@ class TestGPTMinimal:
             "identity fold did not change the loss — the TP rank fold "
             "is not reaching the kernel")
 
+    def test_sp_hidden_dropout_per_rank_masks(self, monkeypatch):
+        """Under sequence parallelism the hidden activations are
+        sequence-SHARDED, so hidden-dropout masks must be drawn per TP
+        rank (a shared key repeats one pattern across chunks).  Control:
+        neutralizing the rank fold must change the loss."""
+        import apex_tpu.transformer.testing.standalone_gpt as gpt_mod
+        parallel_state.initialize_model_parallel(2)
+        mesh = parallel_state.get_mesh()
+        model = gpt_model_provider(_gpt_cfg(hidden_dropout=0.4,
+                                            sequence_parallel=True))
+        tokens, labels = _data()
+
+        def body(tokens, labels):
+            p = model.init({"params": jax.random.PRNGKey(1),
+                            "dropout": jax.random.PRNGKey(2)},
+                           tokens, labels)
+            return model.apply(p, tokens, labels, deterministic=False,
+                               rngs={"dropout": jax.random.PRNGKey(5)})
+
+        def run():
+            return float(jax.jit(
+                functools.partial(jax.shard_map, check_vma=False)(
+                    body, mesh=mesh, in_specs=(P(), P()),
+                    out_specs=P()))(tokens, labels))
+
+        folded = run()
+        real = gpt_mod._hidden_dropout_rng
+        monkeypatch.setattr(gpt_mod, "_hidden_dropout_rng",
+                            lambda mod, sp: mod.make_rng("dropout"))
+        shared = run()
+        monkeypatch.setattr(gpt_mod, "_hidden_dropout_rng", real)
+        assert np.isfinite(folded)
+        assert folded != shared, (
+            "rank fold not reaching SP hidden dropout")
+
     def test_remat_matches_baseline(self):
         parallel_state.initialize_model_parallel(1)
         tokens, labels = _data()

@@ -35,6 +35,7 @@ SLOW = {
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::TestGPTMinimal::test_trains_single_device",
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::TestGPTMinimal::test_trains_with_dropout",
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::TestGPTMinimal::test_tp2_dropout_decorrelates_ranks",
+    "tests/L0/run_transformer/test_gpt_bert_minimal.py::TestGPTMinimal::test_sp_hidden_dropout_per_rank_masks",
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::test_context_parallel_matches_cp1",
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::test_scan_layers_matches_loop",
     "tests/L0/run_transformer/test_layers.py::test_sequence_parallel_column_row",
