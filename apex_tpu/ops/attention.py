@@ -53,6 +53,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -658,6 +659,18 @@ def fold_rank_seed(seed, axis_name):
             ^ (jax.lax.axis_index(axis_name) * jnp.int32(_HF)))
 
 
+def _zero_cotangent(x):
+    """Cotangent for a non-differentiable custom_vjp argument: None for
+    an absent (None) operand, float0 zeros for integer/bool primals,
+    ordinary zeros for inexact dtypes (a 0/1 float mask is accepted by
+    the forward's ``where``, so its grad path must not type-error)."""
+    if x is None:
+        return None
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.zeros(x.shape, x.dtype)
+    return np.zeros(np.shape(x), jax.dtypes.float0)
+
+
 def _seed_operand(seed, row_off=0, col_off=0):
     """SMEM dropout operand: [seed, global row offset, global col
     offset].  Offsets are 0 for unsharded attention; ring attention sets
@@ -764,28 +777,34 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
             mask3 = jnp.pad(
                 mask3, ((0, 0), (0, sq_pad - sq), (0, sk_pad - sk)))
 
+    # mask3/seed3 are custom_vjp ARGUMENTS, not closure captures: a
+    # traced value closed over by a custom_vjp function leaks its trace
+    # under nn.scan/lax.scan + grad (UnexpectedTracerError — hit by
+    # scan_layers models with dropout).  None passes through as an
+    # empty pytree; arrays get float0 cotangents (bool/int primals).
     @jax.custom_vjp
-    def run(q3, k3, v3):
+    def run(q3, k3, v3, mask3, seed3):
         out, _ = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk,
                       causal_off=causal_off, valid=valid,
                       rate=dropout_rate, seed3=seed3)
         return out
 
-    def run_fwd(q3, k3, v3):
+    def run_fwd(q3, k3, v3, mask3, seed3):
         out, lse = _fwd(q3, k3, v3, mask3, causal, scale, bq, bk,
                         causal_off=causal_off, valid=valid,
                         rate=dropout_rate, seed3=seed3)
-        return out, (q3, k3, v3, out, lse)
+        return out, (q3, k3, v3, mask3, seed3, out, lse)
 
     def run_bwd(res, do3):
-        q3, k3, v3, out, lse = res
-        return _bwd_impl(q3, k3, v3, mask3, out, lse, do3,
-                         causal, scale, bq, bk,
-                         causal_off=causal_off, valid=valid,
-                         rate=dropout_rate, seed3=seed3)
+        q3, k3, v3, mask3, seed3, out, lse = res
+        dq, dk, dv = _bwd_impl(q3, k3, v3, mask3, out, lse, do3,
+                               causal, scale, bq, bk,
+                               causal_off=causal_off, valid=valid,
+                               rate=dropout_rate, seed3=seed3)
+        return dq, dk, dv, _zero_cotangent(mask3), _zero_cotangent(seed3)
 
     run.defvjp(run_fwd, run_bwd)
-    out = run(q3, k3, v3)
+    out = run(q3, k3, v3, mask3, seed3)
     if padded:
         out = out[:, :sq, :]
     return out.reshape(b, h, sq, d)

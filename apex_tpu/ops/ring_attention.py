@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.attention import (_bwd_impl, _fwd, _fit_block,
-                                    _seed_operand, mha_reference)
+                                    _seed_operand, _zero_cotangent,
+                                    mha_reference)
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
 
 __all__ = ["ring_attention", "ring_attention_reference"]
@@ -131,28 +132,31 @@ def ring_attention(q, k, v, *, causal: bool = False,
     def rot(x):
         return jax.lax.ppermute(x, axis_name, perm)
 
+    # seed is a custom_vjp ARGUMENT (None when dropout is off): closing
+    # over a traced seed leaks its trace under scan + grad — see the
+    # matching note in flash_attention
     @jax.custom_vjp
-    def run(q3, k3in, v3in):
-        out, _ = _ring_fwd(q3, k3in, v3in)
+    def run(q3, k3in, v3in, seed):
+        out, _ = _ring_fwd(q3, k3in, v3in, seed)
         return out
 
-    def _drop_seed3(my, t):
+    def _drop_seed3(seed, my, t):
         """Dropout operand for the step-t pair: global row offset is this
         rank's query origin; global col offset is the HELD shard's origin
         (source rank (my - t) mod cp)."""
         if not dropout_rate:
             return None
         src = jax.lax.rem(my - t + cp, cp)
-        return _seed_operand(dropout_seed, my * s_local, src * s_local)
+        return _seed_operand(seed, my * s_local, src * s_local)
 
-    def _ring_fwd(q3, k3in, v3in):
+    def _ring_fwd(q3, k3in, v3in, seed):
         my = jax.lax.axis_index(axis_name)
         out = jnp.zeros((b * h, s_local, d), jnp.float32)
         lse = jnp.full((b * h, s_local), -1e30, jnp.float32)
         kv = (k3in, v3in)
         for t in range(cp):
             k3, v3 = kv
-            s3 = _drop_seed3(my, t)
+            s3 = _drop_seed3(seed, my, t)
             if causal and t > 0:
                 # invisible shards: skip the kernel entirely (lax.cond on
                 # the traced rank): no wasted FLOPs, and no exp(s - lse)
@@ -173,9 +177,9 @@ def ring_attention(q, k, v, *, causal: bool = False,
                 kv = jax.tree.map(rot, kv)
         return out.astype(q3.dtype), lse
 
-    def run_fwd(q3, k3in, v3in):
-        out, lse = _ring_fwd(q3, k3in, v3in)
-        return out, (q3, k3in, v3in, out, lse)
+    def run_fwd(q3, k3in, v3in, seed):
+        out, lse = _ring_fwd(q3, k3in, v3in, seed)
+        return out, (q3, k3in, v3in, seed, out, lse)
 
     def run_bwd(res, do3):
         # flash decomposition per shard pair with the GLOBAL lse: p =
@@ -183,7 +187,7 @@ def ring_attention(q, k, v, *, causal: bool = False,
         # pair contributes its exact dq/dk/dv.  dk/dv accumulators travel
         # WITH their K/V shard; after the final step one more rotation
         # brings every shard (and its grads) home.
-        q3, k3in, v3in, out, lse = res
+        q3, k3in, v3in, seed, out, lse = res
         my = jax.lax.axis_index(axis_name)
         dq = jnp.zeros_like(q3, dtype=jnp.float32)
         kv_dkv = (k3in, v3in,
@@ -194,7 +198,7 @@ def ring_attention(q, k, v, *, causal: bool = False,
                           jnp.zeros_like(v3in, dtype=jnp.float32))
         for t in range(cp):
             k3, v3, dk_acc, dv_acc = kv_dkv
-            s3 = _drop_seed3(my, t)
+            s3 = _drop_seed3(seed, my, t)
             if causal and t > 0:
                 # skip invisible pairs (see forward): avoids inf partials
                 # from exp(s - lse) on unbounded scores AND the FLOPs
@@ -215,7 +219,9 @@ def ring_attention(q, k, v, *, causal: bool = False,
             kv_dkv = jax.tree.map(rot, kv_dkv)   # cp rotations total
         _, _, dk, dv = kv_dkv
         return (dq.astype(q3.dtype), dk.astype(k3in.dtype),
-                dv.astype(v3in.dtype))
+                dv.astype(v3in.dtype), _zero_cotangent(seed))
 
     run.defvjp(run_fwd, run_bwd)
-    return run(q3, k3in, v3in).reshape(b, h, s_local, d)
+    seed_arr = (None if not dropout_rate
+                else jnp.asarray(dropout_seed, jnp.int32))
+    return run(q3, k3in, v3in, seed_arr).reshape(b, h, s_local, d)
