@@ -76,6 +76,38 @@ def test_split_backward_matches_fused(monkeypatch):
         np.testing.assert_allclose(a, b, atol=1e-5, err_msg=f"d{name}")
 
 
+def test_masked_plus_dropout_matches_oracle():
+    """Padding mask and prob dropout compose: kernel fwd and all grads
+    match the same-mask oracle, and fully-masked rows stay exactly zero
+    through the dropout rescale."""
+    q, k, v = _qkv(12, s=128)
+    # key-padding-style mask with one fully-masked query row per batch
+    mask = jnp.zeros((B, 1, 128, 128), bool)
+    mask = mask.at[:, :, 7, :].set(True)          # row 7 sees nothing
+    mask = mask.at[:, :, :, 100:].set(True)       # keys 100+ padded
+
+    def kfn(q, k, v):
+        return flash_attention(q, k, v, mask=mask, dropout_rate=RATE,
+                               dropout_seed=SEED, **BLOCKS)
+
+    def ofn(q, k, v):
+        return mha_reference(q, k, v, mask=mask, dropout_rate=RATE,
+                             dropout_seed=SEED)
+
+    out, ref = kfn(q, k, v), ofn(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-6)
+    assert bool(jnp.all(out[:, :, 7] == 0.0))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+    gk = jax.grad(loss(kfn), argnums=(0, 1, 2))(q, k, v)
+    go = jax.grad(loss(ofn), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gk, go):
+        np.testing.assert_allclose(a, b, atol=5e-6, err_msg=f"d{name}")
+    assert bool(jnp.all(gk[0][:, :, 7] == 0.0))   # masked row: zero dq
+
+
 def test_block_independent_and_large_bh():
     """The mask depends on global coordinates only: different kernel
     blockings agree bit-for-bit, and bh >= 3 works (a python-int bh

@@ -103,6 +103,7 @@ SLOW = {
     "tests/L0/run_attention/test_ulysses_attention.py::test_grads_match_full_attention",
     "tests/L0/run_attention/test_attention_dropout.py::test_split_backward_matches_fused",
     "tests/L0/run_attention/test_attention_dropout.py::test_ring_dropout_matches_unsharded",
+    "tests/L0/run_attention/test_attention_dropout.py::test_masked_plus_dropout_matches_oracle",
     "tests/L0/run_attention/test_attention_dropout.py::test_ulysses_dropout_reproducible_and_finite",
     "tests/L0/run_attention/test_attention_dropout.py::test_backward_regenerates_identical_mask",
     "tests/L0/run_attention/test_attention_dropout.py::test_forward_matches_masked_oracle[False]",
