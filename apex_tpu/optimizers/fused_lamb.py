@@ -5,8 +5,9 @@ Parity: ``apex/optimizers/fused_lamb.py :: FusedLAMB`` over
 (csrc/multi_tensor_lamb.cu).  Phase 1 (elementwise Adam-style direction) runs
 as one Pallas kernel over the flat buffer; per-tensor w/u norms and the
 global-grad-norm clip are static-sliced reductions XLA fuses; phase 2 applies
-``p -= lr * trust_ratio * u`` with the per-tensor ratio broadcast through a
-``jnp.repeat`` over static leaf sizes.
+``p -= lr * trust_ratio * u`` with the per-tensor ratio broadcast through
+static-slice concatenation (``broadcast_leaf_scalars`` — a gather-based
+``jnp.repeat`` costs seconds on TPU, see its docstring).
 
 Scope notes (shared verbatim by the torch-mode twin in
 ``_torch_mode.py`` — the two entry points are kept numerically
@@ -28,7 +29,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.fused_update import fused_lamb_phase1_flat
-from apex_tpu.optimizers.base import FusedOptimizerBase
+from apex_tpu.optimizers.base import FusedOptimizerBase, \
+    broadcast_leaf_scalars
 
 __all__ = ["FusedLAMB"]
 
@@ -66,8 +68,7 @@ def _lamb_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
                       jnp.float32(1.0))
     if use_nvlamb:
         ratio = w_norm / jnp.maximum(u_norm, 1e-12)
-    total = int(p.shape[0])
-    scale = jnp.repeat(ratio, jnp.asarray(sizes), total_repeat_length=total)
+    scale = broadcast_leaf_scalars(ratio, sizes)
     p_new = p - lr * scale * u
 
     skip = noop_flag > 0

@@ -13,7 +13,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers.base import FusedOptimizerBase
+from apex_tpu.optimizers.base import FusedOptimizerBase, \
+    broadcast_leaf_scalars
 
 __all__ = ["FusedNovoGrad"]
 
@@ -32,9 +33,7 @@ def _novograd_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
     first = step <= 1.0
     v_init = jnp.zeros_like(gsq) if init_zero else gsq
     v_new = jnp.where(first, v_init, beta2 * v + (1.0 - beta2) * gsq)
-    total = int(p.shape[0])
-    denom = jnp.repeat(jnp.sqrt(v_new) + eps, jnp.asarray(sizes),
-                       total_repeat_length=total)
+    denom = broadcast_leaf_scalars(jnp.sqrt(v_new) + eps, sizes)
     ghat = g32 / denom + weight_decay * p
     coef = (1.0 - beta1) if grad_averaging else 1.0
     m_new = beta1 * m + coef * ghat

@@ -1,0 +1,111 @@
+"""Decompose the BERT north-star leg's step time on-chip.
+
+Times, each in its own scan program (same harness as bench.py):
+  fwd-only, fwd+bwd, lamb-only.  (The full step is the bench.py bert
+  leg itself — run ``python bench.py --inner tpu --leg bert``.)
+Prints one JSON line.  Scratch diagnostic — not a bench artifact.
+"""
+import json
+import time
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+
+def rtt():
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.device_get(triv(jnp.float32(0)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(triv(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed(loop, args, iters, r):
+    jax.device_get(loop(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) - r) / iters
+
+
+def main():
+    from apex_tpu.optimizers.fused_lamb import _lamb_step
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import BertConfig, bert_model_provider
+
+    r = rtt()
+    cfg = BertConfig(max_seq_length=128, hidden_dropout=0.0,
+                     attention_dropout=0.0, params_dtype=jnp.bfloat16)
+    batch, seq, iters = 32, 128, 4
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    model = bert_model_provider(cfg, add_binary_head=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                cfg.vocab_size)
+    types = jnp.zeros((batch, seq), jnp.int32)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens, types,
+                        lm_labels=labels)
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    flat = flat.astype(jnp.float32)
+    sizes = tuple(int(np.prod(l.shape)) if l.ndim else 1
+                  for l in jax.tree.leaves(params))
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    out = {"n_params": int(flat.size), "n_leaves": len(sizes)}
+
+    def loss_fn(fp):
+        loss, _ = model.apply(unravel(fp), tokens, types, lm_labels=labels)
+        return loss
+
+    # 1. forward only
+    @jax.jit
+    def fwd_loop(fp):
+        def body(c, _):
+            return c + loss_fn(fp + c * 1e-30), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    out["fwd_ms"] = round(timed(fwd_loop, (flat,), iters, r) * 1e3, 2)
+    print("fwd", out["fwd_ms"], flush=True)
+
+    # 2. fwd + bwd
+    @jax.jit
+    def fb_loop(fp):
+        def body(c, _):
+            l, g = jax.value_and_grad(loss_fn)(fp + c * 1e-30)
+            return c + l + jnp.sum(g[:1]), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    out["fwd_bwd_ms"] = round(timed(fb_loop, (flat,), iters, r) * 1e3, 2)
+    print("fwd_bwd", out["fwd_bwd_ms"], flush=True)
+
+    # 3. lamb only (state carried)
+    g = jnp.ones_like(flat) * 1e-4
+
+    @jax.jit
+    def lamb_loop(state, g):
+        def body(state, _):
+            fp, m, v = state
+            return _lamb_step(
+                fp, m, v, g, jnp.float32(1), jnp.float32(1e-4),
+                jnp.float32(0.9), jnp.float32(0.999), jnp.float32(1e-6),
+                jnp.float32(0.01), jnp.float32(1.0), jnp.float32(0),
+                jnp.float32(1.0), bias_correction=True, offsets=offsets,
+                sizes=sizes, use_nvlamb=False), None
+        state, _ = jax.lax.scan(body, state, None, length=iters)
+        return jax.tree.map(lambda x: jnp.sum(x[:1]), state)
+    state = (flat, jnp.zeros_like(flat), jnp.zeros_like(flat))
+    out["lamb_ms"] = round(timed(lamb_loop, (state, g), iters, r) * 1e3, 2)
+    print("lamb", out["lamb_ms"], flush=True)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

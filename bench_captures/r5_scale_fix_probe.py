@@ -1,0 +1,93 @@
+"""Probe the repeat-free per-leaf broadcast + norms on-chip.
+Scratch diagnostic."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rtt():
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.device_get(triv(jnp.float32(0)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(triv(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed(loop, args, iters, r):
+    jax.device_get(loop(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) - r) / iters
+
+
+def main():
+    r = rtt()
+    iters = 4
+    n = 334_822_400
+    sizes = [31_254_528] + [1024 * 1024] * 96 + [4 * 1024 * 1024] * 48 + \
+        [1024] * 151
+    sizes.append(n - sum(sizes))
+    sizes = tuple(sizes)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    out = {}
+
+    p = jnp.ones((n,), jnp.float32)
+    u = jnp.full((n,), 1e-4, jnp.float32)
+    ratio = jnp.ones((len(sizes),), jnp.float32)
+
+    # A. full trust-ratio apply via concat of per-leaf broadcasts
+    @jax.jit
+    def apply_loop(p, u, ratio):
+        def body(p, _):
+            pieces = [
+                jax.lax.dynamic_slice_in_dim(p, o, s)
+                - 1e-4 * ratio[i] * jax.lax.dynamic_slice_in_dim(u, o, s)
+                for i, (o, s) in enumerate(zip(offsets, sizes))]
+            return jnp.concatenate(pieces), None
+        p, _ = jax.lax.scan(body, p, None, length=iters)
+        return jnp.sum(p[:1])
+    out["apply_concat_ms"] = round(
+        timed(apply_loop, (p, u, ratio), iters, r) * 1e3, 2)
+    print("apply_concat", out["apply_concat_ms"], flush=True)
+
+    # B. scale vector built via concat of broadcast_to, then vector math
+    @jax.jit
+    def scale_loop(p, u, ratio):
+        def body(p, _):
+            scale = jnp.concatenate([
+                jnp.broadcast_to(ratio[i], (s,))
+                for i, s in enumerate(sizes)])
+            return p - 1e-4 * scale * u, None
+        p, _ = jax.lax.scan(body, p, None, length=iters)
+        return jnp.sum(p[:1])
+    out["scale_concat_ms"] = round(
+        timed(scale_loop, (p, u, ratio), iters, r) * 1e3, 2)
+    print("scale_concat", out["scale_concat_ms"], flush=True)
+
+    # C. per-leaf sq-norms via static slices, ALL used (stacked)
+    @jax.jit
+    def norms_loop(p):
+        def body(c, _):
+            x = p + c * 1e-30
+            nrm = jnp.stack([
+                jnp.sum(jnp.square(jax.lax.dynamic_slice_in_dim(x, o, s)))
+                for o, s in zip(offsets, sizes)])
+            return c + jnp.sum(nrm) * 1e-30, None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    out["norms_all_ms"] = round(timed(norms_loop, (p,), iters, r) * 1e3, 2)
+    print("norms_all", out["norms_all_ms"], flush=True)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
