@@ -25,6 +25,7 @@ from apex_tpu.ops.fused_update import (
     fused_adam_flat,
     fused_lamb_phase1_flat,
 )
+from apex_tpu.optimizers.base import broadcast_leaf_scalars
 from apex_tpu.utils import cdiv, tree_ravel
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
@@ -139,10 +140,11 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
 
     The reference computes exact per-tensor norms across shards
     (``multi_tensor_l2norm`` + group allreduce); here each shard computes
-    per-tensor partial sums of squares with a segment-sum over the leaf
-    layout (segment ids derived on device via ``searchsorted`` on the
-    static leaf offsets — no O(n) host arrays), psum'd over the data axis
-    — same math, one collective, EXACT per-tensor trust ratios.
+    per-tensor partial sums of squares over the static leaf-span layout
+    (a ``lax.switch`` over ranks keeps every slice static — per-element
+    gathers measure seconds on TPU, see ``_shard_leaf_spans``), psum'd
+    over the data axis — same math, one collective, EXACT per-tensor
+    trust ratios.
     """
 
     _state_keys = ("exp_avg", "exp_avg_sq")
@@ -163,20 +165,31 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
         self.grad_average = grad_average
         self.use_nvlamb = use_nvlamb
 
-    def _shard_segment_ids(self, leaves, n: int):
-        """Per-element tensor ids for MY shard of the padded flat buffer.
+    def _shard_leaf_spans(self, sizes, n: int):
+        """Static leaf spans per rank: ``spans[r]`` lists
+        ``(leaf_id, lo, hi)`` — the intersection of each leaf's
+        ``[offset, offset+size)`` with rank r's padded shard window, in
+        shard-local coordinates.  The padding tail is covered by no span.
 
-        Leaf boundaries are static; my shard's offset is dynamic
-        (axis_index), so ids come from ``searchsorted`` of the positions
-        against the cumulative leaf ends.  Padding tail gets id
-        ``n_tensors`` (an extra dropped segment)."""
-        sizes = [int(l.size) for l in leaves]
-        ends = jnp.asarray(
-            [sum(sizes[:i + 1]) for i in range(len(sizes))], jnp.int32)
+        Leaf boundaries AND the shard length are static, so every rank's
+        spans are plain Python — only *which* rank we are is dynamic, and
+        a ``lax.switch`` over ranks keeps every slice static.  This is
+        load-bearing for TPU: per-element gathers (``segment_sum`` /
+        ``trust[seg]``) over a BERT-large-sized shard measure seconds per
+        call (see ``broadcast_leaf_scalars``), while static slices +
+        concat are copies."""
         shard_len = self._padded(n) // self.dp
-        idx = jax.lax.axis_index(self.axis_name) if self.dp > 1 else 0
-        pos = idx * shard_len + jnp.arange(shard_len, dtype=jnp.int32)
-        return jnp.searchsorted(ends, pos, side="right"), len(sizes)
+        offs = [0]
+        for s in sizes:
+            offs.append(offs[-1] + s)
+        spans = []
+        for r in range(self.dp):
+            start, end = r * shard_len, (r + 1) * shard_len
+            rs = [(i, max(o, start) - start, min(o + s, end) - start)
+                  for i, (o, s) in enumerate(zip(offs, sizes))
+                  if min(o + s, end) > max(o, start)]
+            spans.append(rs)
+        return spans, shard_len
 
     def step(self, state: dict, grads, *, lr: Optional[float] = None,
              noop_flag=0.0, grad_scale=1.0):
@@ -201,24 +214,58 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
             bias_correction=self.bias_correction, grad_scale=grad_scale)
         # EXACT per-tensor trust ratios (reference: multi_tensor_l2norm per
         # tensor + group allreduce): shard-local per-tensor partial sq-sums
-        # via segment_sum, psum over dp, ratio gathered back per element.
+        # over static leaf spans (lax.switch over ranks — no per-element
+        # gathers, see _shard_leaf_spans), psum over dp, per-tensor ratio
+        # broadcast back through static-slice concatenation.
         p32 = state["master"]
-        seg, n_tensors = self._shard_segment_ids(leaves, n)
-        psq = jax.ops.segment_sum(jnp.square(p32), seg,
-                                  num_segments=n_tensors + 1)
-        usq = jax.ops.segment_sum(jnp.square(u), seg,
-                                  num_segments=n_tensors + 1)
+        sizes = [int(l.size) for l in leaves]
+        n_tensors = len(sizes)
+        spans, shard_len = self._shard_leaf_spans(sizes, n)
+        idx = jax.lax.axis_index(self.axis_name) if self.dp > 1 else 0
+
+        def _norms_branch(rs):
+            def f(pu):
+                p_, u_ = pu
+                out = []
+                for vec in (p_, u_):
+                    row = [jnp.float32(0.0)] * n_tensors
+                    for i, lo, hi in rs:
+                        row[i] = jnp.sum(jnp.square(
+                            jax.lax.dynamic_slice_in_dim(vec, lo, hi - lo)))
+                    out.append(jnp.stack(row))
+                return jnp.stack(out)
+            return f
+
         if self.dp > 1:
-            psq = jax.lax.psum(psq, self.axis_name)
-            usq = jax.lax.psum(usq, self.axis_name)
+            sq = jax.lax.switch(idx, [_norms_branch(rs) for rs in spans],
+                                (p32, u))
+            sq = jax.lax.psum(sq, self.axis_name)
+        else:
+            sq = _norms_branch(spans[0])((p32, u))
+        psq, usq = sq[0], sq[1]
         pnorm, unorm = jnp.sqrt(psq), jnp.sqrt(usq)
         if self.use_nvlamb:
             trust = pnorm / jnp.maximum(unorm, 1e-12)
         else:
             trust = jnp.where((pnorm > 0) & (unorm > 0), pnorm / unorm, 1.0)
-        trust = trust.at[n_tensors].set(1.0)   # padding segment
-        lr_t = (self.lr if lr is None else lr) * trust[seg]
-        p = p32 - lr_t * u
+
+        def _scale_branch(rs):
+            def f(trust):
+                vals = [trust[i] for i, _, _ in rs]
+                span_sizes = [hi - lo for _, lo, hi in rs]
+                covered = sum(span_sizes)
+                if covered < shard_len:     # padding tail: ratio 1
+                    vals.append(jnp.float32(1.0))
+                    span_sizes.append(shard_len - covered)
+                return broadcast_leaf_scalars(jnp.stack(vals), span_sizes)
+            return f
+
+        if self.dp > 1:
+            scale = jax.lax.switch(
+                idx, [_scale_branch(rs) for rs in spans], trust)
+        else:
+            scale = _scale_branch(spans[0])(trust)
+        p = p32 - (self.lr if lr is None else lr) * scale * u
         skip = jnp.asarray(noop_flag, jnp.float32) > 0
         p = jnp.where(skip, p32, p)
         m = jnp.where(skip, state["exp_avg"], m)

@@ -701,12 +701,58 @@ def _plan_block(s: int, preferred: int):
     return _fit_block(s_pad, preferred), s_pad
 
 
+#: measured kernel/XLA crossover on v5e (bench_captures/
+#: r5_attn_crossover.py, fwd+bwd, h=16 d=64): at s=128 the Pallas grid
+#: degenerates to b*h tiny programs and Mosaic dispatch dominates —
+#: 828 µs vs 119 µs for plain XLA einsum attention; at s=256 it is
+#: 707 vs 379; from s=512 the kernel wins (777 vs 2033, and 4.3x at
+#: s=2048).  Auto-dispatch sends padded-seq <= 256 to the XLA path.
+_XLA_PATH_MAX_SEQ = 256
+
+
+def _xla_attention(q, k, v, *, causal, scale, mask, rate, seed):
+    """Short-sequence attention as plain XLA ops — same semantics as the
+    kernels (True-=-masked boolean mask, fully-masked rows emit zeros,
+    the identical coordinate-hash probability dropout), but lowered to
+    one batched einsum chain XLA fuses well at small ``s``.
+
+    Numerics mirror the kernel: bf16 operands into the MXU with fp32
+    accumulation (``preferred_element_type``), softmax in fp32, the
+    probability matrix cast back to ``v.dtype`` for the PV dot."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = jax.lax.dot_general(
+        q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(cols <= rows + (sk - sq), s, _NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask, _NEG_INF, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    if causal or mask is not None:
+        p = jnp.where(m <= _MASKED_ROW_THRESH, 0.0, p)
+    if rate:
+        keep = _keep_mask(jnp.asarray(seed, jnp.int32),
+                          jnp.arange(b * h, dtype=jnp.int32)[:, None, None],
+                          0, 0, sq, sk, rate).reshape(b, h, sq, sk)
+        p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - rate))
+    out = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                     sm_scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     dropout_rate: float = 0.0,
-                    dropout_seed=None):
+                    dropout_seed=None,
+                    use_kernel: Optional[bool] = None):
     """Fused blockwise attention, ``[b, h, s, d]`` layout.
 
     Drop-in fused path for the reference's ``fmhalib`` /
@@ -716,6 +762,14 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     multiple and masked inside the kernel — the kernel path is taken for
     EVERY shape (the reference kernels instead refuse such shapes; the
     old behavior here was a silent O(s²) oracle fallback).
+
+    ``use_kernel=None`` auto-dispatches: on TPU backends, sequences at
+    or under ``_XLA_PATH_MAX_SEQ`` (measured crossover — see its note)
+    run as one fused XLA einsum chain instead of the Pallas kernels;
+    identical semantics including the dropout mask stream.  Explicit
+    ``block_q``/``block_k`` forces the kernel (the caller is tuning
+    it), as does ``use_kernel=True``; non-TPU backends always take the
+    kernel so interpret-mode tests exercise kernel code.
 
     ``dropout_rate`` > 0 drops attention *probabilities* in-kernel (the
     reference's philox softmax+dropout fusion; see the module
@@ -732,13 +786,21 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     if not 0.0 <= dropout_rate < 1.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got "
                          f"{dropout_rate}")
+    if dropout_rate and dropout_seed is None:
+        raise ValueError(
+            "dropout_rate > 0 requires dropout_seed (reusing an "
+            "implicit constant seed would repeat the same mask "
+            "every training step)")
+    if use_kernel is None:
+        use_kernel = (block_q is not None or block_k is not None
+                      or max(sq, sk) > _XLA_PATH_MAX_SEQ
+                      or jax.default_backend() not in ("tpu", "axon"))
+    if not use_kernel:
+        return _xla_attention(q, k, v, causal=causal, scale=scale,
+                              mask=mask, rate=dropout_rate,
+                              seed=dropout_seed)
     seed3 = None
     if dropout_rate:
-        if dropout_seed is None:
-            raise ValueError(
-                "dropout_rate > 0 requires dropout_seed (reusing an "
-                "implicit constant seed would repeat the same mask "
-                "every training step)")
         seed3 = _seed_operand(dropout_seed)
     # default 1024x1024 blocks: measured ~21% faster fwd+bwd than
     # 512x512 at [*, 16, 1024-2048, 64] on v5e (fewer online-softmax

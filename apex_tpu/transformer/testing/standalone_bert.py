@@ -106,10 +106,13 @@ class BertModel(nn.Module):
             mask4 = ~keep[:, None, None, :]
             mask4 = jnp.broadcast_to(mask4, (b, 1, s, s))
 
+        block = ParallelTransformerLayer
+        if cfg.remat:
+            # same wrapping as GPTModel.setup: deterministic is static
+            block = nn.remat(block, static_argnums=(3,))
         for i in range(cfg.num_layers):
-            h = ParallelTransformerLayer(
-                gcfg, causal=False, name=f"layer_{i}")(
-                    h, mask4, deterministic)
+            h = block(gcfg, causal=False, name=f"layer_{i}")(
+                h, mask4, deterministic)
         if cfg.sequence_parallel:
             h = mappings.gather_from_sequence_parallel_region(
                 h, tensor_parallel_output_grad=False)

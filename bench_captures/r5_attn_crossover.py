@@ -1,0 +1,68 @@
+"""Measure flash-kernel vs XLA-attention crossover over seq length at
+fixed tokens (b*s = 4096, h=16, d=64) and at fixed batch.  Scratch."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def rtt():
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.device_get(triv(jnp.float32(0)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(triv(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed(loop, args, iters, r):
+    jax.device_get(loop(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) - r) / iters
+
+
+def bench_grad(f, args, iters, r):
+    def loss(*a):
+        return jnp.sum(f(*a).astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def loop(args):
+        def body(c, _):
+            a0 = args[0] + jnp.asarray(c, args[0].dtype) * 1e-30
+            gs = jax.grad(loss, argnums=(0, 1, 2))(a0, *args[1:])
+            bump = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+            return c + bump * 1e-30, None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    return round(timed(loop, (args,), iters, r) * 1e6, 1)
+
+
+def main():
+    from apex_tpu.ops.attention import flash_attention, mha_reference
+    r = rtt()
+    rows = []
+    for s, batch, iters in ((128, 32, 100), (256, 16, 60), (512, 8, 40),
+                            (1024, 4, 20), (2048, 4, 10)):
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i),
+                                     (batch, 16, s, 64), jnp.bfloat16)
+                   for i in range(3))
+        for causal in (False, True):
+            fl = bench_grad(lambda q, k, v, c=causal: flash_attention(
+                q, k, v, causal=c), (q, k, v), iters, r)
+            rf = bench_grad(lambda q, k, v, c=causal: mha_reference(
+                q, k, v, causal=c), (q, k, v), iters, r)
+            rows.append({"s": s, "b": batch, "causal": causal,
+                         "flash_us": fl, "ref_us": rf})
+            print(rows[-1], flush=True)
+    print(json.dumps(rows), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Full BERT fwd+bwd: native-pytree params vs flat-fp32-master unravel.
+Isolates the cost of the master-vector indirection.  Scratch.
+Run one variant at a time: MODE=tree|flat."""
+import json
+import os
+import time
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+
+def rtt():
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.device_get(triv(jnp.float32(0)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(triv(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed(loop, args, iters, r):
+    jax.device_get(loop(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) - r) / iters
+
+
+def main():
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import BertConfig, bert_model_provider
+
+    r = rtt()
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    batch, seq, iters = 32, 128, 4
+    cfg = BertConfig(max_seq_length=seq, hidden_dropout=0.0,
+                     attention_dropout=0.0, params_dtype=jnp.bfloat16)
+    model = bert_model_provider(cfg, add_binary_head=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq), 0,
+                                cfg.vocab_size)
+    types = jnp.zeros((batch, seq), jnp.int32)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
+                                cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens, types,
+                        lm_labels=labels)
+    out = {}
+
+    mode = os.environ.get("MODE", "tree")
+
+    def loss_tree(p):
+        loss, _ = model.apply(p, tokens, types, lm_labels=labels)
+        return loss
+
+    @jax.jit
+    def tree_loop(params):
+        def body(c, _):
+            bump = jax.tree.map(
+                lambda x: x * (1 + jnp.asarray(c, x.dtype) * 1e-30), params)
+            l, g = jax.value_and_grad(loss_tree)(bump)
+            gn = sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                     for x in jax.tree.leaves(g))
+            return c + l * 0 + gn * 1e-30, None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    if mode == "tree":
+        out["tree_fwd_bwd_ms"] = round(
+            timed(tree_loop, (params,), iters, r) * 1e3, 2)
+        print(json.dumps(out), flush=True)
+        return
+
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    flat = flat.astype(jnp.float32)
+
+    def loss_flat(fp):
+        return loss_tree(unravel(fp))
+
+    @jax.jit
+    def flat_loop(fp):
+        def body(c, _):
+            l, g = jax.value_and_grad(loss_flat)(fp + c * 1e-30)
+            return c + l * 0 + jnp.sum(g * g) * 1e-30, None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    out["flat_fwd_bwd_ms"] = round(
+        timed(flat_loop, (flat,), iters, r) * 1e3, 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

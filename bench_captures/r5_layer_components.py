@@ -1,0 +1,105 @@
+"""Time BERT-layer components fwd+bwd in isolation at the north-star
+shape (b=32, s=128, h=1024, heads=16).  Scratch diagnostic."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def rtt():
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.device_get(triv(jnp.float32(0)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(triv(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed(loop, args, iters, r):
+    jax.device_get(loop(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) - r) / iters
+
+
+def bench_grad(f, args, iters, r):
+    """us per fwd+bwd of f(*args) (sum-of-squares loss, full grads)."""
+    def loss(*a):
+        return jnp.sum(f(*a).astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def loop(args):
+        def body(c, _):
+            a0 = args[0] + jnp.asarray(c, args[0].dtype) * 1e-30
+            gs = jax.grad(loss, argnums=tuple(range(len(args))))(
+                a0, *args[1:])
+            bump = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+            return c + bump * 1e-30, None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    return round(timed(loop, (args,), iters, r) * 1e6, 1)
+
+
+def main():
+    from apex_tpu.ops.attention import flash_attention, mha_reference
+    from apex_tpu.ops.layer_norm import layer_norm
+    r = rtt()
+    iters = 100
+    out = {}
+    b, s, h, nh, d = 32, 128, 1024, 16, 64
+    key = jax.random.PRNGKey(0)
+
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, nh, s, d),
+                                 jnp.bfloat16) for i in range(3))
+    out["flash_us"] = bench_grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=False), (q, k, v),
+        iters, r)
+    print("flash", out["flash_us"], flush=True)
+    out["mha_ref_us"] = bench_grad(
+        lambda q, k, v: mha_reference(q, k, v, causal=False), (q, k, v),
+        iters, r)
+    print("mha_ref", out["mha_ref_us"], flush=True)
+
+    x = jax.random.normal(key, (s * b, h), jnp.bfloat16)
+    gam = jnp.ones((h,), jnp.float32)
+    bet = jnp.zeros((h,), jnp.float32)
+    out["fused_ln_us"] = bench_grad(
+        lambda x, g, b_: layer_norm(x, g, b_), (x, gam, bet), iters, r)
+    print("fused_ln", out["fused_ln_us"], flush=True)
+
+    def jnp_ln(x, g, b_):
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * g + b_).astype(
+            x.dtype)
+    out["jnp_ln_us"] = bench_grad(jnp_ln, (x, gam, bet), iters, r)
+    print("jnp_ln", out["jnp_ln_us"], flush=True)
+
+    # the layer's three matmuls, fused into one fn (qkv, out-proj, mlp x2)
+    wqkv = jax.random.normal(jax.random.PRNGKey(4), (h, 3 * h),
+                             jnp.bfloat16) * 0.02
+    wo = jax.random.normal(jax.random.PRNGKey(5), (h, h), jnp.bfloat16) * .02
+    w1 = jax.random.normal(jax.random.PRNGKey(6), (h, 4 * h),
+                           jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(jax.random.PRNGKey(7), (4 * h, h),
+                           jnp.bfloat16) * 0.02
+
+    def matmuls(x, wqkv, wo, w1, w2):
+        a = x @ wqkv
+        bqv = a[:, :h] @ wo
+        c = jax.nn.gelu(x @ w1)
+        return bqv + c @ w2
+    out["matmuls_us"] = bench_grad(matmuls, (x, wqkv, wo, w1, w2), iters, r)
+    print("matmuls", out["matmuls_us"], flush=True)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Time the real layer modules fwd+bwd at the north-star shape:
+ParallelTransformerLayer, ParallelAttention, ParallelMLP, FusedLayerNorm.
+Scratch diagnostic."""
+import json
+import time
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+
+def rtt():
+    triv = jax.jit(lambda x: x + 1.0)
+    jax.device_get(triv(jnp.float32(0)))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(triv(jnp.float32(1)))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def timed(loop, args, iters, r):
+    jax.device_get(loop(*args))
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(loop(*args))
+        samples.append(time.perf_counter() - t0)
+    return (min(samples) - r) / iters
+
+
+def bench_module(model, params, x, iters, r, extra=()):
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+
+    def loss(fp, x):
+        out = model.apply(unravel(fp), x, *extra)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def loop(fp, x):
+        def body(c, _):
+            l, gs = jax.value_and_grad(loss, argnums=(0, 1))(
+                fp, x + jnp.asarray(c, x.dtype) * 1e-30)
+            bump = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in gs)
+            return c + bump * 1e-30 + l * 0, None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+    return round(timed(loop, (flat, x), iters, r) * 1e6, 1)
+
+
+def main():
+    from apex_tpu.normalization import FusedLayerNorm
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import BertConfig
+    from apex_tpu.transformer.testing.standalone_gpt import (
+        ParallelAttention, ParallelMLP, ParallelTransformerLayer)
+
+    r = rtt()
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    iters = 50
+    b, s = 32, 128
+    cfg = BertConfig(max_seq_length=s, hidden_dropout=0.0,
+                     attention_dropout=0.0,
+                     params_dtype=jnp.bfloat16).gpt_cfg()
+    x = jax.random.normal(jax.random.PRNGKey(0), (s, b, cfg.hidden_size),
+                          jnp.bfloat16)
+    out = {}
+
+    layer = ParallelTransformerLayer(cfg, causal=False)
+    p = layer.init(jax.random.PRNGKey(1), x)
+    out["layer_us"] = bench_module(layer, p, x, iters, r)
+    print("layer", out["layer_us"], flush=True)
+
+    attn = ParallelAttention(cfg, causal=False)
+    p = attn.init(jax.random.PRNGKey(1), x)
+    out["attention_us"] = bench_module(attn, p, x, iters, r)
+    print("attention", out["attention_us"], flush=True)
+
+    mlp = ParallelMLP(cfg)
+    p = mlp.init(jax.random.PRNGKey(1), x)
+    out["mlp_us"] = bench_module(mlp, p, x, iters, r)
+    print("mlp", out["mlp_us"], flush=True)
+
+    ln = FusedLayerNorm(normalized_shape=cfg.hidden_size)
+    p = ln.init(jax.random.PRNGKey(1), x)
+    out["ln_us"] = bench_module(ln, p, x, iters, r)
+    print("ln", out["ln_us"], flush=True)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
