@@ -39,8 +39,11 @@ EXPERIMENTS = [
     ("gpt_batch16", ["--leg", "main", "--override", "batch=16"], 2400),
     ("gpt_batch24", ["--leg", "main", "--override", "batch=24"], 2400),
     ("bert_batch16", ["--leg", "bert", "--override", "batch=16"], 900),
+    # batch 48 projected ~13 GB — the largest no-remat fit
+    ("bert_batch48", ["--leg", "bert", "--override", "batch=48"], 1200),
     # batch 64 without remat OOMs (measured r5: 16.44 G vs 15.75 G HBM);
-    # remat=1 rematerializes the layers to fit
+    # remat=1 rematerializes the layers to fit (costs ~+fwd FLOPs — only
+    # wins if the bigger GEMMs beat the recompute)
     ("bert_batch64_remat", ["--leg", "bert", "--override", "batch=64",
                             "--override", "remat=1"], 1200),
     ("attn_block1024", ["--leg", "attn"], 900),
