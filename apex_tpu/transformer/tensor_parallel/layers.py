@@ -34,11 +34,13 @@ TPU-native notes:
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
@@ -257,17 +259,54 @@ class RowParallelLinear(nn.Module):
         return output, b
 
 
+@functools.lru_cache(maxsize=None)
+def _embedding_lookup_matmul_grad(vocab: int, dtype_str: str):
+    """``take(weight, ids)`` whose backward builds the table grad as a
+    one-hot × dy matmul instead of XLA's scatter-add.  TPU scatters
+    serialize per update row; the one-hot contraction is one MXU pass
+    (fp32 accumulate) over work XLA can also fuse the comparison into.
+    Opt-in via ``VocabParallelEmbedding(grad_via_matmul=True)`` pending
+    the on-chip A/B (bench_captures/r5_experiments.py).
+
+    A factory (cached per (vocab, dtype)) because custom_vjp residuals
+    must be JAX types — the static table shape/dtype ride the closure."""
+    wdtype = jnp.dtype(dtype_str)
+
+    @jax.custom_vjp
+    def lookup(weight, ids):
+        return jnp.take(weight, ids, axis=0)
+
+    def fwd(weight, ids):
+        return jnp.take(weight, ids, axis=0), ids
+
+    def bwd(ids, dy):
+        flat_ids = ids.reshape(-1)
+        dyf = dy.reshape(-1, dy.shape[-1])
+        onehot = jax.nn.one_hot(flat_ids, vocab, dtype=dyf.dtype)
+        dw = jax.lax.dot_general(onehot, dyf, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return (dw.astype(wdtype),
+                np.zeros(np.shape(ids), jax.dtypes.float0))
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
 class VocabParallelEmbedding(nn.Module):
     """Embedding with vocab rows sharded over TP (reference:
     ``VocabParallelEmbedding``): out-of-range token ids are masked to 0,
     looked up locally, zeroed, and psum'd — one allreduce, no gather of the
-    embedding table."""
+    embedding table.
+
+    ``grad_via_matmul`` swaps the backward's scatter-add for a one-hot
+    MXU contraction (see ``_embedding_lookup_matmul_grad``)."""
     num_embeddings: int
     embedding_dim: int
     init_method: Callable = nn.initializers.normal(stddev=0.02)
     params_dtype: Any = jnp.float32
     use_cpu_initialization: bool = False
     axis_name: str = TENSOR_AXIS
+    grad_via_matmul: bool = False
 
     @nn.compact
     def __call__(self, input_):
@@ -276,14 +315,18 @@ class VocabParallelEmbedding(nn.Module):
         weight = self.param(
             "weight", _shard_init(self.init_method, self.axis_name, world),
             (per_partition, self.embedding_dim), self.params_dtype)
+        lookup = (_embedding_lookup_matmul_grad(
+            per_partition, jnp.dtype(self.params_dtype).name)
+            if self.grad_via_matmul
+            else (lambda w, i: jnp.take(w, i, axis=0)))
         if world == 1:
-            return jnp.take(weight, input_, axis=0)
+            return lookup(weight, input_)
         rank = jax.lax.axis_index(self.axis_name)
         start, _ = VocabUtility.vocab_range_from_per_partition_vocab_size(
             per_partition, rank, world)
         input_mask = (input_ < start) | (input_ >= start + per_partition)
         masked_input = jnp.clip(input_ - start, 0, per_partition - 1)
-        output_parallel = jnp.take(weight, masked_input, axis=0)
+        output_parallel = lookup(weight, masked_input)
         output_parallel = jnp.where(
             input_mask[..., None], 0.0, output_parallel)
         return mappings.reduce_from_tensor_model_parallel_region(

@@ -49,6 +49,7 @@ class BertConfig:
     params_dtype: Any = jnp.float32
     sequence_parallel: bool = False
     remat: bool = False
+    embedding_grad_via_matmul: bool = False
 
     def gpt_cfg(self) -> GPTConfig:
         return GPTConfig(
@@ -61,7 +62,8 @@ class BertConfig:
             attention_dropout=self.attention_dropout,
             params_dtype=self.params_dtype,
             sequence_parallel=self.sequence_parallel,
-            remat=self.remat)
+            remat=self.remat,
+            embedding_grad_via_matmul=self.embedding_grad_via_matmul)
 
 
 class BertModel(nn.Module):
@@ -80,6 +82,7 @@ class BertModel(nn.Module):
 
         word = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, params_dtype=cfg.params_dtype,
+            grad_via_matmul=cfg.embedding_grad_via_matmul,
             name="word_embeddings")(tokens)
         pos = self.param(
             "position_embeddings", nn.initializers.normal(stddev=0.02),

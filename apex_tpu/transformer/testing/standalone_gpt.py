@@ -67,6 +67,9 @@ class GPTConfig:
     context_parallel: bool = False             # ring attention over 'context'
     remat: bool = False                        # jax.checkpoint per layer
     scan_layers: bool = False                  # lax.scan over layers
+    # embedding-table grad as a one-hot MXU matmul instead of XLA's
+    # scatter-add (see VocabParallelEmbedding.grad_via_matmul)
+    embedding_grad_via_matmul: bool = False
     # MoE (beyond reference parity; Megatron-core arg names): replace the
     # dense FFN with num_moe_experts top-k routed experts.  With
     # expert_model_parallel the experts shard over the mesh's 'expert'
@@ -305,6 +308,7 @@ class GPTEmbedding(nn.Module):
         # tokens: [b, s] -> hidden [s, b, h]
         emb = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, params_dtype=cfg.params_dtype,
+            grad_via_matmul=cfg.embedding_grad_via_matmul,
             name="word_embeddings")(tokens)
         pos = self.param(
             "position_embeddings", nn.initializers.normal(stddev=0.02),

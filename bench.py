@@ -470,7 +470,9 @@ def _microbench_bert(rtt: float, on_tpu: bool):
     if on_tpu:
         cfg = BertConfig(max_seq_length=128, hidden_dropout=0.0,
                          attention_dropout=0.0, params_dtype=jnp.bfloat16,
-                         remat=bool(_ov("remat", 0)))
+                         remat=bool(_ov("remat", 0)),
+                         embedding_grad_via_matmul=bool(
+                             _ov("emb_matmul_grad", 0)))
         batch, seq, iters = _ov("batch", 32), 128, _ov("iters", 8)
     else:
         cfg = BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,

@@ -46,6 +46,9 @@ EXPERIMENTS = [
     # wins if the bigger GEMMs beat the recompute)
     ("bert_batch64_remat", ["--leg", "bert", "--override", "batch=64",
                             "--override", "remat=1"], 1200),
+    # embedding-table grad: one-hot MXU matmul vs XLA scatter-add
+    ("bert_emb_matmul_grad", ["--leg", "bert", "--override",
+                              "emb_matmul_grad=1"], 900),
     ("attn_block1024", ["--leg", "attn"], 900),
     ("attn_block512", ["--leg", "attn", "--override", "block_q=512",
                        "--override", "block_k=512"], 900),

@@ -141,3 +141,33 @@ def test_param_attribute_helpers():
     r = types.SimpleNamespace()
     tensor_parallel.set_defaults_if_not_set_tensor_model_parallel_attributes(r)
     assert r.tensor_model_parallel is False and r.partition_dim == -1
+
+
+def test_vocab_parallel_embedding_matmul_grad_matches_scatter():
+    """grad_via_matmul must reproduce the scatter-add table grad exactly
+    (fp32 here; the one-hot MXU contraction and the scatter sum the same
+    dy rows per vocab id)."""
+    vocab, dim = 16, 8
+    tokens = jax.random.randint(jax.random.key(7), (BATCH, 5), 0, vocab)
+    mesh = parallel_state.get_mesh()
+    grads = {}
+    for via_matmul in (False, True):
+        emb = tensor_parallel.VocabParallelEmbedding(
+            vocab, dim, grad_via_matmul=via_matmul)
+
+        def body(tokens):
+            params = emb.init(jax.random.key(6), tokens)
+
+            def loss(p):
+                y = emb.apply(p, tokens)
+                return jnp.sum(y * (1.0 + jnp.arange(dim)))
+
+            return jax.grad(loss)(params)["params"]["weight"]
+
+        grads[via_matmul] = np.asarray(jax.jit(functools.partial(
+            jax.shard_map, check_vma=False)(
+                body, mesh=mesh, in_specs=(P(),),
+                out_specs=P("tensor")))(tokens))
+    np.testing.assert_allclose(grads[True], grads[False],
+                               rtol=1e-5, atol=1e-5)
+    assert np.abs(grads[True]).sum() > 0      # grads actually flowed
