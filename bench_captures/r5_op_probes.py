@@ -127,6 +127,30 @@ def main():
         scan_loop(roundtrip, 1, iters), (x,), iters, r)
     print("transpose", out["transpose_roundtrip_us"], flush=True)
 
+    # 3b. LayerNorm fwd+bwd at the BERT per-layer shape: Pallas kernel
+    # vs plain-XLA LN (grad through both; the layer runs ~50 LN
+    # kernel-pairs per step so fixed overheads multiply)
+    from apex_tpu.ops.layer_norm import layer_norm, layer_norm_reference
+    xln = jax.random.normal(jax.random.PRNGKey(5), (s * b, h), jnp.bfloat16)
+    gam = jnp.ones((h,), jnp.float32)
+    bet = jnp.zeros((h,), jnp.float32)
+
+    def ln_grad(impl):
+        def f(x, g_, b_):
+            def loss(x, g_, b_):
+                return jnp.sum(impl(x, g_, b_).astype(jnp.float32) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(x, g_, b_)
+        return f
+
+    out["ln_fused_us"] = timed_us(
+        scan_loop(ln_grad(layer_norm), 3, iters), (xln, gam, bet),
+        iters, r)
+    print("ln_fused", out["ln_fused_us"], flush=True)
+    out["ln_xla_us"] = timed_us(
+        scan_loop(ln_grad(layer_norm_reference), 3, iters),
+        (xln, gam, bet), iters, r)
+    print("ln_xla", out["ln_xla_us"], flush=True)
+
     # 4. flat-master unravel + grad ravel at BERT-large size
     n_leaves = 297
     sizes = [31_254_528] + [1024 * 1024] * 96 + [4 * 1024 * 1024] * 48 + \
@@ -153,6 +177,21 @@ def main():
     out["unravel_plus_ravel_us"] = timed_us(
         scan_loop(ravel_fn, 1, it2), (flat32,), it2, r)
     print("unravel+ravel", out["unravel_plus_ravel_us"], flush=True)
+
+    # 4b. the GRAD of unravel — the flat-master pattern differentiates
+    # through it, whose transpose is a 297-way pad+add chain over the
+    # full flat buffer; if XLA doesn't fuse that into one pass, this is
+    # the in-model overhead the isolated layers don't show
+    def unravel_grad_fn(fp):
+        def loss(fp):
+            t = unravel(fp)
+            return sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                       for x in jax.tree.leaves(t))
+        return jax.grad(loss)(fp)
+
+    out["unravel_grad_us"] = timed_us(
+        scan_loop(unravel_grad_fn, 1, it2), (flat32,), it2, r)
+    print("unravel_grad", out["unravel_grad_us"], flush=True)
     print(json.dumps(out), flush=True)
 
 
