@@ -497,25 +497,52 @@ def _microbench_bert(rtt: float, on_tpu: bool):
                   for l in jax.tree.leaves(params))
     offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
 
-    def step(state, batch_args):
-        fp, m, v = state
-        tokens, types, labels = batch_args
+    lamb_args = (jnp.float32(1), jnp.float32(1e-4), jnp.float32(0.9),
+                 jnp.float32(0.999), jnp.float32(1e-6), jnp.float32(0.01),
+                 jnp.float32(1.0), jnp.float32(0), jnp.float32(1.0))
+    lamb_kw = dict(bias_correction=True, offsets=offsets, sizes=sizes,
+                   use_nvlamb=False)
 
-        def loss_fn(fp):
-            loss, _ = model.apply(unravel(fp), tokens, types,
-                                  lm_labels=labels)
-            return loss
+    if _ov("split_state", 0):
+        # two-buffer structure (the apex master-weights regime proper):
+        # fwd+bwd run on the bf16 param TREE, grads are raveled as a
+        # forward op, the update runs on the flat fp32 master, and the
+        # tree is refreshed from it.  Differentiating through unravel —
+        # the single-buffer structure below — transposes to a 297-way
+        # pad+add chain over the flat buffer; this variant never
+        # differentiates it (A/B: --override split_state=1).
+        def step(state, batch_args):
+            tree, fp, m, v = state
+            tokens, types, labels = batch_args
 
-        _, g = jax.value_and_grad(loss_fn)(fp)
-        p2, m2, v2 = _lamb_step(
-            fp, m, v, g, jnp.float32(1), jnp.float32(1e-4),
-            jnp.float32(0.9), jnp.float32(0.999), jnp.float32(1e-6),
-            jnp.float32(0.01), jnp.float32(1.0), jnp.float32(0),
-            jnp.float32(1.0), bias_correction=True, offsets=offsets,
-            sizes=sizes, use_nvlamb=False)
-        return (p2, m2, v2)
+            def loss_fn(tree):
+                loss, _ = model.apply(tree, tokens, types,
+                                      lm_labels=labels)
+                return loss
 
-    state = (flat, jnp.zeros_like(flat), jnp.zeros_like(flat))
+            _, g_tree = jax.value_and_grad(loss_fn)(tree)
+            g = jax.flatten_util.ravel_pytree(g_tree)[0].astype(
+                jnp.float32)
+            p2, m2, v2 = _lamb_step(fp, m, v, g, *lamb_args, **lamb_kw)
+            return (unravel(p2), p2, m2, v2)
+
+        state = (unravel(flat), flat, jnp.zeros_like(flat),
+                 jnp.zeros_like(flat))
+    else:
+        def step(state, batch_args):
+            fp, m, v = state
+            tokens, types, labels = batch_args
+
+            def loss_fn(fp):
+                loss, _ = model.apply(unravel(fp), tokens, types,
+                                      lm_labels=labels)
+                return loss
+
+            _, g = jax.value_and_grad(loss_fn)(fp)
+            p2, m2, v2 = _lamb_step(fp, m, v, g, *lamb_args, **lamb_kw)
+            return (p2, m2, v2)
+
+        state = (flat, jnp.zeros_like(flat), jnp.zeros_like(flat))
     t = _bench_loop(step, state, (tokens, types, labels), iters, rtt)
     value = batch * seq / t.best
     peak_tflops, _ = _chip_spec()

@@ -49,6 +49,10 @@ EXPERIMENTS = [
     # embedding-table grad: one-hot MXU matmul vs XLA scatter-add
     ("bert_emb_matmul_grad", ["--leg", "bert", "--override",
                               "emb_matmul_grad=1"], 900),
+    # two-buffer state (tree fwd/bwd + flat master) vs differentiating
+    # through unravel
+    ("bert_split_state", ["--leg", "bert", "--override",
+                          "split_state=1"], 900),
     ("attn_block1024", ["--leg", "attn"], 900),
     ("attn_block512", ["--leg", "attn", "--override", "block_q=512",
                        "--override", "block_k=512"], 900),
