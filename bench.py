@@ -582,7 +582,9 @@ def _bench_main(force_cpu: bool = False) -> None:
                         num_attention_heads=16,
                         max_seq_length=_ov("seq", 1024),
                         hidden_dropout=0.0, attention_dropout=0.0,
-                        params_dtype=jnp.bfloat16)
+                        params_dtype=jnp.bfloat16,
+                        embedding_grad_via_matmul=bool(
+                            _ov("emb_matmul_grad", 0)))
         batch, seq, iters = (_ov("batch", 8), _ov("seq", 1024),
                              _ov("iters", 8))
     else:
@@ -604,17 +606,33 @@ def _bench_main(force_cpu: bool = False) -> None:
 
     from apex_tpu.ops.fused_update import fused_adam_flat
 
-    def fused_step(state, batch):
-        flatp, m, v = state
-        tokens, labels = batch
-        def loss_fn(fp):
-            # unravel restores each leaf's original dtype (bf16 weights)
-            return model.apply(unravel(fp), tokens, labels)
-        loss, g = jax.value_and_grad(loss_fn)(flatp)
-        p2, m2, v2 = fused_adam_flat(
-            flatp, g.astype(jnp.float32), m, v, lr=1e-4, beta1=0.9,
-            beta2=0.999, eps=1e-8, weight_decay=0.0, step=1)
-        return (p2, m2, v2)
+    if _ov("split_state", 0):
+        # two-buffer structure: fwd+bwd on the bf16 tree, grads raveled
+        # as a forward op, fused update on the flat fp32 master (no
+        # differentiation through unravel — see the bert leg note)
+        def fused_step(state, batch):
+            tree, flatp, m, v = state
+            tokens, labels = batch
+            loss, g_tree = jax.value_and_grad(
+                lambda t: model.apply(t, tokens, labels))(tree)
+            g = jax.flatten_util.ravel_pytree(g_tree)[0]
+            p2, m2, v2 = fused_adam_flat(
+                flatp, g.astype(jnp.float32), m, v, lr=1e-4, beta1=0.9,
+                beta2=0.999, eps=1e-8, weight_decay=0.0, step=1)
+            return (unravel(p2), p2, m2, v2)
+    else:
+        def fused_step(state, batch):
+            flatp, m, v = state
+            tokens, labels = batch
+            def loss_fn(fp):
+                # unravel restores each leaf's original dtype (bf16
+                # weights)
+                return model.apply(unravel(fp), tokens, labels)
+            loss, g = jax.value_and_grad(loss_fn)(flatp)
+            p2, m2, v2 = fused_adam_flat(
+                flatp, g.astype(jnp.float32), m, v, lr=1e-4, beta1=0.9,
+                beta2=0.999, eps=1e-8, weight_decay=0.0, step=1)
+            return (p2, m2, v2)
 
     def naive_adam(flatp, g, m, v):
         # unfused elementwise update chain (eager-style baseline)
@@ -650,10 +668,12 @@ def _bench_main(force_cpu: bool = False) -> None:
     m = jnp.zeros_like(flat_params)
     v = jnp.zeros_like(flat_params)
     state = (flat_params, m, v)
+    fused_state = ((unravel(flat_params),) + state
+                   if _ov("split_state", 0) else state)
     batch_args = (tokens, labels)
 
     # Fused leg is THE metric: hard-fail (after retries) if it can't run.
-    t_fused = _bench_loop(fused_step, state, batch_args, iters, rtt)
+    t_fused = _bench_loop(fused_step, fused_state, batch_args, iters, rtt)
     # Baseline + microbench legs are auxiliary: degrade to null.
     t_naive = _aux(
         lambda: _bench_loop(naive_step, state, batch_args, iters, rtt),

@@ -7,16 +7,20 @@ the bench legs (r4 verdict weak #7; this file replaces
 ``r4_experiments.py``'s 5.8 kB of inline source snippets).
 
 Open questions it answers, in priority order (a wedge mid-batch keeps
-everything already written):
+everything already written; the EXPERIMENTS table below is the
+authoritative order):
 
 1. ``--quick``: the BERT north-star leg alone (BASELINE north_star,
    >=50% MFU target) — first, so a brief window can't miss it.
-2. GPT flagship main leg at batch 8/16/24 — bigger GEMM M dims vs the
-   committed batch-8 number under the base-2 kernels.
-3. BERT leg at batch 16/64 around the north-star 32.
-4. Flash attention block 512 vs 1024 (the r3 block choice re-validated
+2. The cheap bert-leg design A/Bs that set library defaults:
+   split-state (tree fwd/bwd + flat master), embedding grad via
+   matmul, batch 48.
+3. GPT flagship main leg at batch 8/16/24, split-state, emb-matmul —
+   bigger GEMM M dims vs the committed batch-8 number.
+4. BERT batch 16 and batch 64 + remat.
+5. Flash attention block 512 vs 1024 (the r3 block choice re-validated
    under base-2 softmax).
-5. The MoE leg (its E-sweep + onehot/gather crossover is built in).
+6. The MoE leg (its E-sweep + onehot/gather crossover is built in).
 
 Usage:  python bench_captures/r5_experiments.py [--quick]
 Writes: bench_captures/r5_experiments_out.json (one JSON object per
@@ -32,27 +36,35 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parent.parent
 OUT = REPO / "bench_captures" / "r5_experiments_out.json"
 
-# (key, bench.py args, timeout_s); --quick runs only the first row
+# (key, bench.py args, timeout_s); --quick runs only the first row.
+# Ordered by information-per-chip-second: the cheap bert-leg A/Bs that
+# decide library defaults come before the 2400 s GPT sweeps, so a short
+# tunnel window still answers the design questions.
 EXPERIMENTS = [
     ("bert", ["--leg", "bert"], 1200),
-    ("gpt_batch8", ["--leg", "main"], 2400),
-    ("gpt_batch16", ["--leg", "main", "--override", "batch=16"], 2400),
-    ("gpt_batch24", ["--leg", "main", "--override", "batch=24"], 2400),
-    ("bert_batch16", ["--leg", "bert", "--override", "batch=16"], 900),
+    # two-buffer state (tree fwd/bwd + flat master) vs differentiating
+    # through unravel — the leading candidate for the ~40 ms in-model
+    # overhead (PERF.md round-5 §3)
+    ("bert_split_state", ["--leg", "bert", "--override",
+                          "split_state=1"], 900),
+    # embedding-table grad: one-hot MXU matmul vs XLA scatter-add
+    ("bert_emb_matmul_grad", ["--leg", "bert", "--override",
+                              "emb_matmul_grad=1"], 900),
     # batch 48 projected ~13 GB — the largest no-remat fit
     ("bert_batch48", ["--leg", "bert", "--override", "batch=48"], 1200),
+    ("gpt_batch8", ["--leg", "main"], 2400),
+    ("gpt_split_state", ["--leg", "main", "--override",
+                         "split_state=1"], 2400),
+    ("gpt_batch16", ["--leg", "main", "--override", "batch=16"], 2400),
+    ("gpt_batch24", ["--leg", "main", "--override", "batch=24"], 2400),
+    ("gpt_emb_matmul_grad", ["--leg", "main", "--override",
+                             "emb_matmul_grad=1"], 2400),
+    ("bert_batch16", ["--leg", "bert", "--override", "batch=16"], 900),
     # batch 64 without remat OOMs (measured r5: 16.44 G vs 15.75 G HBM);
     # remat=1 rematerializes the layers to fit (costs ~+fwd FLOPs — only
     # wins if the bigger GEMMs beat the recompute)
     ("bert_batch64_remat", ["--leg", "bert", "--override", "batch=64",
                             "--override", "remat=1"], 1200),
-    # embedding-table grad: one-hot MXU matmul vs XLA scatter-add
-    ("bert_emb_matmul_grad", ["--leg", "bert", "--override",
-                              "emb_matmul_grad=1"], 900),
-    # two-buffer state (tree fwd/bwd + flat master) vs differentiating
-    # through unravel
-    ("bert_split_state", ["--leg", "bert", "--override",
-                          "split_state=1"], 900),
     ("attn_block1024", ["--leg", "attn"], 900),
     ("attn_block512", ["--leg", "attn", "--override", "block_q=512",
                        "--override", "block_k=512"], 900),

@@ -284,3 +284,42 @@ def test_xla_path_dropout_stream_matches_kernel():
     za = np.isclose(np.asarray(a), 0.0, atol=1e-6)
     zb = np.isclose(np.asarray(b), 0.0, atol=1e-6)
     assert (za == zb).mean() > 0.999
+
+
+def test_auto_dispatch_predicate(monkeypatch):
+    """On TPU backends short seqs take the XLA path, long seqs and
+    explicit blocks take the kernel; non-TPU backends always kernel."""
+    import apex_tpu.ops.attention as A
+    import apex_tpu.utils.common as common
+    # on_tpu() is functools.cache'd: pre-warm it with the REAL backend
+    # so the monkeypatched default_backend below can't poison it for
+    # this test (interpret-mode selection) or later kernel tests
+    common.on_tpu()
+    calls = {}
+    real_xla, real_fwd = A._xla_attention, A._fwd
+
+    def spy_xla(*a, **k):
+        calls["xla"] = True
+        return real_xla(*a, **k)
+
+    def spy_fwd(*a, **k):
+        calls["kernel"] = True
+        return real_fwd(*a, **k)
+
+    monkeypatch.setattr(A, "_xla_attention", spy_xla)
+    monkeypatch.setattr(A, "_fwd", spy_fwd)
+    q, k, v = _qkv(21, 1, 2, 128, 128, 64)
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+    calls.clear()
+    A.flash_attention(q, k, v)
+    assert calls == {"xla": True}            # short seq on tpu -> XLA
+
+    calls.clear()
+    A.flash_attention(q, k, v, block_q=128, block_k=128)
+    assert calls == {"kernel": True}         # explicit blocks -> kernel
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "cpu")
+    calls.clear()
+    A.flash_attention(q, k, v)
+    assert calls == {"kernel": True}         # non-tpu backend -> kernel
