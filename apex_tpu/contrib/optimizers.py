@@ -25,10 +25,17 @@ from apex_tpu.ops.fused_update import (
     fused_adam_flat,
     fused_lamb_phase1_flat,
 )
+import numpy as np
+
 from apex_tpu.optimizers.base import broadcast_leaf_scalars
 from apex_tpu.utils import cdiv, tree_ravel
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
+
+#: above this DP width the lax.switch-over-ranks trust-ratio path
+#: (O(dp * n_leaves) compiled branches) gives way to the global-buffer
+#: fallback (O(n) extra HBM traffic, compile size independent of dp)
+_SWITCH_MAX_DP = 32
 
 
 class _DistributedOptimizerBase:
@@ -180,10 +187,12 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
         concat are copies.
 
         Compile cost is O(dp · n_leaves) HLO ops (dead branches are
-        compiled, not executed) — fine through dp ≈ 64 on a
-        BERT-large-sized tree; for much larger DP groups a blocked
-        cumsum-difference formulation would bound compile size at the
-        cost of one extra pass over the shard."""
+        compiled, not executed); above ``_SWITCH_MAX_DP`` ``step``
+        switches to the global-buffer fallback — the leaf layout is
+        globally static and only the shard offset is dynamic, so the
+        shard is placed into a zeroed full-size buffer (norms) and the
+        full-size static scale vector is dynamically sliced (apply),
+        bounding compile size at the cost of O(n) extra HBM traffic."""
         shard_len = self._padded(n) // self.dp
         offs = [0]
         for s in sizes:
@@ -226,7 +235,11 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
         p32 = state["master"]
         sizes = [int(l.size) for l in leaves]
         n_tensors = len(sizes)
-        spans, shard_len = self._shard_leaf_spans(sizes, n)
+        large_dp = self.dp > _SWITCH_MAX_DP
+        if large_dp:        # spans unused — skip the O(dp*n_leaves) build
+            spans, shard_len = None, self._padded(n) // self.dp
+        else:
+            spans, shard_len = self._shard_leaf_spans(sizes, n)
         idx = jax.lax.axis_index(self.axis_name) if self.dp > 1 else 0
 
         def _norms_branch(rs):
@@ -242,7 +255,26 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
                 return jnp.stack(out)
             return f
 
-        if self.dp > 1:
+        if large_dp:
+            # bounded-compile fallback: only the shard's OFFSET is
+            # dynamic (idx * shard_len) — place the shard into a
+            # zeroed GLOBAL buffer at that offset, then every leaf
+            # reduction is a static slice.  Costs one full-buffer temp
+            # (O(n) HBM traffic, ~3 ms on a 335M tree) instead of the
+            # switch path's O(dp * n_leaves) compiled branches.
+            npad = self._padded(n)
+            offs = list(np.cumsum([0] + sizes[:-1]))
+
+            def global_sq_norms(vec):
+                full = jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros((npad,), jnp.float32), jnp.square(vec),
+                    idx * shard_len, axis=0)
+                return jnp.stack([
+                    jnp.sum(jax.lax.dynamic_slice_in_dim(full, o, s))
+                    for o, s in zip(offs, sizes)])
+            sq = jnp.stack([global_sq_norms(p32), global_sq_norms(u)])
+            sq = jax.lax.psum(sq, self.axis_name)
+        elif self.dp > 1:
             sq = jax.lax.switch(idx, [_norms_branch(rs) for rs in spans],
                                 (p32, u))
             sq = jax.lax.psum(sq, self.axis_name)
@@ -266,7 +298,19 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
                 return broadcast_leaf_scalars(jnp.stack(vals), span_sizes)
             return f
 
-        if self.dp > 1:
+        if large_dp:
+            # global scale vector is static-structured (leaf layout);
+            # my shard's window is one dynamic slice of it
+            npad = self._padded(n)
+            gsizes = list(sizes)
+            if npad > n:
+                gsizes.append(npad - n)
+            gtrust = (jnp.concatenate([trust, jnp.ones((1,), jnp.float32)])
+                      if npad > n else trust)
+            scale = jax.lax.dynamic_slice_in_dim(
+                broadcast_leaf_scalars(gtrust, gsizes),
+                idx * shard_len, shard_len)
+        elif self.dp > 1:
             scale = jax.lax.switch(
                 idx, [_scale_branch(rs) for rs in spans], trust)
         else:

@@ -157,3 +157,41 @@ def test_dist_adam_preserves_bf16_dtypes():
     new_params, _ = opt.step(state, g)
     assert new_params["w"].dtype == jnp.bfloat16
     assert new_params["b"].dtype == jnp.bfloat16
+
+
+def test_dist_lamb_large_dp_fallback_matches_switch(monkeypatch):
+    """The bounded-compile global-buffer path (dp > _SWITCH_MAX_DP) must
+    produce the same params as the lax.switch static-span path."""
+    import apex_tpu.contrib.optimizers as co
+
+    params = _params(jax.random.PRNGKey(9))
+    nflat = 37 * 13 + 13
+    grads_per_rank = jax.random.normal(
+        jax.random.PRNGKey(10), (DP, nflat)) * 0.05
+    mesh = _mesh()
+
+    def unflat(flat):
+        return {"w": flat[:37 * 13].reshape(37, 13), "b": flat[37 * 13:]}
+
+    def run():
+        opt = DistributedFusedLAMB(DP, lr=1e-2, weight_decay=0.01,
+                                   max_grad_norm=1.0)
+
+        def body(grank):
+            state = opt.init_state(params)
+            g = unflat(grank[0])
+            new_params, state = opt.step(state, g)
+            new_params, state = opt.step(state, g)
+            return new_params
+
+        return jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh, in_specs=(P("data"),), out_specs=P()))(
+            grads_per_rank)
+
+    via_switch = run()
+    monkeypatch.setattr(co, "_SWITCH_MAX_DP", 1)   # force the fallback
+    via_global = run()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6,
+                                                atol=1e-7),
+        via_global, via_switch)
