@@ -150,14 +150,7 @@ def save_and_commit(payload: dict, runner=subprocess.run) -> bool:
     extras = payload.get("extras") or {}
     msg = (f"{ROUND} on-chip capture: {payload.get('value')} tokens/s, "
            f"mfu {extras.get('mfu')}, bert_mfu {extras.get('bert_mfu')}")
-    runner(["git", "-C", str(REPO), "add", str(out), str(bench_artifact)],
-           capture_output=True, text=True)
-    r2 = runner(
-        ["git", "-C", str(REPO), "commit", "-m", msg,
-         "-m", "No-Verification-Needed: committing a measurement "
-               "artifact, no source change"],
-        capture_output=True, text=True)
-    log(f"git commit rc={r2.returncode}: {(r2.stdout or r2.stderr)[-160:]}")
+    _commit_artifacts([out, bench_artifact], msg, runner=runner)
     return True
 
 
@@ -206,15 +199,68 @@ def run_experiments(quick: bool, runner=subprocess.run) -> bool:
     outf = CAPDIR / "r5_experiments_out.json"
     captured = outf.exists() and "bert_mfu" in outf.read_text()
     if captured:
-        runner(["git", "-C", str(REPO), "add", str(outf)],
-               capture_output=True)
-        runner(
-            ["git", "-C", str(REPO), "commit", "-m",
-             f"{ROUND} on-chip experiment captures",
-             "-m", "No-Verification-Needed: measurement "
-                   "artifact, no source change"],
-            capture_output=True)
+        _commit_artifacts([outf], f"{ROUND} on-chip experiment captures",
+                          runner=runner)
     return captured if quick else "ALL_COMPLETE" in stdout
+
+
+#: diagnostic scripts run once after the experiment batch completes —
+#: each prints JSON/op tables; stdout is committed alongside the
+#: captures so an unattended window still yields the decomposition data
+DIAGNOSTICS = [
+    ("op_probes", "r5_op_probes.py", 1800),
+    ("profile_bert", "r5_profile_bert.py", 1200),
+]
+
+
+def _commit_artifacts(paths, msg, runner=subprocess.run) -> None:
+    """Shared add+commit for measurement artifacts (no-op when empty)."""
+    if not paths:
+        return
+    runner(["git", "-C", str(REPO), "add", *map(str, paths)],
+           capture_output=True, text=True)
+    r = runner(
+        ["git", "-C", str(REPO), "commit", "-m", msg,
+         "-m", "No-Verification-Needed: committing a measurement "
+               "artifact, no source change"],
+        capture_output=True, text=True)
+    log(f"git commit rc={r.returncode}: "
+        f"{((r.stdout or r.stderr) or '')[-160:]}")
+
+
+def run_diagnostics(runner=subprocess.run) -> bool:
+    """Run each diagnostic script, save stdout+stderr, commit.  True
+    only when every script exited 0 (a crashed or timed-out script is
+    stamped _FAIL/_TIMEOUT — not _DONE — so it reruns next window)."""
+    all_ok = True
+    touched = []
+    for key, script, timeout in DIAGNOSTICS:
+        outf = CAPDIR / f"r5_diag_{key}.txt"
+        if outf.exists() and outf.read_text().strip().endswith("_DONE"):
+            continue
+        try:
+            r = runner([sys.executable, str(CAPDIR / script)],
+                       capture_output=True, text=True, timeout=timeout,
+                       cwd=str(REPO))
+            body = (r.stdout or "") + \
+                (f"\n--- stderr ---\n{r.stderr}" if r.stderr else "")
+            outf.write_text(
+                body + ("\n_DONE" if r.returncode == 0 else "\n_FAIL"))
+            log(f"diagnostic {key} rc={r.returncode}")
+            if r.returncode != 0:
+                all_ok = False
+        except subprocess.TimeoutExpired as e:
+            def _s(x):
+                return x if isinstance(x, str) else (x or b"").decode()
+            outf.write_text(_s(e.stdout) +
+                            (f"\n--- stderr ---\n{_s(e.stderr)}"
+                             if e.stderr else "") + "\n_TIMEOUT")
+            log(f"diagnostic {key} timed out (partial kept)")
+            all_ok = False
+        touched.append(outf)
+    _commit_artifacts(touched, f"{ROUND} on-chip diagnostic outputs",
+                      runner=runner)
+    return all_ok
 
 
 def main() -> None:
@@ -224,6 +270,7 @@ def main() -> None:
     log(f"watcher started (round {ROUND}, pid {os.getpid()})")
     bert_done = False
     experiments_done = False
+    diagnostics_done = False
     try:
         while True:
             # one bad iteration (ENOSPC, git hiccup, transient OSError)
@@ -240,6 +287,9 @@ def main() -> None:
                     if ok and not experiments_done:
                         log("running full experiment batch")
                         experiments_done = run_experiments(quick=False)
+                    if experiments_done and not diagnostics_done:
+                        log("running diagnostics (op probes + profile)")
+                        diagnostics_done = run_diagnostics()
                     time.sleep(1200)
                 else:
                     log("probe failed (tunnel dead/wedged)")

@@ -132,3 +132,63 @@ def test_probe_false_on_timeout_or_bad_rc():
         return _FakeProc(stdout="PROBE_OK 256.0")
 
     assert watcher.probe(runner=ok_run) is True
+
+
+def test_run_diagnostics_saves_and_skips_done(monkeypatch, tmp_path):
+    _redirect_capdir(monkeypatch, tmp_path)
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _FakeProc(stdout='{"probe": 1}')
+
+    ok = watcher.run_diagnostics(runner=fake_run)
+    assert ok
+    for key, _, _ in watcher.DIAGNOSTICS:
+        out = tmp_path / f"r5_diag_{key}.txt"
+        assert out.exists() and out.read_text().endswith("_DONE")
+    # one run per script + one shared git add + one commit
+    n_scripts = len(watcher.DIAGNOSTICS)
+    assert len(calls) == n_scripts + 2
+    # second invocation skips completed diagnostics entirely (empty
+    # touched list -> not even a commit attempt)
+    calls.clear()
+    assert watcher.run_diagnostics(runner=fake_run)
+    assert len(calls) == 0
+
+
+def test_run_diagnostics_failure_reruns_and_keeps_stderr(monkeypatch,
+                                                        tmp_path):
+    _redirect_capdir(monkeypatch, tmp_path)
+    rc = {"v": 1}
+
+    def fake_run(cmd, **kw):
+        if any(str(c).endswith(".py") for c in cmd):
+            return _FakeProc(rc=rc["v"], stdout="",
+                             stderr="Traceback: boom")
+        return _FakeProc()
+
+    assert not watcher.run_diagnostics(runner=fake_run)
+    key = watcher.DIAGNOSTICS[0][0]
+    body = (tmp_path / f"r5_diag_{key}.txt").read_text()
+    # crash artifact keeps the traceback and is NOT stamped done
+    assert "Traceback: boom" in body and body.endswith("_FAIL")
+    # a later healthy window reruns it and flips to _DONE
+    rc["v"] = 0
+    assert watcher.run_diagnostics(runner=fake_run)
+    assert (tmp_path / f"r5_diag_{key}.txt").read_text().endswith("_DONE")
+
+
+def test_run_diagnostics_timeout_keeps_partial(monkeypatch, tmp_path):
+    _redirect_capdir(monkeypatch, tmp_path)
+
+    def fake_run(cmd, **kw):
+        if any(str(c).endswith(".py") for c in cmd):
+            raise subprocess.TimeoutExpired(cmd, 1, output="partial out")
+        return _FakeProc()
+
+    ok = watcher.run_diagnostics(runner=fake_run)
+    assert not ok
+    key = watcher.DIAGNOSTICS[0][0]
+    body = (tmp_path / f"r5_diag_{key}.txt").read_text()
+    assert "partial out" in body and body.endswith("_TIMEOUT")
