@@ -16,6 +16,11 @@ from apex_tpu.transformer.testing.standalone_gpt import (
     GPTModel,
     gpt_model_provider,
 )
+from apex_tpu.transformer.testing.standalone_llama import (
+    LlamaConfig,
+    LlamaModel,
+    llama_model_provider,
+)
 
 __all__ = [
     "BertConfig",
@@ -24,4 +29,7 @@ __all__ = [
     "GPTConfig",
     "GPTModel",
     "gpt_model_provider",
+    "LlamaConfig",
+    "LlamaModel",
+    "llama_model_provider",
 ]

@@ -10,6 +10,7 @@ attention kernel, runnable on a CPU mesh or real TPU.
 from .commons import IdentityLayer, initialize_distributed, set_random_seed
 from .standalone_gpt import GPTConfig, GPTModel, gpt_model_provider
 from .standalone_bert import BertConfig, BertModel, bert_model_provider
+from .standalone_llama import LlamaConfig, LlamaModel, llama_model_provider
 from .batch_sampler import (
     MegatronPretrainingSampler,
     MegatronPretrainingRandomSampler,
@@ -25,6 +26,9 @@ __all__ = [
     "BertConfig",
     "BertModel",
     "bert_model_provider",
+    "LlamaConfig",
+    "LlamaModel",
+    "llama_model_provider",
     "MegatronPretrainingSampler",
     "MegatronPretrainingRandomSampler",
 ]

@@ -1,0 +1,237 @@
+"""Standalone LLaMA-family decoder on the apex_tpu TP layers.
+
+Beyond-parity breadth: the reference keeps only GPT/BERT fixtures under
+``apex/transformer/testing``; this model demonstrates that the same op
+inventory composes into the modern decoder recipe — fused RMSNorm
+(`apex.normalization.FusedRMSNorm` parity class), cached-cos/sin RoPE
+(``transformer/functional/fused_rope.py``), grouped-query attention over
+the flash kernels, SwiGLU over Column/RowParallelLinear, an untied
+vocab-parallel LM head — with tensor parallelism from the same
+``parallel_state`` mesh axes.
+
+Conventions follow the public LLaMA architecture: pre-norm RMSNorm, no
+biases, rotary positions, ``ffn = silu(gate) * up``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.transformer.functional.fused_rope import (
+    fused_apply_rotary_pos_emb_cached,
+)
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.utils import divide
+
+__all__ = ["LlamaConfig", "LlamaModel", "llama_model_provider"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Defaults give a test-scale model; override for real sizes."""
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_attention_heads: int = 8
+    num_kv_heads: Optional[int] = None         # None = MHA; < heads = GQA
+    ffn_hidden_size: Optional[int] = None      # None = LLaMA's 8/3 rule
+    max_seq_length: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    params_dtype: Any = jnp.float32
+    remat: bool = False
+    embedding_grad_via_matmul: bool = False
+
+    def __post_init__(self):
+        if self.num_attention_heads % self.kv_heads:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must "
+                f"be a multiple of num_kv_heads ({self.kv_heads})")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_attention_heads
+
+    @property
+    def ffn(self) -> int:
+        if self.ffn_hidden_size is not None:
+            return self.ffn_hidden_size
+        # LLaMA sizing: 2/3 * 4h, rounded up to a multiple of 256
+        raw = int(8 * self.hidden_size / 3)
+        return (raw + 255) // 256 * 256
+
+
+def _tp() -> int:
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_tensor_model_parallel_world_size()
+    return 1
+
+
+def _rope_cos_sin(seq_len: int, dim: int, theta: float):
+    """[s, 1, 1, dim] cos/sin tables (NeoX half-split convention — the
+    layout ``_rotate_half`` in fused_rope expects)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, jnp.float32) / dim))
+    freqs = jnp.outer(jnp.arange(seq_len, dtype=jnp.float32), inv)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)[:, None, None, :]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+class LlamaAttention(nn.Module):
+    """GQA self-attention: q heads and kv heads shard over the tensor
+    axis; RoPE on q/k; causal flash attention core."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        s, b = x.shape[0], x.shape[1]
+        tp = _tp()
+        heads_local = divide(cfg.num_attention_heads, tp)
+        head_dim = divide(cfg.hidden_size, cfg.num_attention_heads)
+        # kv sharding: when tp divides kv_heads each rank owns its kv
+        # shard; otherwise (tp > kv_heads, or ragged) the kv projection
+        # is REPLICATED — every rank computes all kv heads and gathers
+        # its q-heads' groups (Megatron's MQA/GQA handling).  Replicated
+        # params init identically on every rank (plain nn.Dense does not
+        # fold the rank into its key); like every replicated param under
+        # TP, their grads must be reduced over the tensor axis by the
+        # training loop's grad-reduction step or ranks drift.
+        kv_sharded = cfg.kv_heads % tp == 0
+
+        q, _ = ColumnParallelLinear(
+            cfg.hidden_size, cfg.num_attention_heads * head_dim,
+            bias=False, gather_output=False,
+            params_dtype=cfg.params_dtype, name="q_proj")(x)
+        if kv_sharded:
+            kv_local = cfg.kv_heads // tp
+            kv, _ = ColumnParallelLinear(
+                cfg.hidden_size, 2 * cfg.kv_heads * head_dim,
+                bias=False, gather_output=False,
+                params_dtype=cfg.params_dtype, name="kv_proj")(x)
+        else:
+            kv_local = cfg.kv_heads
+            kv = nn.Dense(2 * cfg.kv_heads * head_dim, use_bias=False,
+                          param_dtype=cfg.params_dtype,
+                          name="kv_proj")(x)
+        q = q.reshape(s, b, heads_local, head_dim)
+        k, v = jnp.split(kv.reshape(s, b, kv_local, 2 * head_dim), 2,
+                         axis=-1)
+
+        cos, sin = _rope_cos_sin(s, head_dim, cfg.rope_theta)
+        q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
+        k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
+
+        group = cfg.num_attention_heads // cfg.kv_heads
+        if kv_sharded:
+            if kv_local != heads_local:    # GQA: share kv across groups
+                k, v = (jnp.broadcast_to(
+                    t[:, :, :, None, :],
+                    (s, b, kv_local, group, head_dim)
+                ).reshape(s, b, heads_local, head_dim) for t in (k, v))
+        else:
+            # replicated kv: gather the kv head for each LOCAL q head
+            # (global q head = rank * heads_local + i); tiny head-axis
+            # gather, rank is dynamic inside shard_map
+            rank = (jax.lax.axis_index(parallel_state.TENSOR_AXIS)
+                    if tp > 1 else 0)
+            ids = (rank * heads_local
+                   + jnp.arange(heads_local, dtype=jnp.int32)) // group
+            k, v = (jnp.take(t, ids, axis=2) for t in (k, v))
+
+        # [s, b, n, d] -> [b, n, s, d]
+        q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        ctx = flash_attention(q, k, v, causal=True)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b,
+                                                heads_local * head_dim)
+        out, _ = RowParallelLinear(
+            cfg.num_attention_heads * head_dim, cfg.hidden_size,
+            bias=False, input_is_parallel=True,
+            params_dtype=cfg.params_dtype, name="o_proj")(ctx)
+        return out
+
+
+class LlamaMLP(nn.Module):
+    """SwiGLU: ``down(silu(gate(x)) * up(x))`` over TP."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate, _ = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn, bias=False, gather_output=False,
+            params_dtype=cfg.params_dtype, name="gate_proj")(x)
+        up, _ = ColumnParallelLinear(
+            cfg.hidden_size, cfg.ffn, bias=False, gather_output=False,
+            params_dtype=cfg.params_dtype, name="up_proj")(x)
+        h = jax.nn.silu(gate) * up
+        out, _ = RowParallelLinear(
+            cfg.ffn, cfg.hidden_size, bias=False, input_is_parallel=True,
+            params_dtype=cfg.params_dtype, name="down_proj")(h)
+        return out
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = FusedRMSNorm(normalized_shape=cfg.hidden_size, eps=cfg.rms_eps,
+                         name="input_norm")(x)
+        x = x + LlamaAttention(cfg, name="attention")(h)
+        h = FusedRMSNorm(normalized_shape=cfg.hidden_size, eps=cfg.rms_eps,
+                         name="post_attention_norm")(x)
+        return x + LlamaMLP(cfg, name="mlp")(h)
+
+
+class LlamaModel(nn.Module):
+    """tokens [b, s] -> loss (with labels) or [s, b, vocab/tp] logits."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, labels=None):
+        cfg = self.cfg
+        if tokens.shape[1] > cfg.max_seq_length:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds "
+                f"max_seq_length {cfg.max_seq_length}")
+        h = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, params_dtype=cfg.params_dtype,
+            grad_via_matmul=cfg.embedding_grad_via_matmul,
+            name="embed_tokens")(tokens)
+        h = h.transpose(1, 0, 2)                    # [s, b, h]
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(block)
+        for i in range(cfg.num_layers):
+            h = block(cfg, name=f"layer_{i}")(h)
+        h = FusedRMSNorm(normalized_shape=cfg.hidden_size, eps=cfg.rms_eps,
+                         name="final_norm")(h)
+        # untied LM head (LLaMA convention), vocab rows sharded over TP
+        logits, _ = ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, bias=False,
+            gather_output=False, params_dtype=cfg.params_dtype,
+            name="lm_head")(h)
+        if labels is None:
+            return logits
+        loss = vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), labels.T)
+        return loss.mean()
+
+
+def llama_model_provider(cfg: LlamaConfig = LlamaConfig()) -> LlamaModel:
+    return LlamaModel(cfg)

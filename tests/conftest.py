@@ -22,6 +22,10 @@ jax.config.update("jax_num_cpu_devices", 8)
 # one place; `pytest -m "slow or not slow"` runs everything.  Entries are
 # nodeid prefixes (parametrized variants inherit the mark).
 SLOW = {
+    # llama fixture (new in r5): train/TP/remat legs measured 10-18 s
+    "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_matches_tp1",
+    "tests/L0/run_transformer/test_llama_minimal.py::test_remat_matches_baseline",
+    "tests/L0/run_transformer/test_llama_minimal.py::test_loss_reasonable_and_trains",
     # r5 re-lane: measured >5 s in the 2026-07-31 durations run
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::test_scan_layers_dropout_trains",
     "tests/L0/run_transformer/test_moe.py::test_gather_dispatch_matches_onehot",
