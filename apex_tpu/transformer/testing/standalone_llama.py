@@ -31,13 +31,15 @@ from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear,
     RowParallelLinear,
     VocabParallelEmbedding,
+    mappings,
 )
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
 )
 from apex_tpu.transformer.utils import divide
 
-__all__ = ["LlamaConfig", "LlamaModel", "llama_model_provider"]
+__all__ = ["LlamaConfig", "LlamaModel", "llama_model_provider",
+           "reduce_llama_grads"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,9 +126,16 @@ class LlamaAttention(nn.Module):
                 params_dtype=cfg.params_dtype, name="kv_proj")(x)
         else:
             kv_local = cfg.kv_heads
+            # replicated projection: copy_to's backward psums dx over
+            # the tensor axis, so upstream (norm/embedding) grads stay
+            # rank-consistent; the kv WEIGHT grads still need
+            # reduce_llama_grads (each rank backprops only its q-heads'
+            # share)
+            x_kv = mappings.copy_to_tensor_model_parallel_region(x) \
+                if tp > 1 else x
             kv = nn.Dense(2 * cfg.kv_heads * head_dim, use_bias=False,
                           param_dtype=cfg.params_dtype,
-                          name="kv_proj")(x)
+                          name="kv_proj")(x_kv)
         q = q.reshape(s, b, heads_local, head_dim)
         k, v = jnp.split(kv.reshape(s, b, kv_local, 2 * head_dim), 2,
                          axis=-1)
@@ -231,6 +240,26 @@ class LlamaModel(nn.Module):
         loss = vocab_parallel_cross_entropy(
             logits.astype(jnp.float32), labels.T)
         return loss.mean()
+
+
+def reduce_llama_grads(grads, cfg: LlamaConfig):
+    """Grad-reduction contract for the replicated-kv path (same pattern
+    as ``moe.reduce_moe_grads``): when ``kv_heads % tp != 0`` the
+    ``kv_proj`` weights are replicated across tensor ranks but each rank
+    backpropagates only its OWN q-heads' contribution — the true grad is
+    the ``psum`` over the tensor axis.  All other replicated params
+    (norm weights) receive identical grads on every rank and need no
+    reduction.  No-op when kv is sharded or tp == 1."""
+    tp = _tp()
+    if tp == 1 or cfg.kv_heads % tp == 0:
+        return grads
+
+    def fix(path, g):
+        if any(getattr(p, "key", None) == "kv_proj" for p in path):
+            return jax.lax.psum(g, parallel_state.TENSOR_AXIS)
+        return g
+
+    return jax.tree_util.tree_map_with_path(fix, grads)
 
 
 def llama_model_provider(cfg: LlamaConfig = LlamaConfig()) -> LlamaModel:

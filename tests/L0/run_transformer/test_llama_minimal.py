@@ -63,10 +63,53 @@ def test_gqa_variants_finite(kv_heads):
     assert abs(float(loss) - np.log(VOCAB)) < 1.0
 
 
-def test_tp2_matches_tp1():
-    """Same per-shard init keys as a dense run is not possible (shard
-    init folds the rank), so instead: TP=2 loss is finite, CE-scale, and
-    the model TRAINS under shard_map with grads synced by psum."""
+def _shard_llama_params(params, tp):
+    """Hand-shard a TP=1 param tree into per-rank trees stacked on a
+    leading [tp] axis (Column/[out,in] and embeddings split dim0, Row
+    splits dim1, norm weights replicate)."""
+    ROW = ("o_proj", "down_proj")
+
+    def shard(path, leaf):
+        names = {getattr(p, "key", None) for p in path}
+        if names & {"input_norm", "post_attention_norm", "final_norm"}:
+            return jnp.stack([leaf] * tp)
+        if names & set(ROW):
+            return jnp.stack(jnp.split(leaf, tp, axis=1))
+        return jnp.stack(jnp.split(leaf, tp, axis=0))
+
+    return jax.tree_util.tree_map_with_path(shard, params)
+
+
+def test_tp2_matches_tp1_exactly():
+    """Dense (TP=1) init, hand-sharded to TP=2: the sharded loss must
+    equal the dense loss — catches shard-to-head misalignment and
+    dropped collective partials that a finite-loss smoke test passes."""
+    tokens, labels = _data(7)
+    parallel_state.initialize_model_parallel(1)
+    model = llama_model_provider(_cfg(num_kv_heads=2))
+    params = model.init(jax.random.PRNGKey(1), tokens, labels)
+    dense_loss = float(model.apply(params, tokens, labels))
+    parallel_state.destroy_model_parallel()
+
+    tp = 2
+    parallel_state.initialize_model_parallel(tp)
+    mesh = parallel_state.get_mesh()
+    stacked = _shard_llama_params(params, tp)
+
+    def body(stacked, tokens, labels):
+        p = jax.tree.map(lambda x: x[0], stacked)   # my rank's shard
+        return model.apply(p, tokens, labels)
+
+    loss = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("tensor"), P(), P()),
+        out_specs=P()))(stacked, tokens, labels)
+    np.testing.assert_allclose(float(loss), dense_loss, rtol=2e-5)
+
+
+def test_tp2_trains_under_shard_map():
+    """TP=2 loss is finite, CE-scale, and the model TRAINS under
+    shard_map (grad sync exactness is test_tp2_matches_tp1_exactly's
+    job)."""
     parallel_state.initialize_model_parallel(2)
     mesh = parallel_state.get_mesh()
     model = llama_model_provider(_cfg(num_kv_heads=2))
@@ -153,3 +196,42 @@ def test_config_validation():
     long_tokens = jnp.zeros((1, SEQ + 1), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
         model.init(jax.random.PRNGKey(0), long_tokens)
+
+
+@pytest.mark.parametrize("reduce_grads", [True, False])
+def test_mqa_tp_kv_grad_reduction_keeps_ranks_consistent(reduce_grads):
+    """Replicated-kv wgrads are per-rank partials: with
+    reduce_llama_grads the kv weights stay bit-identical across tensor
+    ranks through updates; without it they drift (the negative control
+    proves the reduction is load-bearing)."""
+    from apex_tpu.transformer.testing.standalone_llama import (
+        reduce_llama_grads,
+    )
+    parallel_state.initialize_model_parallel(2)
+    mesh = parallel_state.get_mesh()
+    cfg = _cfg(num_kv_heads=1)
+    model = llama_model_provider(cfg)
+    tokens, labels = _data(6)
+
+    def body(tokens, labels):
+        p = model.init(jax.random.PRNGKey(1), tokens, labels)
+
+        def loss_fn(p):
+            return model.apply(p, tokens, labels)
+
+        for _ in range(3):
+            _, g = jax.value_and_grad(loss_fn)(p)
+            if reduce_grads:
+                g = reduce_llama_grads(g, cfg)
+            p = jax.tree.map(lambda a, b: a - 3e-3 * b, p, g)
+        kv = p["params"]["layer_0"]["attention"]["kv_proj"]["kernel"]
+        return kv[None]                      # [1, h, 2*kv*d] per rank
+
+    kv_both = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P(), P()),
+        out_specs=P("tensor")))(tokens, labels)   # stacked [2, h, ...]
+    diff = float(jnp.max(jnp.abs(kv_both[0] - kv_both[1])))
+    if reduce_grads:
+        assert diff == 0.0, diff
+    else:
+        assert diff > 1e-7, "negative control: drift expected"

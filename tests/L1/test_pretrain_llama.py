@@ -1,0 +1,24 @@
+"""L1 wiring of ``examples/llama`` — the beyond-parity LLaMA decoder
+must train end to end on a tp x dp mesh (GQA kv sharding included)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from examples.llama.pretrain_llama import main
+
+
+def test_pretrain_llama_tp2_dp2_trains():
+    first, last = main(["--tp", "2", "--dp", "2", "--iters", "25"])
+    assert np.isfinite(last)
+    assert last < first * 0.5, (first, last)
+
+
+def test_pretrain_llama_mqa_tp2():
+    first, last = main(["--tp", "2", "--dp", "1", "--iters", "20",
+                        "--kv-heads", "1"])
+    assert np.isfinite(last)
+    assert last < first * 0.7, (first, last)

@@ -22,8 +22,12 @@ jax.config.update("jax_num_cpu_devices", 8)
 # one place; `pytest -m "slow or not slow"` runs everything.  Entries are
 # nodeid prefixes (parametrized variants inherit the mark).
 SLOW = {
-    # llama fixture (new in r5): train/TP/remat legs measured 10-18 s
-    "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_matches_tp1",
+    # llama fixture (new in r5): train/TP/remat legs measured 9-18 s
+    "tests/L1/test_pretrain_llama.py::test_pretrain_llama_tp2_dp2_trains",
+    "tests/L1/test_pretrain_llama.py::test_pretrain_llama_mqa_tp2",
+    "tests/L0/run_transformer/test_llama_minimal.py::test_mqa_tp_kv_grad_reduction_keeps_ranks_consistent",
+    "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_trains_under_shard_map",
+    "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_matches_tp1_exactly",
     "tests/L0/run_transformer/test_llama_minimal.py::test_remat_matches_baseline",
     "tests/L0/run_transformer/test_llama_minimal.py::test_loss_reasonable_and_trains",
     # r5 re-lane: measured >5 s in the 2026-07-31 durations run
