@@ -558,6 +558,70 @@ def _microbench_bert(rtt: float, on_tpu: bool):
             "bert_shape": [batch, seq, cfg.num_layers, cfg.hidden_size]}
 
 
+def _microbench_llama(rtt: float, on_tpu: bool):
+    """LLaMA-family decoder train step (beyond-parity model: RMSNorm +
+    RoPE + GQA 2:1 + SwiGLU — ``apex_tpu.models.LlamaModel``), fused
+    Adam on fp32 masters.  Reported as ``llama_tokens_per_s`` /
+    ``llama_mfu``."""
+    from apex_tpu.ops.fused_update import fused_adam_flat
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import (LlamaConfig,
+                                              llama_model_provider)
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32768, hidden_size=1024, num_layers=24,
+            num_attention_heads=16, num_kv_heads=8,
+            max_seq_length=_ov("seq", 1024), params_dtype=jnp.bfloat16,
+            remat=bool(_ov("remat", 0)),
+            embedding_grad_via_matmul=bool(_ov("emb_matmul_grad", 0)))
+        batch, iters = _ov("batch", 8), _ov("iters", 8)
+    else:
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                          num_attention_heads=4, num_kv_heads=2,
+                          max_seq_length=128)
+        batch, iters = 2, 2
+    seq = cfg.max_seq_length
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    model = llama_model_provider(cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), tokens, labels)
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    flat = flat.astype(jnp.float32)
+    n_params = int(flat.size)
+
+    def step(state, batch_args):
+        fp, m, v = state
+        tokens, labels = batch_args
+
+        def loss_fn(fp):
+            return model.apply(unravel(fp), tokens, labels)
+
+        _, g = jax.value_and_grad(loss_fn)(fp)   # fp is fp32, so is g
+        p2, m2, v2 = fused_adam_flat(
+            fp, g, m, v, lr=1e-4, beta1=0.9,
+            beta2=0.999, eps=1e-8, weight_decay=0.0, step=1)
+        return (p2, m2, v2)
+
+    state = (flat, jnp.zeros_like(flat), jnp.zeros_like(flat))
+    t = _bench_loop(step, state, (tokens, labels), iters, rtt)
+    value = batch * seq / t.best
+    peak_tflops, _ = _chip_spec()
+    flops_per_token = (6 * n_params
+                       + 6 * cfg.num_layers * seq * cfg.hidden_size)
+    mfu = value * flops_per_token / (peak_tflops * 1e12)
+    return {"llama_tokens_per_s": round(value, 1),
+            "llama_mfu": round(mfu, 4),
+            "llama_sec_per_step": round(t.best, 5),
+            "llama_n_params": n_params,
+            "llama_shape": [batch, seq, cfg.num_layers, cfg.hidden_size,
+                            cfg.kv_heads]}
+
+
 MICRO_LEGS = {
     "adam": _microbench_adam,
     "ln": _microbench_layernorm,
@@ -565,6 +629,7 @@ MICRO_LEGS = {
     "xent": _microbench_xentropy,
     "moe": _microbench_moe,
     "bert": _microbench_bert,
+    "llama": _microbench_llama,
 }
 
 
