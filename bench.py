@@ -853,8 +853,9 @@ def _run_leg(mode: str, leg: str, timeout: float, key=None):
 
 # (leg, subprocess timeout): main pays 2 scan-loop compiles over the
 # tunnel; each micro leg pays 1-2 smaller ones
-LEG_TIMEOUTS = [("main", 1500), ("bert", 1200), ("adam", 700),
-                ("ln", 600), ("attn", 700), ("xent", 600), ("moe", 900)]
+LEG_TIMEOUTS = [("main", 1500), ("bert", 1200), ("llama", 1200),
+                ("adam", 700), ("ln", 600), ("attn", 700), ("xent", 600),
+                ("moe", 900)]
 
 
 def _run_all_legs(mode: str, errors: list):
@@ -894,7 +895,8 @@ def _summarize_capture(name, payload):
            "vs_baseline": payload.get("vs_baseline")}
     for k in ("mfu", "chip", "flash_attn_us", "adam_gbps",
               "layernorm_gbps", "xentropy_gbps", "moe_tokens_per_s",
-              "bert_mfu", "bert_tokens_per_s"):
+              "bert_mfu", "bert_tokens_per_s",
+              "llama_mfu", "llama_tokens_per_s"):
         if k in extras:
             out[k] = extras[k]
     return out

@@ -39,7 +39,11 @@ CAPDIR = REPO / "bench_captures"
 LOCKFILE = CAPDIR / "watcher.lock"
 ROUND = "r5"
 PROBE_TIMEOUT = 90
-BENCH_TIMEOUT = 1800
+#: outer ceiling > the SUM of bench.py's per-leg timeouts (8900 s incl.
+#: the main-leg retry) — same rule as the experiments runner: the outer
+#: kill must never truncate a capture the inner per-leg timeouts would
+#: have completed degraded
+BENCH_TIMEOUT = 9600
 PROBE_INTERVAL = 240
 
 PROBE_SRC = """
