@@ -30,15 +30,23 @@ def _is_local(axis_name: str) -> bool:
 
 def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
                                  label_smoothing: float = 0.0,
-                                 axis_name: str = TENSOR_AXIS):
+                                 axis_name: str = TENSOR_AXIS,
+                                 half_residuals: bool = False):
     """Per-token CE loss over vocab-sharded logits (no full-vocab gather).
 
     Matches the reference's ``vocab_parallel_cross_entropy(logits, target,
     label_smoothing)``: returns loss with the logits' leading shape.
+
+    ``half_residuals`` stores the backward's softmax residual in
+    bfloat16 instead of fp32 (the reference xentropy kernel's
+    half-precision bprop — ``apex/contrib/csrc/xentropy`` stores the
+    softmax in the input half dtype).  Halves the dominant
+    ``[tokens, vocab]`` residual; the logits grad quantizes through
+    bf16, which downstream bf16 matmul backward does anyway.
     """
     if _is_local(axis_name):
         return _local_cross_entropy(vocab_parallel_logits, target,
-                                    label_smoothing)
+                                    label_smoothing, half_residuals)
 
     partition_vocab = vocab_parallel_logits.shape[-1]
     full_vocab = partition_vocab * jax.lax.axis_size(axis_name)
@@ -67,6 +75,8 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
         log_sum_exp = jnp.log(sum_exp)
         loss = log_sum_exp - predicted
         softmax = exp_logits / sum_exp[..., None]
+        if half_residuals:
+            softmax = softmax.astype(jnp.bfloat16)
         if smoothing > 0.0:
             # mean over the full vocab of -log_softmax, reduced over shards
             # (reference: log_probs sum / num classes)
@@ -79,26 +89,68 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
 
     def _bwd(res, g):
         softmax, target_mask, masked_target = res
+        if half_residuals:
+            softmax = softmax.astype(jnp.float32)
         onehot = jax.nn.one_hot(
             masked_target, partition_vocab, dtype=softmax.dtype)
         onehot = jnp.where(target_mask[..., None], 0.0, onehot)
-        if smoothing > 0.0:
-            grad = softmax - (1.0 - smoothing) * onehot - \
-                smoothing / full_vocab
-        else:
-            grad = softmax - onehot
-        return (grad * g[..., None], None)
+        return (_ce_grad(softmax, onehot, smoothing, full_vocab, g), None)
 
     f.defvjp(_fwd, _bwd)
     return f(vocab_parallel_logits, target)
 
 
-def _local_cross_entropy(logits, target, label_smoothing: float):
-    """Unsharded fallback (tp==1) with identical math; also the test oracle."""
+def _ce_grad(softmax, onehot, smoothing: float, vocab: int, g):
+    """dCE/dlogits = softmax - (1-s)·onehot - s/V, scaled by the loss
+    cotangent — the ONE copy of the backward formula shared by the
+    sharded and local paths (so they cannot drift apart)."""
+    if smoothing > 0.0:
+        grad = softmax - (1.0 - smoothing) * onehot - smoothing / vocab
+    else:
+        grad = softmax - onehot
+    return grad * g[..., None]
+
+
+def _local_cross_entropy(logits, target, label_smoothing: float,
+                         half_residuals: bool = False):
+    """Unsharded fallback (tp==1) with identical math; also the test
+    oracle.  With ``half_residuals`` the backward keeps a bf16 softmax
+    (manual vjp) instead of autodiff's fp32 log_probs."""
     vocab = logits.shape[-1]
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(log_probs, target[..., None], axis=-1)[..., 0]
-    if label_smoothing > 0.0:
-        smooth = -jnp.sum(log_probs, axis=-1) / vocab
-        return (1.0 - label_smoothing) * nll + label_smoothing * smooth
-    return nll
+    if not half_residuals:
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            log_probs, target[..., None], axis=-1)[..., 0]
+        if label_smoothing > 0.0:
+            smooth = -jnp.sum(log_probs, axis=-1) / vocab
+            return (1.0 - label_smoothing) * nll + label_smoothing * smooth
+        return nll
+    smoothing = float(label_smoothing)
+
+    @jax.custom_vjp
+    def f(logits, target):
+        return _fwd(logits, target)[0]
+
+    def _fwd(logits, target):
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        shifted = logits - m
+        sum_exp = jnp.sum(jnp.exp(shifted), axis=-1)
+        log_probs_t = jnp.take_along_axis(
+            shifted, target[..., None], axis=-1)[..., 0] - jnp.log(sum_exp)
+        loss = -log_probs_t
+        if smoothing > 0.0:
+            smooth = -(jnp.sum(shifted, axis=-1)
+                       - vocab * jnp.log(sum_exp)) / vocab
+            loss = (1.0 - smoothing) * loss + smoothing * smooth
+        softmax = (jnp.exp(shifted) / sum_exp[..., None]).astype(
+            jnp.bfloat16)
+        return loss, (softmax, target)
+
+    def _bwd(res, g):
+        softmax, target = res
+        softmax = softmax.astype(jnp.float32)   # this path is half-only
+        onehot = jax.nn.one_hot(target, vocab, dtype=jnp.float32)
+        return (_ce_grad(softmax, onehot, smoothing, vocab, g), None)
+
+    f.defvjp(_fwd, _bwd)
+    return f(logits, target)

@@ -50,6 +50,7 @@ class BertConfig:
     sequence_parallel: bool = False
     remat: bool = False
     embedding_grad_via_matmul: bool = False
+    ce_half_residuals: bool = False
 
     def gpt_cfg(self) -> GPTConfig:
         return GPTConfig(
@@ -63,7 +64,8 @@ class BertConfig:
             params_dtype=self.params_dtype,
             sequence_parallel=self.sequence_parallel,
             remat=self.remat,
-            embedding_grad_via_matmul=self.embedding_grad_via_matmul)
+            embedding_grad_via_matmul=self.embedding_grad_via_matmul,
+            ce_half_residuals=self.ce_half_residuals)
 
 
 class BertModel(nn.Module):
@@ -143,7 +145,8 @@ class BertModel(nn.Module):
         if lm_labels is None:
             return lm_logits, binary_logits
         loss = vocab_parallel_cross_entropy(
-            lm_logits.astype(jnp.float32), lm_labels.T)
+            lm_logits.astype(jnp.float32), lm_labels.T,
+            half_residuals=cfg.ce_half_residuals)
         # loss weighting is SEPARATE from the attention padding mask
         # (reference: pretrain scripts pass loss_mask for the 15% MLM
         # positions while attention_mask covers padding); attention_mask

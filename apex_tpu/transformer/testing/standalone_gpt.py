@@ -70,6 +70,10 @@ class GPTConfig:
     # embedding-table grad as a one-hot MXU matmul instead of XLA's
     # scatter-add (see VocabParallelEmbedding.grad_via_matmul)
     embedding_grad_via_matmul: bool = False
+    # store the CE backward's softmax residual in bf16 (the reference
+    # xentropy kernel's half-precision bprop) — halves the dominant
+    # [tokens, vocab] residual
+    ce_half_residuals: bool = False
     # MoE (beyond reference parity; Megatron-core arg names): replace the
     # dense FFN with num_moe_experts top-k routed experts.  With
     # expert_model_parallel the experts shard over the mesh's 'expert'
@@ -388,7 +392,8 @@ class GPTModel(nn.Module):
             return logits
         # labels: [b, s] -> [s, b]
         loss = vocab_parallel_cross_entropy(
-            logits.astype(jnp.float32), labels.T)
+            logits.astype(jnp.float32), labels.T,
+            half_residuals=self.cfg.ce_half_residuals)
         return loss.mean()
 
 

@@ -472,7 +472,8 @@ def _microbench_bert(rtt: float, on_tpu: bool):
                          attention_dropout=0.0, params_dtype=jnp.bfloat16,
                          remat=bool(_ov("remat", 0)),
                          embedding_grad_via_matmul=bool(
-                             _ov("emb_matmul_grad", 0)))
+                             _ov("emb_matmul_grad", 0)),
+                         ce_half_residuals=bool(_ov("ce_half", 0)))
         batch, seq, iters = _ov("batch", 32), 128, _ov("iters", 8)
     else:
         cfg = BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,

@@ -67,3 +67,69 @@ def test_gradient_matches_local_oracle(label_smoothing):
     g_ref = jax.grad(lambda l: jnp.sum(
         _local_cross_entropy(l, target, label_smoothing)))(logits)
     np.testing.assert_allclose(g, g_ref, rtol=2e-5, atol=2e-6)
+
+
+class TestHalfResiduals:
+    """half_residuals=True stores the backward softmax in bf16 (the
+    reference xentropy's half-precision bprop): loss must be identical,
+    grads within bf16 quantization of the fp32 path — both the sharded
+    and the tp==1 local path."""
+
+    def _check(self, tp_body):
+        loss32, g32 = tp_body(False)
+        loss16, g16 = tp_body(True)
+        np.testing.assert_allclose(np.asarray(loss16),
+                                   np.asarray(loss32), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
+                                   atol=4e-3, rtol=1e-2)
+        assert float(np.abs(np.asarray(g16)).sum()) > 0
+
+    def test_local_path(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (6, 64)) * 3
+        target = jax.random.randint(jax.random.PRNGKey(1), (6,), 0, 64)
+
+        def body(half):
+            def f(lg):
+                return vocab_parallel_cross_entropy(
+                    lg, target, half_residuals=half).sum()
+            return jax.value_and_grad(f)(logits)
+
+        parallel_state.initialize_model_parallel(1)
+        self._check(body)
+
+    def test_local_path_label_smoothing(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (6, 64)) * 3
+        target = jax.random.randint(jax.random.PRNGKey(3), (6,), 0, 64)
+
+        def body(half):
+            def f(lg):
+                return vocab_parallel_cross_entropy(
+                    lg, target, label_smoothing=0.1,
+                    half_residuals=half).sum()
+            return jax.value_and_grad(f)(logits)
+
+        parallel_state.initialize_model_parallel(1)
+        self._check(body)
+
+    def test_sharded_path(self):
+        parallel_state.initialize_model_parallel(4)
+        mesh = parallel_state.get_mesh()
+        vocab = 64
+        logits = jax.random.normal(jax.random.PRNGKey(4), (6, vocab)) * 3
+        target = jax.random.randint(jax.random.PRNGKey(5), (6,), 0, vocab)
+
+        def body(half):
+            def run(logits, target):
+                def f(lg):
+                    return vocab_parallel_cross_entropy(
+                        lg, target, half_residuals=half).sum()
+                return jax.value_and_grad(f)(logits)
+
+            loss, g = jax.jit(functools.partial(
+                jax.shard_map, check_vma=False)(
+                run, mesh=mesh,
+                in_specs=(P(None, "tensor"), P()),
+                out_specs=(P(), P(None, "tensor"))))(logits, target)
+            return loss, g
+
+        self._check(body)

@@ -113,27 +113,22 @@ def test_timed_median_fallback_on_rtt_collapse():
     """A min sample inside the RTT jitter must not publish a ~0 best
     (r5: flash_attn_us 0.0 / moe us_gather 0.0): best falls back to the
     median when it reads < 0.25x of it."""
-    samples = iter([0.061, 0.30, 0.31, 0.32, 0.33])  # rtt swamps the min
-
-    def fake_run():
-        return None
-
-    times = iter([0.0, 0.061, 0.1, 0.40, 0.5, 0.81, 0.9, 1.22, 1.3,
-                  1.63])  # perf_counter pairs per rep
+    # perf_counter pairs per rep -> samples .061, .30, .31, .32, .33:
+    # the first rep finishes inside RTT jitter
+    times = [0.0, 0.061, 0.1, 0.40, 0.5, 0.81, 0.9, 1.22, 1.3, 1.63]
     with mock.patch.object(bench.time, "perf_counter",
-                           side_effect=list(times)):
-        t = bench._timed(fake_run, iters=10, rtt=0.060)
-    # samples: .061, .30, .31, .32, .33 -> per-iter mins would be 1e-10;
-    # median (0.31-0.060)/10 = 0.025 wins
+                           side_effect=times):
+        t = bench._timed(lambda: None, iters=10, rtt=0.060)
+    # min per-iter would be (0.061-0.060)/10 = 1e-4 — under 0.25x the
+    # median (0.31-0.060)/10 = 0.025, so the median wins
     assert t.best == t.median
     assert t.best > 1e-4
 
 
 def test_timed_normal_min_kept():
-    times = iter([0.0, 0.50, 0.6, 1.12, 1.2, 1.74, 1.8, 2.36, 2.4,
-                  3.02])
+    times = [0.0, 0.50, 0.6, 1.12, 1.2, 1.74, 1.8, 2.36, 2.4, 3.02]
     with mock.patch.object(bench.time, "perf_counter",
-                           side_effect=list(times)):
+                           side_effect=times):
         t = bench._timed(lambda: None, iters=10, rtt=0.060)
-    assert t.best < t.median or t.best == t.median
+    assert t.best != t.median          # fallback must NOT have fired
     assert abs(t.best - (0.50 - 0.060) / 10) < 1e-9
