@@ -191,10 +191,11 @@ def run_experiments(quick: bool, runner=subprocess.run) -> bool:
     stdout = ""
     try:
         # full-batch ceiling > the sum of the inner per-experiment
-        # timeouts (~11100s) so the outer kill never truncates a batch
-        # the inner timeouts would have completed
+        # timeouts (24600 s after the r5 additions) so the outer kill
+        # never truncates a batch the inner timeouts would have
+        # completed; results are flushed per-experiment either way
         r = runner(args, capture_output=True, text=True,
-                   timeout=1400 if quick else 13000, cwd=str(REPO))
+                   timeout=1400 if quick else 26000, cwd=str(REPO))
         stdout = r.stdout or ""
         log(f"experiments ({'quick' if quick else 'full'}) "
             f"rc={r.returncode}: {stdout.strip().splitlines()[-1:]}")
