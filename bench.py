@@ -898,7 +898,9 @@ def _summarize_capture(name, payload):
               "layernorm_gbps", "xentropy_gbps", "moe_tokens_per_s",
               "bert_mfu", "bert_tokens_per_s",
               "llama_mfu", "llama_tokens_per_s"):
-        if k in extras:
+        # falsy values are broken measurements (e.g. the pre-fix
+        # flash_attn_us 0.0 RTT-collapse artifact) — don't republish
+        if extras.get(k):
             out[k] = extras[k]
     return out
 
