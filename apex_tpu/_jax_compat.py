@@ -1,0 +1,62 @@
+"""Runtime aliases so the package runs on older jax releases.
+
+The codebase is written against the current jax surface (``jax.shard_map``
+with ``check_vma=``, ``jax.lax.axis_size``, ``pltpu.CompilerParams``).
+Older releases (<=0.4.x, e.g. the 0.4.37 in this image) spell those
+``jax.experimental.shard_map.shard_map(check_rep=...)``,
+``lax.psum(1, axis)`` and ``pltpu.TPUCompilerParams``.  Rather than
+down-editing 35+ call sites (and re-editing them when the image moves
+forward), :func:`install` grafts the modern names onto old jax at import
+time.  Every graft is guarded by ``hasattr`` so on a modern jax this is
+a no-op and the real implementations win.
+
+Imported for its side effect at the top of ``apex_tpu/__init__.py`` and
+``tests/conftest.py``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.lax
+
+_installed = False
+
+
+def _axis_size(axis_name):
+    # psum of a non-tracer constant folds at trace time to a concrete
+    # Python int on old jax — exactly the static value the modern
+    # jax.lax.axis_size returns (call sites branch on it in Python).
+    return jax.lax.psum(1, axis_name)
+
+
+def install() -> None:
+    """Graft modern jax names onto an old jax. Idempotent, no-op on new jax."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        @functools.wraps(_shard_map)
+        def shard_map(*args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+
+        jax.shard_map = shard_map
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pallas not shipped — kernels fall back anyway
+        pltpu = None
+    if pltpu is not None and not hasattr(pltpu, "CompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+install()

@@ -1,0 +1,26 @@
+"""apex_tpu.analysis — JAX-aware static analysis.
+
+Two engines (see README "Static analysis"):
+
+* :mod:`~apex_tpu.analysis.lint` — AST rules over the whole package
+  (host syncs under jit, PRNG key reuse, traced Python branching,
+  missing donation, fp32-defaulting factories, prints under trace).
+* :mod:`~apex_tpu.analysis.jaxpr_audit` — traces each public fused op
+  under a declared bf16 precision policy and asserts jaxpr invariants
+  (no unexplained bf16→fp32 upcasts, no host callbacks / transfers in
+  kernel bodies, output dtypes match the policy).
+
+CLI: ``python -m apex_tpu.analysis`` or the ``apex-tpu-analyze`` entry
+point; findings are gated by ``.analysis_baseline.json`` so only NEW
+violations fail the run.
+"""
+from apex_tpu.analysis.finding import Finding
+from apex_tpu.analysis.lint import lint_paths, lint_source
+
+__all__ = ["Finding", "lint_paths", "lint_source", "run_jaxpr_audit"]
+
+
+def run_jaxpr_audit(*args, **kwargs):
+    """Lazy proxy — the auditor imports jax, the linter doesn't need to."""
+    from apex_tpu.analysis.jaxpr_audit import run_jaxpr_audit as _run
+    return _run(*args, **kwargs)

@@ -1,0 +1,165 @@
+"""``python -m apex_tpu.analysis`` / ``apex-tpu-analyze`` entry point.
+
+Runs both engines over the package, subtracts the committed baseline
+(``.analysis_baseline.json``), and exits nonzero only on NEW findings —
+the ratchet pattern: pre-existing debt is pinned, regressions fail CI.
+
+    apex-tpu-analyze                       # lint + jaxpr audit, baseline-gated
+    apex-tpu-analyze path/ other.py        # restrict lint to paths
+    apex-tpu-analyze --write-baseline      # re-pin current findings
+    apex-tpu-analyze --no-baseline         # show everything, exit 1 if any
+    apex-tpu-analyze --list-rules
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional
+
+from apex_tpu.analysis.finding import Finding
+from apex_tpu.analysis.lint import lint_paths
+
+BASELINE_NAME = ".analysis_baseline.json"
+DEFAULT_SCAN = ("apex_tpu", "bench.py", "examples", "tests")
+
+
+def repo_root() -> Path:
+    """The tree the default scan targets.  Source checkouts (the normal
+    case) resolve from the package location; for an installed wheel —
+    whose parent is site-packages, which also contains an ``apex_tpu``
+    dir — prefer a repo-shaped cwd so the *checkout* gets linted and its
+    baseline found."""
+    import apex_tpu
+    pkg_parent = Path(apex_tpu.__file__).resolve().parent.parent
+    if (pkg_parent / "pyproject.toml").is_file():
+        return pkg_parent
+    cwd = Path.cwd()
+    if (cwd / "apex_tpu").is_dir():
+        return cwd
+    return pkg_parent
+
+
+def load_baseline(path: Path) -> set:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write_baseline(path: Path, findings: list) -> None:
+    entries = [{
+        "fingerprint": f.fingerprint,
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "message": f.message,
+        "line_text": f.line_text,
+    } for f in sorted(findings, key=lambda f: f.fingerprint)]
+    path.write_text(
+        json.dumps({"version": 1, "findings": entries}, indent=1) + "\n",
+        encoding="utf-8")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="apex-tpu-analyze",
+        description="JAX/TPU static analysis: AST lint + jaxpr audit")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: {DEFAULT_SCAN} "
+                        f"under the repo root)")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help=f"suppression file (default: <root>/{BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline; report everything")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="pin the current findings as the new baseline")
+    p.add_argument("--no-lint", action="store_true",
+                   help="skip the AST lint engine")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr precision/transfer audit")
+    p.add_argument("--ops", default=None,
+                   help="comma-separated op names for the jaxpr audit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="only the summary line")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from apex_tpu.analysis.rules import all_rules
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:<28} {rule.description}")
+        print("APX200 audit-trace-failure         jaxpr audit: op failed "
+              "to trace under the policy")
+        print("APX201 unexplained-upcast          jaxpr audit: bf16→fp32 "
+              "convert feeding no accumulator")
+        print("APX202 host-transfer-in-kernel     jaxpr audit: callback/"
+              "device_put in a fused op body")
+        print("APX203 output-dtype-policy         jaxpr audit: op output "
+              "dtype violates the declared policy")
+        return 0
+
+    root = repo_root()
+    findings: list = []
+
+    if not args.no_lint:
+        if args.paths:
+            paths = args.paths
+        else:
+            paths = [str(root / p) for p in DEFAULT_SCAN
+                     if (root / p).exists()]
+        findings.extend(lint_paths(paths, root=str(root)))
+
+    if not args.no_jaxpr:
+        from apex_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+        ops = args.ops.split(",") if args.ops else None
+        findings.extend(run_jaxpr_audit(ops))
+
+    baseline_path = args.baseline or (root / BASELINE_NAME)
+    if args.write_baseline:
+        # a restricted scan must not silently replace the shared
+        # full-repo baseline — that would drop every pinned finding
+        # outside the scan scope and re-fail the next full run
+        restricted = bool(args.paths) or args.no_lint or args.no_jaxpr
+        if restricted and args.baseline is None:
+            print("apex-tpu-analyze: refusing --write-baseline for a "
+                  "restricted scan (paths/--no-lint/--no-jaxpr) targeting "
+                  f"the shared {BASELINE_NAME}; pass --baseline <file> "
+                  "to write a scoped baseline, or run the full scan",
+                  file=sys.stderr)
+            return 2
+        write_baseline(baseline_path, findings)
+        print(f"baseline written: {baseline_path} "
+              f"({len(findings)} finding(s) pinned)")
+        return 0
+
+    baseline: set = set()
+    if not args.no_baseline and baseline_path.is_file():
+        baseline = load_baseline(baseline_path)
+
+    new = [f for f in findings if f.fingerprint not in baseline]
+    suppressed = len(findings) - len(new)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in new],
+            "suppressed": suppressed,
+            "total": len(findings),
+        }, indent=1))
+    else:
+        if not args.quiet:
+            for f in new:
+                print(f.render())
+        status = "FAIL" if new else "OK"
+        print(f"apex-tpu-analyze: {status} — {len(new)} new finding(s), "
+              f"{suppressed} baselined, {len(findings)} total")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

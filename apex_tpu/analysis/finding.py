@@ -1,0 +1,49 @@
+"""Finding record + stable fingerprinting for baseline suppression.
+
+A finding's fingerprint must survive unrelated edits to the same file
+(pure line-number shifts), so it is built from the *text* of the
+offending line rather than its position: ``rule :: path :: sha1(line
+text) :: occurrence-index``.  The index disambiguates several identical
+lines tripping the same rule in one file (fingerprints stay stable as
+long as their relative order does — the same contract pylint's
+``symbol``-based baselines use).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str              # "APX101"
+    path: str              # repo-relative posix path (or "<fixture>")
+    line: int              # 1-based; 0 for whole-artifact findings
+    col: int
+    message: str
+    line_text: str = ""    # stripped source of the offending line
+    index: int = 0         # occurrence index among same (rule, path, line_text)
+
+    @property
+    def fingerprint(self) -> str:
+        digest = hashlib.sha1(self.line_text.encode("utf-8")).hexdigest()[:12]
+        return f"{self.rule}::{self.path}::{digest}::{self.index}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule} {self.message}"
+
+
+def assign_indices(findings: list[Finding]) -> list[Finding]:
+    """Number findings that share (rule, path, line_text) by source order
+    so their fingerprints are distinct and stable."""
+    findings = sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    seen: dict[tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, f.line_text)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        out.append(Finding(f.rule, f.path, f.line, f.col, f.message,
+                           f.line_text, idx))
+    return out

@@ -1,0 +1,258 @@
+"""Jaxpr precision/transfer auditor for the public fused ops.
+
+Each op in :data:`OPS` is traced (``jax.make_jaxpr`` — abstract, zero
+FLOPs, runs in milliseconds on CPU) under the declared precision policy
+(bf16 activations; optimizer math on fp32 master params; losses
+reduce in fp32) and the whole jaxpr — including pallas kernel bodies,
+``custom_vjp`` branches and nested ``pjit``/``cond`` jaxprs — is walked
+to assert three invariants:
+
+* **APX201 — upcast discipline.** Every ``convert_element_type``
+  bf16→fp32 must either feed an accumulating primitive (reductions,
+  ``dot_general``) or be one of the op's *declared* entry upcasts
+  (``upcast_budget`` — e.g. LayerNorm applies γ/β in fp32 by design).
+  A NEW unexplained upcast — someone dropping an fp32 constant into a
+  bf16 kernel — fails the audit.
+* **APX202 — transfer/callback discipline.** No host callbacks,
+  ``device_put`` or infeed/outfeed anywhere in a kernel body.
+* **APX203 — output dtype policy.** Outputs match the declared dtypes
+  (bf16 in → bf16 out for kernels; losses and optimizer states fp32).
+
+Trace failures surface as APX200 so a refactor that breaks an op's
+public signature cannot silently drop it from the audit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from apex_tpu.analysis.finding import Finding
+
+__all__ = ["OpSpec", "OPS", "run_jaxpr_audit", "POLICY"]
+
+POLICY = ("bf16 activations / fp32 accumulators and losses / "
+          "fp32 optimizer master state")
+
+# Primitives whose consumption of an fp32 value justifies the upcast:
+# the whole point of fp32 inside a bf16 kernel is accumulation.
+ACCUM_PRIMS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "dot_general", "add_any", "cumsum", "cumprod", "cumlogsumexp",
+    "logsumexp",
+}
+
+# Host-transfer / callback primitives that must never appear in a fused
+# op's body (they serialise the TPU pipeline or break AOT compilation).
+FORBIDDEN_PRIMS = {
+    "pure_callback", "io_callback", "callback", "debug_callback",
+    "outside_call", "device_put", "infeed", "outfeed",
+    "copy_to_host_async",
+}
+
+
+@dataclass
+class OpSpec:
+    """One audited op: how to trace it + its declared invariants."""
+    name: str
+    path: str                           # module the finding anchors to
+    build: Callable[[], tuple]          # () -> (fn, args tuple)
+    out_dtypes: Optional[tuple] = None  # expected output dtypes, None = skip
+    # bf16->fp32 converts allowed beyond accumulator feeds (declared
+    # entry upcasts, e.g. applying affine params in fp32)
+    upcast_budget: Optional[int] = 0    # None = skip the upcast check
+
+
+def _builders():
+    """Specs are built lazily so importing this module stays jax-free
+    until an audit actually runs."""
+    import jax
+    import jax.numpy as jnp
+
+    bf16 = jnp.bfloat16
+    f32 = jnp.float32
+
+    def s(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def layer_norm():
+        from apex_tpu.ops import layer_norm as op
+        return (lambda x, w, b: op(x, w, b),
+                (s((8, 256), bf16), s((256,), bf16), s((256,), bf16)))
+
+    def rms_norm():
+        from apex_tpu.ops import rms_norm as op
+        return (lambda x, w: op(x, w), (s((8, 256), bf16), s((256,), bf16)))
+
+    def flash_attention():
+        from apex_tpu.ops import flash_attention as op
+        qkv = s((1, 2, 128, 64), bf16)
+        return (lambda q, k, v: op(q, k, v, causal=True), (qkv, qkv, qkv))
+
+    def ring_attention():
+        from apex_tpu.ops import ring_attention as op
+        qkv = s((1, 2, 128, 64), bf16)
+        # axis_name=None exercises the single-shard entry path without a
+        # mesh; the collective path shares the same kernels
+        return (lambda q, k, v: op(q, k, v, causal=True, axis_name=None),
+                (qkv, qkv, qkv))
+
+    def xentropy():
+        from apex_tpu.ops import softmax_cross_entropy_loss as op
+        return (lambda l, y: op(l, y),
+                (s((8, 128), bf16), s((8,), jnp.int32)))
+
+    def fused_adam():
+        from apex_tpu.ops import fused_adam_flat as op
+        p = s((256,), f32)
+        return (lambda p_, g, m, v: op(
+            p_, g, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+            weight_decay=0.0, step=1), (p, p, p, p))
+
+    def moe_layer():
+        import flax  # noqa: F401 — optional dep; ImportError skips the op
+        from apex_tpu.transformer.moe.layer import MoELayer
+        layer = MoELayer(num_experts=4, hidden_size=64,
+                         ffn_hidden_size=128, top_k=2)
+        key = jax.random.PRNGKey(0)
+        x = s((16, 64), bf16)
+        variables = jax.eval_shape(layer.init, key, x)
+        return (lambda v, x_: layer.apply(v, x_), (variables, x))
+
+    return {
+        # budgets are the measured entry upcasts (γ/β applied in fp32 by
+        # design — see the kernel docstrings); any increase fails
+        "layer_norm": (layer_norm, "apex_tpu/ops/layer_norm.py",
+                       ("bfloat16",), 2),
+        "rms_norm": (rms_norm, "apex_tpu/ops/layer_norm.py",
+                     ("bfloat16",), 3),
+        "flash_attention": (flash_attention, "apex_tpu/ops/attention.py",
+                            ("bfloat16",), 0),
+        "ring_attention": (ring_attention, "apex_tpu/ops/ring_attention.py",
+                           ("bfloat16",), 0),
+        "xentropy": (xentropy, "apex_tpu/ops/xentropy.py",
+                     ("float32",), 0),
+        "fused_adam": (fused_adam, "apex_tpu/ops/fused_update.py",
+                       ("float32", "float32", "float32"), 0),
+        # flax module: dtype promotion is the router's business — audit
+        # transfer discipline only
+        "moe_layer": (moe_layer, "apex_tpu/transformer/moe/layer.py",
+                      None, None),
+    }
+
+
+def op_specs() -> list:
+    return [OpSpec(name, path, build, out, budget)
+            for name, (build, path, out, budget) in _builders().items()]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _subjaxprs(params: dict):
+    import jax
+    for v in params.values():
+        items = v if isinstance(v, (list, tuple)) else [v]
+        for item in items:
+            if isinstance(item, jax.core.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, jax.core.Jaxpr):
+                yield item
+
+
+def _iter_jaxprs(jaxpr):
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in _subjaxprs(eqn.params):
+            yield from _iter_jaxprs(sub)
+
+
+def _audit_jaxpr(closed) -> tuple:
+    """-> (unexplained_upcast_count, forbidden_prim_names)"""
+    import jax
+    import jax.numpy as jnp
+    unexplained = 0
+    forbidden: list = []
+    for jaxpr in _iter_jaxprs(closed.jaxpr):
+        consumers: dict = {}
+        for eqn in jaxpr.eqns:
+            for var in eqn.invars:
+                if not isinstance(var, jax.core.Literal):
+                    consumers.setdefault(var, []).append(eqn.primitive.name)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in FORBIDDEN_PRIMS:
+                forbidden.append(name)
+            if name == "convert_element_type" and \
+                    eqn.params.get("new_dtype") == jnp.float32 and \
+                    getattr(eqn.invars[0], "aval", None) is not None and \
+                    eqn.invars[0].aval.dtype == jnp.bfloat16:
+                outs = consumers.get(eqn.outvars[0], [])
+                # escaping the subjaxpr (no local consumer) means the
+                # fp32 value is an output/residual — a declared boundary
+                if outs and not any(c in ACCUM_PRIMS for c in outs):
+                    unexplained += 1
+    return unexplained, forbidden
+
+
+def audit_op(spec: OpSpec) -> list:
+    """Audit one op; returns findings (empty = all invariants hold)."""
+    import jax
+
+    findings: list = []
+
+    def finding(rule, msg):
+        # line_text feeds the baseline fingerprint — keep it to the
+        # stable (op, rule) identity; msg carries the volatile details
+        # (exception strings, counts) that must not churn the ratchet
+        return Finding(rule, spec.path, 0, 0, msg,
+                       line_text=f"{spec.name}:{rule}")
+
+    try:
+        fn, args = spec.build()
+    except ImportError:
+        return []  # optional dependency absent — op not in this build
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — any trace failure is a finding
+        return [finding("APX200",
+                        f"tracing {spec.name} under the precision policy "
+                        f"failed: {type(e).__name__}: {e}")]
+
+    unexplained, forbidden = _audit_jaxpr(closed)
+    if forbidden:
+        findings.append(finding(
+            "APX202",
+            f"{spec.name} jaxpr contains host-transfer/callback "
+            f"primitive(s) {sorted(set(forbidden))} — fused op bodies "
+            f"must stay on-device"))
+    if spec.upcast_budget is not None and unexplained > spec.upcast_budget:
+        findings.append(finding(
+            "APX201",
+            f"{spec.name} has {unexplained} bf16→fp32 upcast(s) that feed "
+            f"no accumulator (budget {spec.upcast_budget}) — an fp32 "
+            f"constant/operand is silently promoting the bf16 kernel "
+            f"body"))
+    if spec.out_dtypes is not None:
+        got = tuple(str(v.aval.dtype) for v in closed.jaxpr.outvars)
+        if got != tuple(spec.out_dtypes):
+            findings.append(finding(
+                "APX203",
+                f"{spec.name} output dtypes {got} violate the declared "
+                f"policy {tuple(spec.out_dtypes)}"))
+    return findings
+
+
+def run_jaxpr_audit(ops: Optional[Sequence[str]] = None) -> list:
+    """Audit every (or the named) public fused op under the bf16 policy."""
+    specs = op_specs()
+    if ops:
+        wanted = set(ops)
+        missing = wanted - {s.name for s in specs}
+        if missing:
+            raise ValueError(f"unknown op(s): {sorted(missing)}")
+        specs = [s for s in specs if s.name in wanted]
+    out: list = []
+    for spec in specs:
+        out.extend(audit_op(spec))
+    return out

@@ -1,0 +1,442 @@
+"""AST lint engine: JAX/TPU hazard rules over the whole package.
+
+The engine parses each module once and hands every registered rule a
+:class:`ModuleContext` carrying the pieces JAX-aware rules keep needing:
+
+* an import-alias map so ``jnp.zeros`` / ``from jax import jit`` /
+  ``from jax.experimental import pallas as pl`` all resolve to full
+  dotted paths;
+* the set of *traced roots* — functions that run under a tracer
+  (``@jax.jit`` / ``pjit`` decorators, ``f = jax.jit(f)`` wraps,
+  ``shard_map`` / ``pallas_call`` / ``grad`` / ``scan`` function
+  arguments) — plus the jit binding call so rules can read
+  ``static_argnums`` / ``donate_argnums``;
+* a conservative "traced locals" dataflow for a root: parameters (minus
+  literal ``static_argnums``/``static_argnames``) and anything assigned
+  from ``jnp.*``-family calls or expressions over traced names, with
+  ``x.shape``-style static attribute reads filtered out.
+
+Inline suppression: ``# apex-lint: disable=APX104`` on the offending
+line, or ``# apex-lint: skip-file`` near the top of a module.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator, Optional, Sequence
+
+from apex_tpu.analysis.finding import Finding, assign_indices
+
+__all__ = ["ModuleContext", "JitInfo", "lint_source", "lint_paths",
+           "JIT_WRAPPERS", "TRACED_WRAPPERS"]
+
+# Wrappers that make their function argument a *jit* boundary (donation,
+# static_argnums semantics apply).
+JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+
+# Wrappers under which the function body runs traced — host syncs,
+# prints, and Python branching on values are hazards inside ANY of
+# these, not only jit.
+TRACED_WRAPPERS = JIT_WRAPPERS | {
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.vmap",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+}
+
+_PARTIAL = {"functools.partial", "partial"}
+
+# Namespaces whose call results are traced values inside a traced root.
+TRACED_NAMESPACE_PREFIXES = (
+    "jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.", "jax.scipy.",
+    "jax.image.", "jax.experimental.pallas.",
+)
+
+# Calls into traced namespaces that nevertheless return *static* Python
+# values (safe to branch on).
+STATIC_FNS = {
+    "jax.lax.axis_size",
+    "jax.numpy.ndim",
+    "jax.numpy.shape",
+    "jax.numpy.result_type",
+    "jax.numpy.issubdtype",
+    "jax.numpy.promote_types",
+    "jax.numpy.dtype",
+    "jax.numpy.iinfo",
+    "jax.numpy.finfo",
+}
+
+# Attribute reads on a traced array that are static metadata, not data.
+STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "itemsize", "nbytes", "sharding",
+    "weak_type", "aval", "at",
+}
+
+_DISABLE_RE = re.compile(r"#\s*apex-lint:\s*disable=([A-Z0-9_,\s]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*apex-lint:\s*skip-file")
+
+
+@dataclass
+class JitInfo:
+    """One traced-wrapper binding of a function-ish AST node."""
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef / Lambda
+    wrapper: str                       # resolved dotted wrapper name
+    binding: Optional[ast.Call] = None  # call carrying kwargs, None for bare @jax.jit
+    # partial(f, a, b, kw=c) binds f's leading params / named params to
+    # static Python values — they are NOT tracers inside the kernel
+    partial_pos: int = 0
+    partial_kws: frozenset = frozenset()
+
+    @property
+    def is_jit(self) -> bool:
+        return self.wrapper in JIT_WRAPPERS
+
+    def binding_kwarg(self, *names: str) -> Optional[ast.expr]:
+        if self.binding is None:
+            return None
+        for kw in self.binding.keywords:
+            if kw.arg in names:
+                return kw.value
+        return None
+
+    def static_params(self) -> Optional[set]:
+        """Literal static_argnums/static_argnames → set of param positions
+        (int) and names (str).  None means "spec present but not a
+        literal" (caller should go quiet rather than guess)."""
+        out: set = set()
+        for key in ("static_argnums", "static_argnames"):
+            val = self.binding_kwarg(key)
+            if val is None:
+                continue
+            try:
+                lit = ast.literal_eval(val)
+            except (ValueError, SyntaxError):
+                return None
+            if isinstance(lit, (int, str)):
+                lit = (lit,)
+            try:
+                out.update(lit)
+            except TypeError:
+                return None
+        return out
+
+
+class ModuleContext:
+    def __init__(self, source: str, path: str = "<string>"):
+        self.source = source
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = self._build_aliases()
+        self._defs = self._collect_defs()
+        self.jit_infos: list[JitInfo] = self._collect_traced_roots()
+        self._traced_region: Optional[set] = None
+
+    # -- imports / name resolution ------------------------------------
+
+    def _build_aliases(self) -> dict:
+        aliases: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node: Optional[ast.expr]) -> Optional[str]:
+        """Best-effort dotted path for a Name/Attribute chain, through
+        import aliases; None for anything else."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    # -- traced-root discovery ----------------------------------------
+
+    def _collect_defs(self) -> dict:
+        defs: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node  # last definition wins
+        return defs
+
+    def _wrapper_of(self, fn_expr: ast.expr) -> Optional[str]:
+        r = self.resolve(fn_expr)
+        if r in TRACED_WRAPPERS:
+            return r
+        return None
+
+    def _unwrap_partial(self, node: ast.expr) -> ast.expr:
+        """partial(f, ...) -> f (one level is all the codebase uses)."""
+        if isinstance(node, ast.Call) and \
+                self.resolve(node.func) in _PARTIAL and node.args:
+            return node.args[0]
+        return node
+
+    def _fnish(self, node: ast.expr):
+        """-> (function-ish AST node, partial_pos, partial_kws) or None."""
+        pos, kws = 0, frozenset()
+        inner = self._unwrap_partial(node)
+        if inner is not node and isinstance(node, ast.Call):
+            pos = len(node.args) - 1
+            kws = frozenset(kw.arg for kw in node.keywords if kw.arg)
+            node = inner
+        if isinstance(node, ast.Lambda):
+            return node, pos, kws
+        if isinstance(node, ast.Name):
+            target = self._defs.get(node.id)
+            if target is not None:
+                return target, pos, kws
+        return None
+
+    def _collect_traced_roots(self) -> list:
+        infos: list[JitInfo] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    w = self._wrapper_of(dec)
+                    if w:
+                        infos.append(JitInfo(node, w))
+                        continue
+                    if isinstance(dec, ast.Call):
+                        w = self._wrapper_of(dec.func)
+                        if w:
+                            infos.append(JitInfo(node, w, dec))
+                            continue
+                        inner = self._unwrap_partial(dec)
+                        if inner is not dec:
+                            w = self._wrapper_of(inner)
+                            if w:
+                                infos.append(JitInfo(node, w, dec))
+            elif isinstance(node, ast.Call):
+                w = self._wrapper_of(node.func) or (
+                    self._wrapper_of(self._unwrap_partial(node.func))
+                    if isinstance(node.func, ast.Call) else None)
+                if not w:
+                    continue
+                for arg in node.args:
+                    hit = self._fnish(arg)
+                    if hit is not None:
+                        target, pos, kws = hit
+                        infos.append(JitInfo(target, w, node,
+                                             partial_pos=pos,
+                                             partial_kws=kws))
+        return infos
+
+    def traced_roots(self) -> list:
+        """JitInfos deduped by root node (first binding wins)."""
+        seen, out = set(), []
+        for info in self.jit_infos:
+            if id(info.node) not in seen:
+                seen.add(id(info.node))
+                out.append(info)
+        return out
+
+    def jit_bindings(self, node: ast.AST) -> list:
+        return [i for i in self.jit_infos if i.node is node and i.is_jit]
+
+    def traced_region(self) -> set:
+        """ids of every AST node lexically under a traced root's body
+        (decorators excluded)."""
+        if self._traced_region is None:
+            region: set = set()
+            for info in self.traced_roots():
+                body = info.node.body
+                nodes = body if isinstance(body, list) else [body]
+                for stmt in nodes:
+                    for sub in ast.walk(stmt):
+                        region.add(id(sub))
+            self._traced_region = region
+        return self._traced_region
+
+    def iter_traced(self, *types) -> Iterator[ast.AST]:
+        """Yield nodes of the given types inside any traced region, once
+        each, in source order."""
+        region = self.traced_region()
+        seen = set()
+        for node in ast.walk(self.tree):
+            if id(node) in region and id(node) not in seen and \
+                    (not types or isinstance(node, tuple(types))):
+                seen.add(id(node))
+                yield node
+
+    # -- traced-value dataflow ----------------------------------------
+
+    def traced_locals(self, info: JitInfo) -> set:
+        """Names holding traced values inside a traced root: non-static
+        parameters + anything assigned from a traced expression."""
+        traced: set = set()
+        node = info.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            params = [p.arg for p in
+                      a.posonlyargs + a.args + a.kwonlyargs]
+            statics: set = set()
+            unknown = False
+            for b in (self.jit_bindings(node) or [info]):
+                s = b.static_params()
+                if s is None:
+                    unknown = True
+                else:
+                    statics |= s
+            # partial-bound leading/keyword params hold static Python
+            # values (e.g. pallas kernel flags bound via
+            # functools.partial(kernel, eps, rms))
+            statics.update(range(info.partial_pos))
+            statics.update(info.partial_kws)
+            if not unknown:
+                for i, p in enumerate(params):
+                    if p in ("self", "cls") or i in statics or p in statics:
+                        continue
+                    traced.add(p)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            for sub in self._walk_in_order(stmt):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    value = sub.value
+                    if value is None:
+                        continue
+                    is_traced = self.expr_is_traced(value, traced)
+                    # `acc += 1`: the target is also an operand — an
+                    # already-traced name stays traced regardless of the
+                    # (possibly constant) RHS
+                    aug_keeps = isinstance(sub, ast.AugAssign)
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                if is_traced or (aug_keeps
+                                                 and n.id in traced):
+                                    traced.add(n.id)
+                                else:
+                                    traced.discard(n.id)
+        return traced
+
+    @staticmethod
+    def _walk_in_order(node: ast.AST) -> Iterator[ast.AST]:
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from ModuleContext._walk_in_order(child)
+
+    def expr_is_traced(self, expr: ast.expr, traced: set) -> bool:
+        """Does ``expr`` reference a traced value?  ``x.shape``-style
+        static metadata reads and static jnp helpers don't count."""
+        parents: dict = {}
+        for node in ast.walk(expr):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in traced:
+                parent = parents.get(id(node))
+                if isinstance(parent, ast.Attribute) and \
+                        parent.value is node and \
+                        parent.attr in STATIC_ATTRS:
+                    continue
+                return True
+            if isinstance(node, ast.Call):
+                r = self.resolve(node.func)
+                if r and r not in STATIC_FNS and \
+                        r.startswith(TRACED_NAMESPACE_PREFIXES):
+                    return True
+        return False
+
+    # -- findings ------------------------------------------------------
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        text = self.lines[line - 1].strip() if 0 < line <= len(self.lines) \
+            else ""
+        return Finding(rule, self.path, line, col, message, text)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    if not (0 < finding.line <= len(lines)):
+        return False
+    m = _DISABLE_RE.search(lines[finding.line - 1])
+    if not m:
+        return False
+    ids = {s.strip() for s in m.group(1).split(",")}
+    return finding.rule in ids or "ALL" in ids
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Iterable] = None) -> list:
+    """Lint one module's source; returns indexed, suppression-filtered
+    findings. Parse failures surface as rule APX000."""
+    from apex_tpu.analysis.rules import all_rules
+    head = "\n".join(source.splitlines()[:5])
+    if _SKIP_FILE_RE.search(head):
+        return []
+    try:
+        ctx = ModuleContext(source, path)
+    except SyntaxError as e:
+        return [Finding("APX000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}", (e.text or "").strip())]
+    findings: list = []
+    for rule in (rules if rules is not None else all_rules()):
+        findings.extend(rule.check_module(ctx))
+    findings = [f for f in findings if not _suppressed(f, ctx.lines)]
+    return assign_indices(findings)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".eggs", "build", "dist",
+              "node_modules", ".analysis_fixtures"}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[Path]:
+    for p in paths:
+        path = Path(p)
+        if path.is_file() and path.suffix == ".py":
+            yield path
+        elif path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in sub.parts):
+                    yield sub
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               rules: Optional[Iterable] = None) -> list:
+    """Lint every .py under ``paths``; finding paths are relative to
+    ``root`` (default: cwd) so fingerprints are machine-independent."""
+    rootp = Path(root) if root else Path.cwd()
+    out: list = []
+    for f in iter_py_files(paths):
+        try:
+            rel = f.resolve().relative_to(rootp.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        out.extend(lint_source(f.read_text(encoding="utf-8"),
+                               rel, rules=rules))
+    return out

@@ -1,0 +1,53 @@
+"""Lint-rule plugin registry.
+
+A rule is a class with ``id`` / ``name`` / ``description`` and a
+``check_module(ctx)`` generator yielding :class:`~apex_tpu.analysis.
+finding.Finding`.  Register with the :func:`register` decorator; the
+engine instantiates every registered rule per run.  Adding a rule =
+dropping a module in this package that defines + registers one class
+and importing it at the bottom of this file (see README "Static
+analysis").
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Type
+
+from apex_tpu.analysis.finding import Finding
+
+
+class Rule:
+    """Base class for AST lint rules (subclass + ``@register``)."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check_module(self, ctx) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    return [REGISTRY[rid]() for rid in sorted(REGISTRY)]
+
+
+# Import order defines nothing semantic; ids keep the report ordering.
+from apex_tpu.analysis.rules import (  # noqa: E402,F401
+    control_flow,
+    donation,
+    host_sync,
+    precision,
+    prng,
+    side_effects,
+)

@@ -1,0 +1,55 @@
+"""APX104 — Python control flow branching on traced values.
+
+``if x.sum() > 0:`` inside a jitted function raises
+ConcretizationTypeError at trace time (or, with concrete tracing,
+silently bakes one branch into the compiled program).  The fix is
+``jax.lax.cond`` / ``jnp.where`` / ``lax.while_loop``.  Static branches
+(shapes, dtypes, config flags, ``static_argnums`` parameters) are fine
+and not flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+
+@register
+class TracedControlFlow(Rule):
+    id = "APX104"
+    name = "traced-python-control-flow"
+    description = ("Python if/while branching on a traced value — use "
+                   "jax.lax.cond / jnp.where / lax.while_loop")
+
+    def check_module(self, ctx):
+        seen: set = set()
+        for info in ctx.traced_roots():
+            traced = ctx.traced_locals(info)
+            body = info.node.body
+            stmts = body if isinstance(body, list) else [body]
+            for stmt in stmts:
+                for node in ast.walk(stmt):
+                    if id(node) in seen:
+                        continue
+                    if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                        test = node.test
+                        if self._identity_test(test):
+                            continue
+                        if ctx.expr_is_traced(test, traced):
+                            seen.add(id(node))
+                            kind = {"If": "if", "While": "while",
+                                    "IfExp": "conditional expression"}[
+                                type(node).__name__]
+                            yield ctx.finding(
+                                self.id, node,
+                                f"Python {kind} on a traced value inside a "
+                                f"traced function — trace-time error or a "
+                                f"baked-in branch; use jax.lax.cond / "
+                                f"jnp.where")
+
+    @staticmethod
+    def _identity_test(test: ast.expr) -> bool:
+        """``x is None`` / ``x is not None`` never concretises a tracer —
+        the standard optional-argument idiom stays quiet."""
+        return isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)

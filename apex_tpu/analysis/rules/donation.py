@@ -1,0 +1,56 @@
+"""APX105 — jitted train/update step without buffer donation.
+
+A jitted step that takes params/optimizer state and returns their
+updated versions holds BOTH copies live across the call unless the
+inputs are donated — on TPU that is the difference between fitting a
+model at N billion params and OOMing at N/2.  The rule fires on jit
+bindings of step-shaped functions (a ``state``/``params``-style
+parameter and a step-ish name) that declare no ``donate_argnums`` /
+``donate_argnames``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from apex_tpu.analysis.rules import Rule, register
+
+_DONATABLE_PARAMS = {
+    "state", "params", "opt_state", "train_state", "optimizer_state",
+    "model_state", "carry",
+}
+_STEP_NAME_RE = re.compile(r"(train|update|optimi[sz]|step)", re.IGNORECASE)
+
+
+@register
+class MissingDonation(Rule):
+    id = "APX105"
+    name = "missing-donate-argnums"
+    description = ("jitted train/update step returns new params/opt-state "
+                   "but does not donate the old buffers "
+                   "(donate_argnums/donate_argnames)")
+
+    def check_module(self, ctx):
+        reported: set = set()
+        for info in ctx.jit_infos:
+            if not info.is_jit or id(info.node) in reported:
+                continue
+            node = info.node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _STEP_NAME_RE.search(node.name):
+                continue
+            a = node.args
+            params = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+            hits = params & _DONATABLE_PARAMS
+            if not hits:
+                continue
+            if any(b.binding_kwarg("donate_argnums", "donate_argnames")
+                   is not None for b in ctx.jit_bindings(node)):
+                continue
+            reported.add(id(node))
+            yield ctx.finding(
+                self.id, node,
+                f"jitted step '{node.name}' takes {sorted(hits)} but "
+                f"donates nothing — pass donate_argnums so XLA reuses the "
+                f"old buffers in place")

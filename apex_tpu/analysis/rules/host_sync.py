@@ -1,0 +1,97 @@
+"""APX101 — host-sync calls inside traced code.
+
+``.item()`` / ``.tolist()`` / ``float(tracer)`` / ``np.asarray`` /
+``block_until_ready`` inside a jitted (or otherwise traced) function
+either fail at trace time with a ConcretizationTypeError or — worse,
+when the value is an abstract-safe constant — silently force a
+host↔device round trip per step, serialising the dispatch pipeline.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+# method calls that synchronise regardless of receiver type
+_SYNC_METHODS = {"item", "tolist", "block_until_ready",
+                 "copy_to_host_async"}
+# module-level functions that pull data to host
+_SYNC_FNS = {"jax.device_get", "numpy.asarray", "numpy.array",
+             "numpy.frombuffer"}
+# builtins that concretise — flagged only on traced-looking operands
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "APX101"
+    name = "host-sync-in-jit"
+    description = ("host-synchronising call inside a traced function "
+                   "(.item()/.tolist()/float(tracer)/np.asarray/"
+                   "block_until_ready)")
+
+    def check_module(self, ctx):
+        traced_by_root: dict = {}
+        seen: set = set()   # nodes reported once — traced roots can nest
+        for info in ctx.traced_roots():
+            traced = ctx.traced_locals(info)
+            # params are "maybe traced"; names *derived* from jnp math
+            # are certainly traced — float()/int() only flags the latter
+            params = set()
+            if hasattr(info.node, "args"):
+                a = info.node.args
+                params = {p.arg for p in
+                          a.posonlyargs + a.args + a.kwonlyargs}
+            traced_by_root[id(info.node)] = (traced, traced - params)
+            for node in self._walk_body(info.node):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                        and not node.args:
+                    yield ctx.finding(
+                        self.id, node,
+                        f".{f.attr}() synchronises with the host inside a "
+                        f"traced function — hoist it out of the "
+                        f"jit/shard_map boundary")
+                    continue
+                r = ctx.resolve(f)
+                if r in _SYNC_FNS:
+                    if self._arg_traced(ctx, node,
+                                        traced_by_root[id(info.node)][0]):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"{r}() on a traced value forces a device→host "
+                            f"transfer (use jnp.asarray / keep it a jax "
+                            f"Array)")
+                    continue
+                if isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS \
+                        and r == f.id:
+                    if self._arg_traced(ctx, node,
+                                        traced_by_root[id(info.node)][1],
+                                        require_derived=True):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"{f.id}() concretises a traced value "
+                            f"(ConcretizationTypeError at trace time, or a "
+                            f"silent sync) — keep it as an array or mark "
+                            f"the argument static")
+
+    @staticmethod
+    def _walk_body(root):
+        body = root.body if isinstance(root.body, list) else [root.body]
+        for stmt in body:
+            yield from ast.walk(stmt)
+
+    @staticmethod
+    def _arg_traced(ctx, call, traced, require_derived=False):
+        if not call.args:
+            return False
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant):
+            return False
+        # require_derived passes the jnp-derived subset of traced names,
+        # so float(eps)-style coercion of a plain param stays quiet while
+        # float(jnp.sum(x)) and float(loss_value) fire.
+        return ctx.expr_is_traced(arg, traced)

@@ -1,0 +1,62 @@
+"""APX106 — fp32-defaulting array factories inside traced code.
+
+``jnp.zeros(n)`` / ``jnp.array(0.5)`` / ``jnp.linspace(...)`` with no
+``dtype=`` produce float32, and one fp32 operand silently promotes a
+whole bf16 expression chain to fp32 — doubling the bytes every
+downstream op moves and halving effective MXU throughput.  (Bare Python
+float literals are weakly typed and do NOT promote, so they are not
+flagged; the materialised-constant factories are the real hazard.)
+Deliberate fp32 accumulators state their dtype and stay quiet.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+# factories whose default dtype is float32 regardless of arguments
+_ALWAYS_FLOAT = {"zeros", "ones", "empty", "eye", "identity", "linspace"}
+# factories whose dtype follows a float argument
+_VALUE_FLOAT = {"array", "asarray", "full", "arange"}
+_NAMESPACES = ("jax.numpy.", "numpy.")
+
+
+@register
+class Fp32DefaultFactory(Rule):
+    id = "APX106"
+    name = "fp32-default-factory"
+    description = ("array factory without dtype= inside traced code "
+                   "defaults to float32 and silently upcasts bf16 math")
+
+    def check_module(self, ctx):
+        for node in ctx.iter_traced(ast.Call):
+            r = ctx.resolve(node.func)
+            if not r or not r.startswith(_NAMESPACES):
+                continue
+            member = r.rsplit(".", 1)[1]
+            if member not in _ALWAYS_FLOAT and member not in _VALUE_FLOAT:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            # positional dtype: np.zeros(shape, dtype) / full(shape, v, dtype)
+            limit = {"zeros": 1, "ones": 1, "empty": 1, "eye": 3,
+                     "identity": 1, "linspace": 5, "array": 1,
+                     "asarray": 1, "full": 2, "arange": 3}.get(member, 1)
+            if len(node.args) > limit:
+                continue
+            if member in _VALUE_FLOAT and not self._has_float_const(node):
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"{r}(...) without dtype= materialises float32 — one fp32 "
+                f"operand promotes the whole bf16 chain; pass dtype= "
+                f"(or x.dtype) explicitly")
+
+    @staticmethod
+    def _has_float_const(call: ast.Call) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, float):
+                    return True
+        return False

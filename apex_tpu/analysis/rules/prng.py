@@ -1,0 +1,169 @@
+"""APX103 — PRNG key consumed twice without a split.
+
+JAX keys are values, not stateful generators: sampling twice with the
+same key yields *identical* randomness (correlated dropout masks,
+duplicated initialisations).  The rule tracks, per function, every name
+bound to a key and flags a second consuming use — ``jax.random``
+samplers and ``split`` consume; ``fold_in`` derives (safe) and
+rebinding (``key, sub = jax.random.split(key)``) resets.
+
+Loops are handled by visiting their bodies twice: a consumption whose
+key isn't rebound within the body trips on the second pass, which is
+exactly the runtime behaviour (same key every iteration).
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+_CONSUMERS = {
+    "normal", "uniform", "bernoulli", "randint", "permutation", "shuffle",
+    "categorical", "gumbel", "truncated_normal", "choice", "dirichlet",
+    "beta", "gamma", "exponential", "laplace", "logistic", "poisson",
+    "rademacher", "cauchy", "multivariate_normal", "t", "maxwell",
+    "orthogonal", "ball", "bits", "split",
+}
+_DERIVERS = {"fold_in", "clone", "wrap_key_data"}
+_KEY_SOURCES = {"PRNGKey", "key", "split", "fold_in", "clone"}
+
+
+@register
+class PRNGKeyReuse(Rule):
+    id = "APX103"
+    name = "prng-key-reuse"
+    description = ("PRNG key consumed by two jax.random calls without an "
+                   "intervening split — identical randomness both times")
+
+    def check_module(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, node)
+
+    def _check_function(self, ctx, func):
+        findings: list = []
+        reported: set = set()
+        # uses: name -> first consuming call node since last rebind
+        self._visit_block(ctx, func.body, {}, findings, reported, func)
+        yield from findings
+
+    def _random_member(self, ctx, call) -> str:
+        r = ctx.resolve(call.func)
+        if r and r.startswith("jax.random."):
+            return r.rsplit(".", 1)[1]
+        return ""
+
+    def _key_arg(self, call):
+        if call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        for kw in call.keywords:
+            if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                return kw.value.id
+        return None
+
+    def _bound_names(self, target) -> set:
+        return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+    def _visit_block(self, ctx, stmts, uses, findings, reported, func):
+        """uses maps key-name -> consuming call node (None once reported)."""
+        for stmt in stmts:
+            self._visit_stmt(ctx, stmt, uses, findings, reported, func)
+
+    _COMPOUND = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+                 ast.AsyncWith, ast.Try)
+
+    def _visit_stmt(self, ctx, stmt, uses, findings, reported, func):
+        # consumptions in this statement's own expressions — for compound
+        # statements only the header (test/iter/items), since their
+        # bodies are recursed into separately below (walking the whole
+        # subtree here would double-count every nested consumption)
+        if isinstance(stmt, self._COMPOUND):
+            headers = []
+            if isinstance(stmt, (ast.If, ast.While)):
+                headers = [stmt.test]
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                headers = [stmt.iter]
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                headers = [i.context_expr for i in stmt.items]
+            scan = [n for h in headers for n in self._walk_no_nested(h)]
+        else:
+            scan = self._walk_no_nested(stmt)
+        for node in scan:
+            if isinstance(node, ast.Call):
+                member = self._random_member(ctx, node)
+                if member in _CONSUMERS:
+                    name = self._key_arg(node)
+                    if name:
+                        prev = uses.get(name, None)
+                        if prev is not None and id(node) not in reported:
+                            reported.add(id(node))
+                            findings.append(ctx.finding(
+                                self.id, node,
+                                f"key '{name}' already consumed at line "
+                                f"{prev.lineno} — split it "
+                                f"(jax.random.split) before reusing"))
+                        elif prev is None:
+                            uses[name] = node
+        # rebindings reset consumption state
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                for name in self._bound_names(t):
+                    uses.pop(name, None)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for name in self._bound_names(stmt.target):
+                uses.pop(name, None)
+            # two passes: keys bound outside and consumed inside the
+            # loop body without rebinding trip on the second pass
+            for _ in range(2):
+                self._visit_block(ctx, stmt.body, uses, findings,
+                                  reported, func)
+            self._visit_block(ctx, stmt.orelse, uses, findings,
+                              reported, func)
+            return
+        elif isinstance(stmt, ast.While):
+            for _ in range(2):
+                self._visit_block(ctx, stmt.body, uses, findings,
+                                  reported, func)
+            self._visit_block(ctx, stmt.orelse, uses, findings,
+                              reported, func)
+            return
+        elif isinstance(stmt, ast.If):
+            # disjoint branches are not double-consumption: fork state
+            before = dict(uses)
+            self._visit_block(ctx, stmt.body, uses, findings, reported,
+                              func)
+            other = dict(before)
+            self._visit_block(ctx, stmt.orelse, other, findings, reported,
+                              func)
+            for k, v in other.items():
+                uses.setdefault(k, v)
+            return
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._visit_block(ctx, stmt.body, uses, findings, reported,
+                              func)
+            return
+        elif isinstance(stmt, ast.Try):
+            for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                self._visit_block(ctx, block, uses, findings, reported,
+                                  func)
+            for h in stmt.handlers:
+                self._visit_block(ctx, h.body, uses, findings, reported,
+                                  func)
+            return
+
+    @staticmethod
+    def _walk_no_nested(stmt):
+        """ast.walk, but don't descend into nested function/class defs
+        (those are analysed on their own)."""
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            if node is not stmt and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Lambda, ast.GeneratorExp,
+                           ast.ListComp, ast.SetComp, ast.DictComp)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,43 @@
+"""APX102 — Python side effects under trace.
+
+``print`` / ``logging`` calls inside a jitted function run ONCE at
+trace time (printing tracer reprs, not values) and then never again —
+the classic "why did my debug print show Traced<ShapedArray…>" trap.
+``jax.debug.print`` / ``jax.debug.callback`` are the sanctioned
+equivalents and are not flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+_LOGGING_PREFIXES = ("logging.",)
+
+
+@register
+class SideEffectUnderJit(Rule):
+    id = "APX102"
+    name = "print-in-jit"
+    description = ("print/logging call inside a traced function — runs at "
+                   "trace time only; use jax.debug.print")
+
+    def check_module(self, ctx):
+        for node in ctx.iter_traced(ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print" \
+                    and ctx.resolve(f) == "print":
+                yield ctx.finding(
+                    self.id, node,
+                    "print() under trace fires once at trace time with "
+                    "tracer reprs — use jax.debug.print(...)")
+                continue
+            r = ctx.resolve(f)
+            if r and r.startswith(_LOGGING_PREFIXES) and \
+                    isinstance(f, ast.Attribute) and \
+                    f.attr in ("debug", "info", "warning", "error",
+                               "critical", "exception", "log"):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{r}() under trace fires once at trace time — use "
+                    f"jax.debug.print or log outside the jitted region")

@@ -164,7 +164,8 @@ def main(argv=None):
         """Whole training run inside ONE shard_map: per-rank TP-sharded
         layer init (axis_index-folded keys), then lax.scan over steps —
         the sharded optimizer state never crosses the jit boundary."""
-        x0 = jnp.zeros((args.seq, args.micro_batch_size, args.hidden))
+        x0 = jnp.zeros((args.seq, args.micro_batch_size, args.hidden),
+                       dtype=jnp.float32)
         pipe_rank = jax.lax.axis_index("pipe") if args.pp > 1 else 0
         embed0 = jax.random.normal(            # replicated tied embedding
             jax.random.PRNGKey(args.seed + 1),

@@ -26,6 +26,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+import apex_tpu._jax_compat  # noqa: F401  (grafts jax.shard_map on old jax)
+
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -41,6 +44,17 @@ def main(expert_parallel_size: int = 2):
     parallel_state.destroy_model_parallel()
     parallel_state.initialize_model_parallel(
         expert_model_parallel_size_=expert_parallel_size)
+    # the ep>1 parallel_state is this example's, not the process's:
+    # leaving it initialized (even on a failure partway through) makes
+    # every later axis_name=None reduction resolve to ('data', 'expert')
+    # and fail in callers running their own mesh
+    try:
+        return _train(expert_parallel_size)
+    finally:
+        parallel_state.destroy_model_parallel()
+
+
+def _train(expert_parallel_size):
     mesh = parallel_state.get_mesh()
     ep = expert_parallel_size
     dp = mesh.shape["data"]
@@ -81,7 +95,7 @@ def main(expert_parallel_size: int = 2):
             for p in path) else P(),
         struct)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(param_specs, P(("data", "expert")),

@@ -22,6 +22,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+import apex_tpu._jax_compat  # noqa: F401  (grafts jax.shard_map on old jax)
+
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -58,7 +61,7 @@ def main():
         logp = jax.nn.log_softmax(logits)
         return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(P(), P(), P("data"), P("data")),
         out_specs=(P(), P()), check_vma=False)

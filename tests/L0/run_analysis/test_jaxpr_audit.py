@@ -1,0 +1,92 @@
+"""Jaxpr auditor: the shipped specs hold, and each invariant fires when
+seeded with a violation."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.analysis.jaxpr_audit import (
+    OpSpec, audit_op, op_specs, run_jaxpr_audit,
+)
+
+
+def test_all_public_ops_pass():
+    assert run_jaxpr_audit() == []
+
+
+def test_covers_at_least_five_ops():
+    assert len(op_specs()) >= 5
+
+
+@pytest.mark.parametrize("name", [s.name for s in op_specs()])
+def test_each_op_passes_individually(name):
+    assert run_jaxpr_audit([name]) == []
+
+
+def test_unknown_op_rejected():
+    with pytest.raises(ValueError, match="unknown op"):
+        run_jaxpr_audit(["definitely_not_an_op"])
+
+
+# --- seeded violations ------------------------------------------------------
+
+def _spec(fn, args, out_dtypes=None, budget=0, name="seeded"):
+    return OpSpec(name, "tests/seeded.py", lambda: (fn, args),
+                  out_dtypes, budget)
+
+
+def test_upcast_violation_fires():
+    # an fp32 constant multiplied into a bf16 value: the convert feeds
+    # mul (not an accumulator) — exactly the silent-promotion hazard
+    def bad(x):
+        c = jnp.asarray(1.5, dtype=jnp.float32)
+        return (x.astype(jnp.float32) * c).astype(jnp.bfloat16)
+
+    x = jax.ShapeDtypeStruct((8, 128), jnp.bfloat16)
+    fs = audit_op(_spec(bad, (x,), budget=0))
+    assert [f.rule for f in fs] == ["APX201"]
+
+
+def test_accumulator_upcast_is_allowed():
+    # upcast feeding a reduction is the sanctioned fp32-accumulate
+    def good(x):
+        return jnp.sum(x.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    x = jax.ShapeDtypeStruct((8, 128), jnp.bfloat16)
+    assert audit_op(_spec(good, (x,), budget=0)) == []
+
+
+def test_host_callback_violation_fires():
+    def bad(x):
+        jax.debug.print("x0 {v}", v=x[0, 0])
+        return x * 2
+
+    x = jax.ShapeDtypeStruct((8, 128), jnp.bfloat16)
+    fs = audit_op(_spec(bad, (x,), budget=None))
+    assert [f.rule for f in fs] == ["APX202"]
+
+
+def test_output_dtype_violation_fires():
+    def bad(x):
+        return x.astype(jnp.float32)   # policy says bf16 out
+
+    x = jax.ShapeDtypeStruct((8, 128), jnp.bfloat16)
+    fs = audit_op(_spec(bad, (x,), out_dtypes=("bfloat16",), budget=None))
+    assert [f.rule for f in fs] == ["APX203"]
+
+
+def test_trace_failure_fires():
+    def bad(x):
+        raise RuntimeError("signature drifted")
+
+    x = jax.ShapeDtypeStruct((8, 128), jnp.bfloat16)
+    fs = audit_op(_spec(bad, (x,), budget=None))
+    assert [f.rule for f in fs] == ["APX200"]
+
+
+def test_layer_norm_budget_is_tight():
+    # the committed budget equals the measured entry upcasts — one MORE
+    # unexplained upcast in the kernel must fail the audit
+    spec = next(s for s in op_specs() if s.name == "layer_norm")
+    tight = OpSpec(spec.name, spec.path, spec.build, spec.out_dtypes,
+                   spec.upcast_budget - 1)
+    assert [f.rule for f in audit_op(tight)] == ["APX201"]

@@ -1,0 +1,315 @@
+"""Per-rule fixture tests: every lint rule has one known-violating and
+one clean snippet, asserting the exact rule id fires (and nothing fires
+on the clean twin)."""
+import pytest
+
+from apex_tpu.analysis import lint_source
+
+# Each entry: rule id -> (firing fixture, clean fixture).  The clean
+# twin is the *corrected* version of the same code, so these double as
+# documentation of the sanctioned pattern.
+FIXTURES = {
+    "APX101": (
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    loss = jnp.sum(x)
+    return loss.item()
+''',
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.sum(x)
+
+def run(x):
+    return step(x).item()   # sync OUTSIDE the jit boundary is fine
+''',
+    ),
+    "APX102": (
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x)
+    print("loss:", y)
+    return y
+''',
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x)
+    jax.debug.print("loss: {y}", y=y)
+    return y
+''',
+    ),
+    "APX103": (
+        '''
+import jax
+
+def sample(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a + b
+''',
+        '''
+import jax
+
+def sample(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+''',
+    ),
+    "APX104": (
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    s = jnp.sum(x)
+    if s > 0:
+        return s
+    return -s
+''',
+        '''
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def step(x, causal):
+    s = jnp.sum(x)
+    if causal:               # static flag — fine
+        s = s * 2
+    return jnp.where(s > 0, s, -s)
+''',
+    ),
+    "APX105": (
+        '''
+import jax
+
+@jax.jit
+def train_step(state, batch):
+    return state, batch
+''',
+        '''
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state, batch
+''',
+    ),
+    "APX106": (
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    acc = jnp.zeros(x.shape)
+    return x + acc
+''',
+        '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    acc = jnp.zeros(x.shape, dtype=x.dtype)
+    return x + acc
+''',
+    ),
+}
+
+
+def rules_of(src):
+    return {f.rule for f in lint_source(src, "fixture.py")}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_fires_on_violation(rule):
+    bad, _ = FIXTURES[rule]
+    assert rule in rules_of(bad), f"{rule} did not fire on its fixture"
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_quiet_on_clean(rule):
+    _, good = FIXTURES[rule]
+    fired = rules_of(good)
+    assert rule not in fired, f"{rule} fired on the clean fixture: {fired}"
+
+
+def test_clean_fixtures_fully_clean():
+    # the corrected twins must not trip ANY rule, not just their own
+    for rule, (_, good) in FIXTURES.items():
+        assert rules_of(good) == set(), \
+            f"clean fixture for {rule} trips {rules_of(good)}"
+
+
+# --- engine behaviours ------------------------------------------------------
+
+def test_syntax_error_is_a_finding():
+    fs = lint_source("def broken(:\n", "broken.py")
+    assert [f.rule for f in fs] == ["APX000"]
+
+
+def test_inline_suppression():
+    src = FIXTURES["APX102"][0].replace(
+        'print("loss:", y)',
+        'print("loss:", y)  # apex-lint: disable=APX102')
+    assert "APX102" not in rules_of(src)
+
+
+def test_skip_file_marker():
+    src = "# apex-lint: skip-file\n" + FIXTURES["APX101"][0]
+    assert lint_source(src, "skipped.py") == []
+
+
+def test_jit_wrap_form_detected():
+    # f = jax.jit(f) after the def, not a decorator
+    src = '''
+import jax
+import jax.numpy as jnp
+
+def step(x):
+    return jnp.sum(x).item()
+
+step = jax.jit(step)
+'''
+    assert "APX101" in rules_of(src)
+
+
+def test_shard_map_body_is_traced():
+    src = '''
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+def run(mesh, x):
+    def body(x):
+        print("inside", x)
+        return x
+    return jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P())(x)
+'''
+    assert "APX102" in rules_of(src)
+
+
+def test_partial_bound_kernel_flags_are_static():
+    # functools.partial(kernel, eps, rms) binds static Python values —
+    # branching on them inside a pallas kernel is fine
+    src = '''
+import functools
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+def _kernel(eps, rms, x_ref, o_ref):
+    x = x_ref[...]
+    if rms:
+        o_ref[...] = x * eps
+    else:
+        o_ref[...] = x + eps
+
+def norm(x, eps, rms):
+    return pl.pallas_call(
+        functools.partial(_kernel, eps, rms),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+'''
+    assert "APX104" not in rules_of(src)
+
+
+def test_augassign_does_not_launder_traced_names():
+    # acc += 1 keeps acc traced — the target is also an operand
+    src = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    acc = jnp.sum(x)
+    acc += 1
+    if acc > 0:
+        return acc
+    return -acc
+'''
+    assert "APX104" in rules_of(src)
+
+
+def test_is_none_branch_not_flagged():
+    src = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x, mask):
+    if mask is None:
+        return jnp.sum(x)
+    return jnp.sum(x * mask)
+'''
+    assert "APX104" not in rules_of(src)
+
+
+def test_key_reuse_across_loop_iterations():
+    src = '''
+import jax
+
+def sample(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, (4,)))
+    return out
+'''
+    assert "APX103" in rules_of(src)
+
+
+def test_key_rebound_in_loop_is_clean():
+    src = '''
+import jax
+
+def sample(key, n):
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (4,)))
+    return out
+'''
+    assert "APX103" not in rules_of(src)
+
+
+def test_key_use_in_disjoint_branches_is_clean():
+    src = '''
+import jax
+
+def sample(key, flag):
+    if flag:
+        return jax.random.normal(key, (4,))
+    else:
+        return jax.random.uniform(key, (4,))
+'''
+    assert "APX103" not in rules_of(src)
+
+
+def test_fingerprint_stable_under_line_shift():
+    bad, _ = FIXTURES["APX101"]
+    f1 = [f for f in lint_source(bad, "m.py") if f.rule == "APX101"]
+    f2 = [f for f in lint_source("# pad\n# pad\n" + bad, "m.py")
+          if f.rule == "APX101"]
+    assert f1 and f2
+    assert f1[0].fingerprint == f2[0].fingerprint
+    assert f1[0].line != f2[0].line

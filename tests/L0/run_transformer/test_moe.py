@@ -810,7 +810,7 @@ def test_interleaved_with_expert_parallel_moe_stage():
 
     def body(batch):
         pipe_r = jax.lax.axis_index("pipe")
-        x0 = jnp.zeros((micro_bs, hid))
+        x0 = jnp.zeros((micro_bs, hid), dtype=jnp.float32)
         # chunk c on rank r is virtual stage c*pp + r; fold the stage id
         # into the init key so every virtual stage draws distinct params
         chunks = jax.tree.map(

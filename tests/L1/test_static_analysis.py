@@ -1,0 +1,109 @@
+"""Tier-1 gate: the static-analysis CLI runs the whole package clean
+against the committed baseline, and the ratchet actually ratchets —
+a seeded violation exits nonzero until it is baselined."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from apex_tpu.analysis.cli import main, repo_root
+
+REPO = repo_root()
+
+VIOLATION = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.sum(x).item()
+'''
+
+
+def test_full_package_clean_in_process():
+    # the whole-repo run tier-1 gates on: lint + jaxpr audit, committed
+    # baseline, exit 0 (in-process so the fast lane keeps it)
+    assert main([]) == 0
+
+
+def test_seeded_violation_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(VIOLATION)
+    rc = main([str(bad), "--no-jaxpr",
+               "--baseline", str(tmp_path / "absent.json")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "APX101" in out and "1 new finding(s)" in out
+
+
+def test_baseline_suppresses_then_ratchets(tmp_path, capsys):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(VIOLATION)
+    baseline = tmp_path / "baseline.json"
+
+    # pin the existing debt
+    assert main([str(bad), "--no-jaxpr", "--write-baseline",
+                 "--baseline", str(baseline)]) == 0
+    pinned = json.loads(baseline.read_text())
+    assert len(pinned["findings"]) == 1
+
+    # pinned debt no longer fails
+    assert main([str(bad), "--no-jaxpr",
+                 "--baseline", str(baseline)]) == 0
+
+    # ...but a NEW violation in the same file still does
+    bad.write_text(VIOLATION + '''
+
+@jax.jit
+def step2(x):
+    return jnp.sum(x).tolist()
+''')
+    capsys.readouterr()
+    assert main([str(bad), "--no-jaxpr",
+                 "--baseline", str(baseline)]) == 1
+    assert "1 new finding(s), 1 baselined" in capsys.readouterr().out
+
+
+def test_write_baseline_refuses_restricted_scan(tmp_path):
+    # a paths/--no-* restricted scan must not replace the shared repo
+    # baseline (it would drop pinned findings outside the scan scope);
+    # an explicit --baseline target is the sanctioned scoped write
+    bad = tmp_path / "seeded.py"
+    bad.write_text(VIOLATION)
+    assert main([str(bad), "--no-jaxpr", "--write-baseline"]) == 2
+    assert main([str(bad), "--no-jaxpr", "--write-baseline",
+                 "--baseline", str(tmp_path / "scoped.json")]) == 0
+
+
+def test_committed_baseline_is_current():
+    # .analysis_baseline.json must stay in sync with the code: every
+    # pinned fingerprint should still correspond to a real finding
+    # (stale entries mean someone fixed a finding without re-pinning)
+    from apex_tpu.analysis.cli import BASELINE_NAME, load_baseline
+    from apex_tpu.analysis.jaxpr_audit import run_jaxpr_audit
+    from apex_tpu.analysis.lint import lint_paths
+
+    path = REPO / BASELINE_NAME
+    assert path.is_file(), "committed baseline missing"
+    pinned = load_baseline(path)
+    live = {f.fingerprint
+            for f in lint_paths([str(REPO / p) for p in
+                                 ("apex_tpu", "bench.py", "examples",
+                                  "tests") if (REPO / p).exists()],
+                                root=str(REPO))}
+    live |= {f.fingerprint for f in run_jaxpr_audit()}
+    stale = pinned - live
+    assert not stale, f"baseline entries no longer firing: {sorted(stale)}"
+
+
+@pytest.mark.slow
+def test_console_entrypoint_subprocess():
+    # python -m path works end to end in a fresh interpreter (<30 s
+    # acceptance budget; slow lane because of the cold jax import)
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "-q"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
