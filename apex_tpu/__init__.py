@@ -7,7 +7,10 @@ Reference: shawnwang18/apex (fork of NVIDIA/apex).  Layer map (see SURVEY.md):
   functors, scaled-masked softmax, RoPE, fused attention, xentropy).
 * ``apex_tpu.multi_tensor_apply`` — ``MultiTensorApply`` parity shim.
 * ``apex_tpu.optimizers``     — FusedAdam / FusedLAMB / FusedSGD / FusedNovoGrad
-  / FusedAdagrad over the fused-update kernel (reference: ``apex/optimizers``).
+  / FusedAdagrad over the fused-update kernel (reference: ``apex/optimizers``),
+  plus ``optimizers.functional`` — the flat-native pure init/update core.
+* ``apex_tpu.train_step``     — flat-native train-step builder: forward,
+  backward, loss scaling, and the fused update as ONE donated XLA program.
 * ``apex_tpu.normalization``  — FusedLayerNorm / FusedRMSNorm modules
   (reference: ``apex/normalization/fused_layer_norm.py``).
 * ``apex_tpu.amp``            — opt-level O0–O3 mixed precision with functional
@@ -48,6 +51,7 @@ _SUBMODULES = (
     "transformer",
     "contrib",
     "models",
+    "train_step",
     "utils",
 )
 

@@ -20,7 +20,8 @@ from apex_tpu.ops.fused_update import fused_scale
 from apex_tpu.utils import tree_ravel
 
 __all__ = ["LossScaleState", "init_loss_scale", "scale_loss_value",
-           "unscale_grads", "update_scale", "LossScaler"]
+           "unscale_grads", "unscale_flat_grads", "update_scale",
+           "LossScaler"]
 
 # Reference constants (apex/amp/scaler.py)
 DEFAULT_INIT_SCALE = 2.0 ** 16
@@ -65,6 +66,18 @@ def unscale_grads(grads, state: LossScaleState):
     flat, unravel = tree_ravel(grads)
     out, flag = fused_scale(flat, 1.0 / state.loss_scale)
     return unravel(out), state.replace(found_inf=flag)
+
+
+def unscale_flat_grads(flat_grads, state: LossScaleState):
+    """Flat-native :func:`unscale_grads`: same fused unscale + overflow
+    detection, but over an already-flat grad buffer — the variant the
+    flat-native train step uses, where autodiff produced flat grads and
+    a tree round-trip would reintroduce the re-ravel concatenate.
+
+    Returns (unscaled_flat_grads, new_state with found_inf set).
+    """
+    out, flag = fused_scale(flat_grads, 1.0 / state.loss_scale)
+    return out, state.replace(found_inf=flag)
 
 
 def update_scale(state: LossScaleState,

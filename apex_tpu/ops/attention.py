@@ -791,6 +791,21 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
             "dropout_rate > 0 requires dropout_seed (reusing an "
             "implicit constant seed would repeat the same mask "
             "every training step)")
+    # validate the mask contract BEFORE the use_kernel dispatch so the
+    # short-seq XLA path and the kernel path enforce the same shape —
+    # a malformed mask must not silently broadcast on one side of the
+    # auto-dispatch boundary and error on the other (ADVICE r5 #1)
+    if mask is not None:
+        shape_ok = (mask.ndim == 4
+                    and mask.shape[0] in (1, b)
+                    and mask.shape[1] in (1, h)
+                    and mask.shape[2] in (1, sq)
+                    and mask.shape[3] in (1, sk))
+        if not shape_ok:
+            raise ValueError(
+                f"mask must be boolean [b|1, h|1, sq|1, sk|1] "
+                f"(broadcastable to [{b}, {h}, {sq}, {sk}]); got "
+                f"{tuple(mask.shape)}")
     if use_kernel is None:
         use_kernel = (block_q is not None or block_k is not None
                       or max(sq, sk) > _XLA_PATH_MAX_SEQ
@@ -822,8 +837,7 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     v3 = v.reshape(b * h, sk, d)
     mask3 = None
     if mask is not None:
-        if mask.ndim != 4:
-            raise ValueError("mask must be [b|1, h|1, sq, sk] boolean")
+        # shape already validated ahead of the use_kernel dispatch
         mb, mh = mask.shape[0], mask.shape[1]
         if mh == 1:
             mask3 = jnp.broadcast_to(

@@ -3,7 +3,17 @@
 Each optimizer runs its whole update as one fused program over a flat fp32
 master buffer per param group — the TPU-native equivalent of the reference's
 multi-tensor kernel launches (see :mod:`apex_tpu.ops.fused_update`).
+
+Two entry points over the same math:
+
+* the class API below (torch-parity: construct with params, call
+  ``step(grads)``, ``state_dict``/``load_state_dict``);
+* :mod:`apex_tpu.optimizers.functional` — pure ``init``/``update``
+  transforms over flat state, for fully-jitted train steps where
+  forward, backward, scaler, and update lower to ONE donated program
+  (see :mod:`apex_tpu.train_step`).
 """
+from apex_tpu.optimizers import functional
 from apex_tpu.optimizers.base import FusedOptimizerBase
 from apex_tpu.optimizers.fused_adam import FusedAdam
 from apex_tpu.optimizers.fused_sgd import FusedSGD
@@ -15,4 +25,5 @@ from apex_tpu.optimizers.fused_mixed_precision_lamb import (
 )
 
 __all__ = ["FusedOptimizerBase", "FusedAdam", "FusedSGD", "FusedLAMB",
-           "FusedAdagrad", "FusedNovoGrad", "FusedMixedPrecisionLamb"]
+           "FusedAdagrad", "FusedNovoGrad", "FusedMixedPrecisionLamb",
+           "functional"]

@@ -17,6 +17,7 @@ Differences from torch semantics, by design (functional JAX):
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Sequence
 
 import jax
@@ -108,6 +109,16 @@ class _Group:
         return gflat
 
 
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("tx",))
+def _apply_update(state, gflat, noop_flag, grad_scale, hyper, *, tx):
+    """One functional update as ONE donated program (shared by every
+    subclass; the per-rule transform is a hashable static, so identical
+    configurations share the compile cache).  Hyperparameters travel as
+    traced scalars — mutating ``group.options["lr"]`` between steps
+    (torch-style LR scheduling) does not recompile."""
+    return tx.update(state, gflat, noop_flag=noop_flag,
+                     grad_scale=grad_scale, **hyper)
+
 
 class FusedOptimizerBase:
     """Base for FusedAdam/FusedLAMB/FusedSGD/FusedNovoGrad/FusedAdagrad.
@@ -158,16 +169,51 @@ class FusedOptimizerBase:
         self.param_groups = groups
         self._step_count = 0
         for g in self.param_groups:
+            g.tx = self._make_tx(g.options)
             self._init_group_state(g)
 
     # -- subclass interface -------------------------------------------------
-    def _init_group_state(self, group: _Group) -> None:
+    def _make_tx(self, options: dict):
+        """Build the group's functional transform
+        (:mod:`apex_tpu.optimizers.functional`) from the STATIC parts of
+        its options; per-step hyperparameters come from
+        :meth:`_traced_hyper`."""
         raise NotImplementedError
+
+    def _traced_hyper(self, options: dict) -> dict:
+        """The group's per-step hyperparameters as traced f32 scalars."""
+        raise NotImplementedError
+
+    def _init_group_state(self, group: _Group) -> None:
+        group.state = dict(group.tx.init_slots(group.master,
+                                               sizes=tuple(group.sizes)))
 
     def _step_group(self, group: _Group, gflat: jax.Array, step: int,
                     noop_flag, grad_scale) -> None:
-        """Update group.master and group.state in place (jitted inside)."""
-        raise NotImplementedError
+        """Update group.master and group.state in place — a thin
+        stateful shell over the functional core: pack the group into a
+        FlatState, run ONE donated program, unpack."""
+        from apex_tpu.optimizers import functional
+        # rebuild the transform from the CURRENT options: torch-idiom
+        # mid-training mutation of static knobs (bias_correction,
+        # nesterov, ...) must keep taking effect, as it did when the
+        # step re-read options directly.  Unchanged options produce an
+        # equal (frozen, hashable) tx, so the jit cache still hits.
+        group.tx = self._make_tx(group.options)
+        state = functional.FlatState(
+            master=group.master,
+            # update() advances the count: seed it one behind the class
+            # counter so bias corrections see the identical step value
+            count=jnp.asarray(step - 1, jnp.float32),
+            slots=group.state,
+            sizes=tuple(group.sizes))
+        state = _apply_update(
+            state, gflat,
+            jnp.asarray(noop_flag, jnp.float32),
+            jnp.asarray(grad_scale, jnp.float32),
+            self._traced_hyper(group.options), tx=group.tx)
+        group.master = state.master
+        group.state = dict(state.slots)
 
     # -- public API ---------------------------------------------------------
     @property

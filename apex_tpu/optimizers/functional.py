@@ -1,0 +1,431 @@
+"""Flat-native functional optimizer core.
+
+Pure ``init(params) -> FlatState`` / ``update(state, flat_grads, ...) ->
+FlatState`` pairs for the five fused rules (Adam, LAMB, SGD, NovoGrad,
+Adagrad), each backed by the same Pallas kernels in
+:mod:`apex_tpu.ops.fused_update` that the class API drives.
+
+Why this exists (PERF.md r5): the class API's ``step(grads)`` takes a
+grad *pytree*, re-ravels it (a 297-leaf ``concatenate`` on BERT-large)
+and returns unraveled params every step — ~40 ms of the 112.7 ms BERT
+step was this repacking plus the host-driven dispatch of unscale /
+update as separate executables.  The functional core removes the
+structural overhead instead of the kernel cost (which is already
+HBM-bound): state is ONE flat fp32 master plus flat slot buffers,
+``update`` is a pure function over them, and a whole train step —
+forward, backward, scaler, fused update — composes into a single
+donated XLA program (see :mod:`apex_tpu.train_step`).  Keep the flat
+master as the *differentiation variable* (``jax.value_and_grad(lambda
+flat: loss(state.unravel(flat)))``) and autodiff produces flat grads
+directly: no re-ravel concatenate exists in the program at all, and the
+per-leaf unravel slices fuse into the forward.
+
+Contracts:
+
+* **Scan-carryable.** ``update`` returns ``state.replace(...)`` — the
+  treedef (including the static layout fields) is preserved, so a
+  ``FlatState`` is a valid ``lax.scan`` carry.
+* **Donation-safe.** All mutable state is arrays (master + slots);
+  static fields are hashable aux data.  ``jax.jit(update,
+  donate_argnums=(0,))`` donates every buffer the kernels alias.
+* **Class-interchangeable.** Slot names match the class API's
+  ``state_dict()["groups"][i]["state"]`` keys exactly, and
+  ``FusedOptimizerBase`` subclasses are thin stateful wrappers over
+  these transforms — N steps through either path are bitwise identical
+  (tests/L0/run_optimizers/test_functional_core.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_update import (
+    fused_adagrad_flat,
+    fused_adam_flat,
+    fused_lamb_phase1_flat,
+    fused_sgd_flat,
+)
+from apex_tpu.utils import tree_ravel
+
+__all__ = [
+    "FlatState",
+    "fused_adam",
+    "fused_lamb",
+    "fused_sgd",
+    "fused_novograd",
+    "fused_adagrad",
+]
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+@flax.struct.dataclass
+class FlatState:
+    """Flat optimizer state: fp32 master + per-rule slot buffers.
+
+    ``sizes``/``flat_dtype``/``unravel`` are static aux data (treedef,
+    not leaves): per-leaf layout for rules that need tensor boundaries
+    (LAMB trust ratios, NovoGrad per-tensor moments) and the pytree
+    round-trip for checkpoint/eval boundaries.  ``update`` never touches
+    them, so carrying a FlatState through ``lax.scan`` keeps the treedef
+    stable.
+    """
+    master: jax.Array               # fp32 flat master buffer
+    count: jax.Array                # f32 scalar: completed update count
+    slots: dict                     # rule buffers, keyed like state_dict
+    sizes: tuple = flax.struct.field(pytree_node=False, default=())
+    flat_dtype: str = flax.struct.field(pytree_node=False,
+                                        default="float32")
+    unravel: Optional[Callable] = flax.struct.field(pytree_node=False,
+                                                    default=None)
+
+    @property
+    def offsets(self) -> tuple:
+        out, off = [], 0
+        for s in self.sizes:
+            out.append(off)
+            off += s
+        return tuple(out)
+
+    def params(self):
+        """Materialize the params pytree (construction dtypes).
+
+        This is the checkpoint/eval boundary — inside a jitted train
+        step the unravel slices fuse into the consumer instead."""
+        if self.unravel is None:
+            raise ValueError(
+                "FlatState was initialized from a flat buffer (no "
+                "unravel); call .master directly or init from a pytree")
+        return self.unravel(self.master.astype(self.flat_dtype))
+
+
+def _init_state(tx, params) -> FlatState:
+    """Shared init: ravel a pytree (or accept an already-flat buffer)
+    into a donation-safe fp32 master + the rule's zero slots."""
+    if hasattr(params, "ndim") and params.ndim == 1:
+        flat, unravel = params, None
+        sizes = (int(flat.size),)
+        flat_dtype = str(flat.dtype)
+    else:
+        flat, unravel = tree_ravel(params)
+        sizes = tuple(int(x.size)
+                      for x in jax.tree_util.tree_leaves(params))
+        flat_dtype = str(flat.dtype)
+    # Explicit copy: the master is donated every step, and ravel of a
+    # single fp32 leaf can alias the caller's param array.
+    master = jnp.array(flat, dtype=jnp.float32, copy=True)
+    return FlatState(
+        master=master,
+        count=jnp.zeros((), jnp.float32),
+        slots=tx.init_slots(master, sizes=sizes),
+        sizes=sizes,
+        flat_dtype=flat_dtype,
+        unravel=unravel)
+
+
+@dataclasses.dataclass(frozen=True)
+class _AdamTx:
+    """Functional FusedAdam(W) (kernel: :func:`fused_adam_flat`)."""
+    lr: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    adam_w_mode: bool = True
+    bias_correction: bool = True
+
+    def init(self, params) -> FlatState:
+        return _init_state(self, params)
+
+    def init_slots(self, master, *, sizes) -> dict:
+        return {"exp_avg": jnp.zeros_like(master),
+                "exp_avg_sq": jnp.zeros_like(master)}
+
+    def update(self, state: FlatState, flat_grads, *, noop_flag=0.0,
+               grad_scale=1.0, lr=None, beta1=None, beta2=None, eps=None,
+               weight_decay=None) -> FlatState:
+        t = state.count + 1.0
+        p, m, v = fused_adam_flat(
+            state.master, flat_grads,
+            state.slots["exp_avg"], state.slots["exp_avg_sq"],
+            lr=_f32(self.lr if lr is None else lr),
+            beta1=_f32(self.beta1 if beta1 is None else beta1),
+            beta2=_f32(self.beta2 if beta2 is None else beta2),
+            eps=_f32(self.eps if eps is None else eps),
+            weight_decay=_f32(self.weight_decay if weight_decay is None
+                              else weight_decay),
+            step=t, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction,
+            noop_flag=_f32(noop_flag), grad_scale=_f32(grad_scale))
+        return state.replace(
+            master=p, count=t,
+            slots={"exp_avg": m, "exp_avg_sq": v})
+
+
+def _broadcast_leaf_scalars(scalars, sizes):
+    # late import: base.py imports this module
+    from apex_tpu.optimizers.base import broadcast_leaf_scalars
+    return broadcast_leaf_scalars(scalars, sizes)
+
+
+@dataclasses.dataclass(frozen=True)
+class _LambTx:
+    """Functional FusedLAMB (phase-1 kernel + per-tensor trust ratios).
+
+    Per-leaf norms need the tensor boundaries — ``state.sizes`` — so the
+    state must have been built by ``init`` from a pytree (or a flat
+    buffer treated as one tensor)."""
+    lr: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-6
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    bias_correction: bool = True
+    grad_averaging: bool = True
+    use_nvlamb: bool = False
+
+    def init(self, params) -> FlatState:
+        return _init_state(self, params)
+
+    def init_slots(self, master, *, sizes) -> dict:
+        return {"exp_avg": jnp.zeros_like(master),
+                "exp_avg_sq": jnp.zeros_like(master)}
+
+    def update(self, state: FlatState, flat_grads, *, noop_flag=0.0,
+               grad_scale=1.0, lr=None, beta1=None, beta2=None, eps=None,
+               weight_decay=None, max_grad_norm=None) -> FlatState:
+        t = state.count + 1.0
+        p = state.master
+        m = state.slots["exp_avg"]
+        v = state.slots["exp_avg_sq"]
+        offsets, sizes = state.offsets, state.sizes
+        mgn = _f32(self.max_grad_norm if max_grad_norm is None
+                   else max_grad_norm)
+        g32 = flat_grads.astype(jnp.float32) * _f32(grad_scale)
+        # global grad norm clip (reference: first multi_tensor_l2norm
+        # launch)
+        gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+        clip = jnp.where((mgn > 0) & (gnorm > mgn), mgn / (gnorm + 1e-6),
+                         1.0)
+        m_new, v_new, u = fused_lamb_phase1_flat(
+            p, g32, m, v,
+            beta1=_f32(self.beta1 if beta1 is None else beta1),
+            beta2=_f32(self.beta2 if beta2 is None else beta2),
+            eps=_f32(self.eps if eps is None else eps),
+            weight_decay=_f32(self.weight_decay if weight_decay is None
+                              else weight_decay),
+            step=t, bias_correction=self.bias_correction,
+            grad_scale=clip, grad_averaging=self.grad_averaging)
+
+        def sq_norms(flat):
+            return jnp.stack([
+                jnp.sum(jnp.square(
+                    jax.lax.dynamic_slice_in_dim(flat, off, size)))
+                for off, size in zip(offsets, sizes)])
+
+        w_norm = jnp.sqrt(sq_norms(p))
+        u_norm = jnp.sqrt(sq_norms(u))
+        # NVLAMB applies the trust ratio to every param; default LAMB
+        # skips params with zero norm (reference kernel's `use_nvlamb`).
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
+                          jnp.float32(1.0))
+        if self.use_nvlamb:
+            ratio = w_norm / jnp.maximum(u_norm, 1e-12)
+        scale = _broadcast_leaf_scalars(ratio, sizes)
+        p_new = p - _f32(self.lr if lr is None else lr) * scale * u
+
+        skip = _f32(noop_flag) > 0
+        return state.replace(
+            master=jnp.where(skip, p, p_new), count=t,
+            slots={"exp_avg": jnp.where(skip, m, m_new),
+                   "exp_avg_sq": jnp.where(skip, v, v_new)})
+
+
+@dataclasses.dataclass(frozen=True)
+class _SgdTx:
+    """Functional FusedSGD (kernel: :func:`fused_sgd_flat`).
+
+    ``slots["seeded"]`` replicates the class API's first-effective-step
+    tracking: torch clones the grad into a FRESH buffer on the first
+    step that actually applies (a noop-skipped step must not seed)."""
+    lr: float = 1e-3
+    momentum: float = 0.0
+    dampening: float = 0.0
+    weight_decay: float = 0.0
+    nesterov: bool = False
+    wd_after_momentum: bool = False
+
+    def init(self, params) -> FlatState:
+        return _init_state(self, params)
+
+    def init_slots(self, master, *, sizes) -> dict:
+        return {"momentum_buffer": jnp.zeros_like(master),
+                "seeded": jnp.zeros((), jnp.float32)}
+
+    def update(self, state: FlatState, flat_grads, *, noop_flag=0.0,
+               grad_scale=1.0, lr=None, momentum=None, dampening=None,
+               weight_decay=None) -> FlatState:
+        t = state.count + 1.0
+        seeded = state.slots["seeded"]
+        noop = _f32(noop_flag)
+        p, buf = fused_sgd_flat(
+            state.master, flat_grads, state.slots["momentum_buffer"],
+            lr=_f32(self.lr if lr is None else lr),
+            momentum=_f32(self.momentum if momentum is None else momentum),
+            dampening=_f32(self.dampening if dampening is None
+                           else dampening),
+            weight_decay=_f32(self.weight_decay if weight_decay is None
+                              else weight_decay),
+            nesterov=self.nesterov,
+            wd_after_momentum=self.wd_after_momentum,
+            first_run=1.0 - seeded, noop_flag=noop,
+            grad_scale=_f32(grad_scale))
+        return state.replace(
+            master=p, count=t,
+            slots={"momentum_buffer": buf,
+                   "seeded": jnp.maximum(
+                       seeded, jnp.where(noop > 0.0, 0.0, 1.0))})
+
+
+@dataclasses.dataclass(frozen=True)
+class _NovoGradTx:
+    """Functional FusedNovoGrad: per-tensor ||g||²-EMA second moments
+    (``exp_avg_sq`` has one scalar per leaf — needs ``state.sizes``)."""
+    lr: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    bias_correction: bool = True
+    grad_averaging: bool = True
+    init_zero: bool = False
+
+    def init(self, params) -> FlatState:
+        return _init_state(self, params)
+
+    def init_slots(self, master, *, sizes) -> dict:
+        return {"exp_avg": jnp.zeros_like(master),
+                "exp_avg_sq": jnp.zeros((len(sizes),), jnp.float32)}
+
+    def update(self, state: FlatState, flat_grads, *, noop_flag=0.0,
+               grad_scale=1.0, lr=None, beta1=None, beta2=None, eps=None,
+               weight_decay=None) -> FlatState:
+        t = state.count + 1.0
+        p = state.master
+        m = state.slots["exp_avg"]
+        v = state.slots["exp_avg_sq"]
+        offsets, sizes = state.offsets, state.sizes
+        b1 = _f32(self.beta1 if beta1 is None else beta1)
+        b2 = _f32(self.beta2 if beta2 is None else beta2)
+        g32 = flat_grads.astype(jnp.float32) * _f32(grad_scale)
+        gsq = jnp.stack([
+            jnp.sum(jnp.square(
+                jax.lax.dynamic_slice_in_dim(g32, off, size)))
+            for off, size in zip(offsets, sizes)])
+        first = t <= 1.0
+        v_init = jnp.zeros_like(gsq) if self.init_zero else gsq
+        v_new = jnp.where(first, v_init, b2 * v + (1.0 - b2) * gsq)
+        denom = _broadcast_leaf_scalars(
+            jnp.sqrt(v_new) + _f32(self.eps if eps is None else eps),
+            sizes)
+        ghat = g32 / denom + _f32(self.weight_decay if weight_decay is None
+                                  else weight_decay) * p
+        coef = (1.0 - b1) if self.grad_averaging else 1.0
+        m_new = b1 * m + coef * ghat
+        lr_ = _f32(self.lr if lr is None else lr)
+        if self.bias_correction:
+            step_size = lr_ / (1.0 - jnp.power(b1, t))
+        else:
+            step_size = lr_
+        p_new = p - step_size * m_new
+        skip = _f32(noop_flag) > 0
+        return state.replace(
+            master=jnp.where(skip, p, p_new), count=t,
+            slots={"exp_avg": jnp.where(skip, m, m_new),
+                   "exp_avg_sq": jnp.where(skip, v, v_new)})
+
+
+@dataclasses.dataclass(frozen=True)
+class _AdagradTx:
+    """Functional FusedAdagrad (kernel: :func:`fused_adagrad_flat`)."""
+    lr: float = 1e-2
+    eps: float = 1e-10
+    weight_decay: float = 0.0
+    w_mode: bool = False
+
+    def init(self, params) -> FlatState:
+        return _init_state(self, params)
+
+    def init_slots(self, master, *, sizes) -> dict:
+        return {"sum": jnp.zeros_like(master)}
+
+    def update(self, state: FlatState, flat_grads, *, noop_flag=0.0,
+               grad_scale=1.0, lr=None, eps=None,
+               weight_decay=None) -> FlatState:
+        t = state.count + 1.0
+        p, h = fused_adagrad_flat(
+            state.master, flat_grads, state.slots["sum"],
+            lr=_f32(self.lr if lr is None else lr),
+            eps=_f32(self.eps if eps is None else eps),
+            weight_decay=_f32(self.weight_decay if weight_decay is None
+                              else weight_decay),
+            w_mode=self.w_mode, noop_flag=_f32(noop_flag),
+            grad_scale=_f32(grad_scale))
+        return state.replace(master=p, count=t, slots={"sum": h})
+
+
+# -- factories (constructor-parity argument names) ---------------------------
+
+def fused_adam(lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+               adam_w_mode=True, bias_correction=True) -> _AdamTx:
+    return _AdamTx(lr=float(lr), beta1=float(betas[0]),
+                   beta2=float(betas[1]), eps=float(eps),
+                   weight_decay=float(weight_decay),
+                   adam_w_mode=bool(adam_w_mode),
+                   bias_correction=bool(bias_correction))
+
+
+def fused_lamb(lr=1e-3, betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+               max_grad_norm=1.0, bias_correction=True,
+               grad_averaging=True, use_nvlamb=False) -> _LambTx:
+    return _LambTx(lr=float(lr), beta1=float(betas[0]),
+                   beta2=float(betas[1]), eps=float(eps),
+                   weight_decay=float(weight_decay),
+                   max_grad_norm=float(max_grad_norm or 0.0),
+                   bias_correction=bool(bias_correction),
+                   grad_averaging=bool(grad_averaging),
+                   use_nvlamb=bool(use_nvlamb))
+
+
+def fused_sgd(lr, momentum=0.0, dampening=0.0, weight_decay=0.0,
+              nesterov=False, wd_after_momentum=False) -> _SgdTx:
+    return _SgdTx(lr=float(lr), momentum=float(momentum),
+                  dampening=float(dampening),
+                  weight_decay=float(weight_decay),
+                  nesterov=bool(nesterov),
+                  wd_after_momentum=bool(wd_after_momentum))
+
+
+def fused_novograd(lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                   weight_decay=0.0, bias_correction=True,
+                   grad_averaging=True, init_zero=False) -> _NovoGradTx:
+    return _NovoGradTx(lr=float(lr), beta1=float(betas[0]),
+                       beta2=float(betas[1]), eps=float(eps),
+                       weight_decay=float(weight_decay),
+                       bias_correction=bool(bias_correction),
+                       grad_averaging=bool(grad_averaging),
+                       init_zero=bool(init_zero))
+
+
+def fused_adagrad(lr=1e-2, eps=1e-10, weight_decay=0.0,
+                  adagrad_w_mode=False) -> _AdagradTx:
+    return _AdagradTx(lr=float(lr), eps=float(eps),
+                      weight_decay=float(weight_decay),
+                      w_mode=bool(adagrad_w_mode))

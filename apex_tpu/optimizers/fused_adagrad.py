@@ -1,25 +1,18 @@
 """FusedAdagrad (parity: ``apex/optimizers/fused_adagrad.py`` over
-``amp_C.multi_tensor_adagrad``, csrc/multi_tensor_adagrad.cu)."""
+``amp_C.multi_tensor_adagrad``, csrc/multi_tensor_adagrad.cu).
+
+The update math lives in the functional core
+(:func:`apex_tpu.optimizers.functional.fused_adagrad`); this class is
+the stateful torch-parity shell over it (see ``FusedOptimizerBase``).
+"""
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.fused_update import fused_adagrad_flat
+from apex_tpu.optimizers import functional
 from apex_tpu.optimizers.base import FusedOptimizerBase
 
 __all__ = ["FusedAdagrad"]
-
-
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("w_mode",))
-def _adagrad_step(p, h, g, lr, eps, weight_decay, noop_flag, grad_scale, *,
-                  w_mode):
-    return fused_adagrad_flat(p, g, h, lr=lr, eps=eps,
-                              weight_decay=weight_decay, w_mode=w_mode,
-                              noop_flag=noop_flag, grad_scale=grad_scale)
 
 
 class FusedAdagrad(FusedOptimizerBase):
@@ -33,18 +26,14 @@ class FusedAdagrad(FusedOptimizerBase):
         self.adagrad_w_mode = bool(adagrad_w_mode)
         super().__init__(params, defaults)
 
-    def _init_group_state(self, group):
-        group.state = {"sum": jnp.zeros_like(group.master)}
+    def _make_tx(self, options):
+        return functional.fused_adagrad(
+            lr=options["lr"], eps=options["eps"],
+            weight_decay=options["weight_decay"],
+            adagrad_w_mode=self.adagrad_w_mode)
 
-    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
-        o = group.options
-        p, h = _adagrad_step(
-            group.master, group.state["sum"], gflat,
-            jnp.asarray(o["lr"], jnp.float32),
-            jnp.asarray(o["eps"], jnp.float32),
-            jnp.asarray(o["weight_decay"], jnp.float32),
-            jnp.asarray(noop_flag, jnp.float32),
-            jnp.asarray(grad_scale, jnp.float32),
-            w_mode=self.adagrad_w_mode)
-        group.master = p
-        group.state["sum"] = h
+    def _traced_hyper(self, options):
+        return {"lr": jnp.asarray(options["lr"], jnp.float32),
+                "eps": jnp.asarray(options["eps"], jnp.float32),
+                "weight_decay": jnp.asarray(options["weight_decay"],
+                                            jnp.float32)}

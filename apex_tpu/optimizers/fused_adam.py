@@ -5,29 +5,19 @@ Parity: ``apex/optimizers/fused_adam.py :: FusedAdam`` (driving
 ``adam_w_mode=True`` gives AdamW (decoupled decay), matching the reference
 default.  CUDA-specific knobs (``capturable``, ``master_weights``) are
 accepted and ignored — jit capture and fp32 masters are always on here.
+
+The update math lives in the functional core
+(:func:`apex_tpu.optimizers.functional.fused_adam`); this class is the
+stateful torch-parity shell over it (see ``FusedOptimizerBase``).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.fused_update import fused_adam_flat
+from apex_tpu.optimizers import functional
 from apex_tpu.optimizers.base import FusedOptimizerBase
 
 __all__ = ["FusedAdam"]
-
-
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2),
-                   static_argnames=("adam_w_mode", "bias_correction"))
-def _adam_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
-               noop_flag, grad_scale, *, adam_w_mode, bias_correction):
-    return fused_adam_flat(
-        p, g, m, v, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
-        weight_decay=weight_decay, step=step, adam_w_mode=adam_w_mode,
-        bias_correction=bias_correction, noop_flag=noop_flag,
-        grad_scale=grad_scale)
 
 
 class FusedAdam(FusedOptimizerBase):
@@ -47,26 +37,18 @@ class FusedAdam(FusedOptimizerBase):
         self.adam_w_mode = bool(adam_w_mode)
         super().__init__(params, defaults)
 
-    def _init_group_state(self, group):
-        group.state = {"exp_avg": jnp.zeros_like(group.master),
-                       "exp_avg_sq": jnp.zeros_like(group.master)}
-
-    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
-        o = group.options
-        beta1, beta2 = o["betas"]
-        p, m, v = _adam_step(
-            group.master, group.state["exp_avg"], group.state["exp_avg_sq"],
-            gflat,
-            jnp.asarray(step, jnp.float32),
-            jnp.asarray(o["lr"], jnp.float32),
-            jnp.asarray(beta1, jnp.float32),
-            jnp.asarray(beta2, jnp.float32),
-            jnp.asarray(o["eps"], jnp.float32),
-            jnp.asarray(o["weight_decay"], jnp.float32),
-            jnp.asarray(noop_flag, jnp.float32),
-            jnp.asarray(grad_scale, jnp.float32),
+    def _make_tx(self, options):
+        return functional.fused_adam(
+            lr=options["lr"], betas=options["betas"], eps=options["eps"],
+            weight_decay=options["weight_decay"],
             adam_w_mode=self.adam_w_mode,
-            bias_correction=bool(o["bias_correction"]))
-        group.master = p
-        group.state["exp_avg"] = m
-        group.state["exp_avg_sq"] = v
+            bias_correction=bool(options["bias_correction"]))
+
+    def _traced_hyper(self, options):
+        beta1, beta2 = options["betas"]
+        return {"lr": jnp.asarray(options["lr"], jnp.float32),
+                "beta1": jnp.asarray(beta1, jnp.float32),
+                "beta2": jnp.asarray(beta2, jnp.float32),
+                "eps": jnp.asarray(options["eps"], jnp.float32),
+                "weight_decay": jnp.asarray(options["weight_decay"],
+                                            jnp.float32)}

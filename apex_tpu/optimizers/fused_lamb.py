@@ -9,6 +9,10 @@ global-grad-norm clip are static-sliced reductions XLA fuses; phase 2 applies
 static-slice concatenation (``broadcast_leaf_scalars`` — a gather-based
 ``jnp.repeat`` costs seconds on TPU, see its docstring).
 
+The math lives in the functional core
+(:func:`apex_tpu.optimizers.functional.fused_lamb`); this class is the
+stateful torch-parity shell over it (see ``FusedOptimizerBase``).
+
 Scope notes (shared verbatim by the torch-mode twin in
 ``_torch_mode.py`` — the two entry points are kept numerically
 interchangeable):
@@ -28,9 +32,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.fused_update import fused_lamb_phase1_flat
-from apex_tpu.optimizers.base import FusedOptimizerBase, \
-    broadcast_leaf_scalars
+from apex_tpu.optimizers import functional
+from apex_tpu.optimizers.base import FusedOptimizerBase
 
 __all__ = ["FusedLAMB"]
 
@@ -42,38 +45,19 @@ __all__ = ["FusedLAMB"]
 def _lamb_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
                max_grad_norm, noop_flag, grad_scale, *, bias_correction,
                offsets, sizes, use_nvlamb, grad_averaging=True):
-    g32 = g.astype(jnp.float32) * grad_scale
-    # global grad norm clip (reference: first multi_tensor_l2norm launch)
-    gnorm = jnp.sqrt(jnp.sum(g32 * g32))
-    clip = jnp.where(
-        (max_grad_norm > 0) & (gnorm > max_grad_norm),
-        max_grad_norm / (gnorm + 1e-6), 1.0)
-
-    m_new, v_new, u = fused_lamb_phase1_flat(
-        p, g32, m, v, beta1=beta1, beta2=beta2, eps=eps,
-        weight_decay=weight_decay, step=step,
-        bias_correction=bias_correction, grad_scale=clip,
-        grad_averaging=grad_averaging)
-
-    def sq_norms(flat):
-        return jnp.stack([
-            jnp.sum(jnp.square(jax.lax.dynamic_slice_in_dim(flat, off, size)))
-            for off, size in zip(offsets, sizes)])
-
-    w_norm = jnp.sqrt(sq_norms(p))
-    u_norm = jnp.sqrt(sq_norms(u))
-    # NVLAMB variant applies the trust ratio to every param; default LAMB
-    # skips params with zero norm (reference kernel's `use_nvlamb` flag).
-    ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
-                      jnp.float32(1.0))
-    if use_nvlamb:
-        ratio = w_norm / jnp.maximum(u_norm, 1e-12)
-    scale = broadcast_leaf_scalars(ratio, sizes)
-    p_new = p - lr * scale * u
-
-    skip = noop_flag > 0
-    return (jnp.where(skip, p, p_new), jnp.where(skip, m, m_new),
-            jnp.where(skip, v, v_new))
+    """Flat-args compatibility entry over the functional core (kept for
+    the on-chip decomposition scripts under ``bench_captures/``)."""
+    tx = functional._LambTx(
+        bias_correction=bool(bias_correction), use_nvlamb=bool(use_nvlamb),
+        grad_averaging=bool(grad_averaging))
+    state = functional.FlatState(
+        master=p, count=step - 1.0,
+        slots={"exp_avg": m, "exp_avg_sq": v}, sizes=tuple(sizes))
+    state = tx.update(state, g, noop_flag=noop_flag, grad_scale=grad_scale,
+                      lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+                      weight_decay=weight_decay,
+                      max_grad_norm=max_grad_norm)
+    return state.master, state.slots["exp_avg"], state.slots["exp_avg_sq"]
 
 
 class FusedLAMB(FusedOptimizerBase):
@@ -95,29 +79,22 @@ class FusedLAMB(FusedOptimizerBase):
         self.use_nvlamb = bool(use_nvlamb)
         super().__init__(params, defaults)
 
-    def _init_group_state(self, group):
-        group.state = {"exp_avg": jnp.zeros_like(group.master),
-                       "exp_avg_sq": jnp.zeros_like(group.master)}
+    def _make_tx(self, options):
+        return functional.fused_lamb(
+            lr=options["lr"], betas=options["betas"], eps=options["eps"],
+            weight_decay=options["weight_decay"],
+            max_grad_norm=options["max_grad_norm"],
+            bias_correction=bool(options["bias_correction"]),
+            grad_averaging=bool(options.get("grad_averaging", True)),
+            use_nvlamb=self.use_nvlamb)
 
-    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
-        o = group.options
-        beta1, beta2 = o["betas"]
-        p, m, v = _lamb_step(
-            group.master, group.state["exp_avg"], group.state["exp_avg_sq"],
-            gflat,
-            jnp.asarray(step, jnp.float32),
-            jnp.asarray(o["lr"], jnp.float32),
-            jnp.asarray(beta1, jnp.float32),
-            jnp.asarray(beta2, jnp.float32),
-            jnp.asarray(o["eps"], jnp.float32),
-            jnp.asarray(o["weight_decay"], jnp.float32),
-            jnp.asarray(o["max_grad_norm"] or 0.0, jnp.float32),
-            jnp.asarray(noop_flag, jnp.float32),
-            jnp.asarray(grad_scale, jnp.float32),
-            bias_correction=bool(o["bias_correction"]),
-            offsets=tuple(group.offsets), sizes=tuple(group.sizes),
-            use_nvlamb=self.use_nvlamb,
-            grad_averaging=bool(o.get("grad_averaging", True)))
-        group.master = p
-        group.state["exp_avg"] = m
-        group.state["exp_avg_sq"] = v
+    def _traced_hyper(self, options):
+        beta1, beta2 = options["betas"]
+        return {"lr": jnp.asarray(options["lr"], jnp.float32),
+                "beta1": jnp.asarray(beta1, jnp.float32),
+                "beta2": jnp.asarray(beta2, jnp.float32),
+                "eps": jnp.asarray(options["eps"], jnp.float32),
+                "weight_decay": jnp.asarray(options["weight_decay"],
+                                            jnp.float32),
+                "max_grad_norm": jnp.asarray(
+                    options["max_grad_norm"] or 0.0, jnp.float32)}

@@ -5,47 +5,19 @@ Parity: ``apex/optimizers/fused_novograd.py :: FusedNovoGrad`` over
 second moment is a single scalar per tensor (||g||²-EMA), so the "fused"
 content is per-tensor reductions + one elementwise pass — both of which XLA
 fuses from jnp directly; a hand Pallas kernel would add nothing here.
+
+The update math lives in the functional core
+(:func:`apex_tpu.optimizers.functional.fused_novograd`); this class is
+the stateful torch-parity shell over it (see ``FusedOptimizerBase``).
 """
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-from apex_tpu.optimizers.base import FusedOptimizerBase, \
-    broadcast_leaf_scalars
+from apex_tpu.optimizers import functional
+from apex_tpu.optimizers.base import FusedOptimizerBase
 
 __all__ = ["FusedNovoGrad"]
-
-
-@functools.partial(
-    jax.jit, donate_argnums=(0, 1, 2),
-    static_argnames=("offsets", "sizes", "bias_correction", "grad_averaging",
-                     "init_zero"))
-def _novograd_step(p, m, v, g, step, lr, beta1, beta2, eps, weight_decay,
-                   noop_flag, grad_scale, *, offsets, sizes, bias_correction,
-                   grad_averaging, init_zero):
-    g32 = g.astype(jnp.float32) * grad_scale
-    gsq = jnp.stack([
-        jnp.sum(jnp.square(jax.lax.dynamic_slice_in_dim(g32, off, size)))
-        for off, size in zip(offsets, sizes)])
-    first = step <= 1.0
-    v_init = jnp.zeros_like(gsq) if init_zero else gsq
-    v_new = jnp.where(first, v_init, beta2 * v + (1.0 - beta2) * gsq)
-    denom = broadcast_leaf_scalars(jnp.sqrt(v_new) + eps, sizes)
-    ghat = g32 / denom + weight_decay * p
-    coef = (1.0 - beta1) if grad_averaging else 1.0
-    m_new = beta1 * m + coef * ghat
-    if bias_correction:
-        bc1 = 1.0 - jnp.power(beta1, step)
-        step_size = lr / bc1
-    else:
-        step_size = lr
-    p_new = p - step_size * m_new
-    skip = noop_flag > 0
-    return (jnp.where(skip, p, p_new), jnp.where(skip, m, m_new),
-            jnp.where(skip, v, v_new))
 
 
 class FusedNovoGrad(FusedOptimizerBase):
@@ -77,29 +49,18 @@ class FusedNovoGrad(FusedOptimizerBase):
         self.init_zero = bool(init_zero)
         super().__init__(params, defaults)
 
-    def _init_group_state(self, group):
-        group.state = {
-            "exp_avg": jnp.zeros_like(group.master),
-            "exp_avg_sq": jnp.zeros((len(group.sizes),), jnp.float32),
-        }
-
-    def _step_group(self, group, gflat, step, noop_flag, grad_scale):
-        o = group.options
-        beta1, beta2 = o["betas"]
-        p, m, v = _novograd_step(
-            group.master, group.state["exp_avg"], group.state["exp_avg_sq"],
-            gflat,
-            jnp.asarray(step, jnp.float32),
-            jnp.asarray(o["lr"], jnp.float32),
-            jnp.asarray(beta1, jnp.float32),
-            jnp.asarray(beta2, jnp.float32),
-            jnp.asarray(o["eps"], jnp.float32),
-            jnp.asarray(o["weight_decay"], jnp.float32),
-            jnp.asarray(noop_flag, jnp.float32),
-            jnp.asarray(grad_scale, jnp.float32),
-            offsets=tuple(group.offsets), sizes=tuple(group.sizes),
-            bias_correction=bool(o["bias_correction"]),
+    def _make_tx(self, options):
+        return functional.fused_novograd(
+            lr=options["lr"], betas=options["betas"], eps=options["eps"],
+            weight_decay=options["weight_decay"],
+            bias_correction=bool(options["bias_correction"]),
             grad_averaging=self.grad_averaging, init_zero=self.init_zero)
-        group.master = p
-        group.state["exp_avg"] = m
-        group.state["exp_avg_sq"] = v
+
+    def _traced_hyper(self, options):
+        beta1, beta2 = options["betas"]
+        return {"lr": jnp.asarray(options["lr"], jnp.float32),
+                "beta1": jnp.asarray(beta1, jnp.float32),
+                "beta2": jnp.asarray(beta2, jnp.float32),
+                "eps": jnp.asarray(options["eps"], jnp.float32),
+                "weight_decay": jnp.asarray(options["weight_decay"],
+                                            jnp.float32)}

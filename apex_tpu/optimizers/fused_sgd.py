@@ -1,27 +1,18 @@
 """FusedSGD (parity: ``apex/optimizers/fused_sgd.py`` over
-``amp_C.multi_tensor_sgd``, csrc/multi_tensor_sgd_kernel.cu)."""
+``amp_C.multi_tensor_sgd``, csrc/multi_tensor_sgd_kernel.cu).
+
+The update math lives in the functional core
+(:func:`apex_tpu.optimizers.functional.fused_sgd`); this class is the
+stateful torch-parity shell over it (see ``FusedOptimizerBase``).
+"""
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.fused_update import fused_sgd_flat
+from apex_tpu.optimizers import functional
 from apex_tpu.optimizers.base import FusedOptimizerBase
 
 __all__ = ["FusedSGD"]
-
-
-@functools.partial(jax.jit, donate_argnums=(0, 1),
-                   static_argnames=("nesterov", "wd_after_momentum"))
-def _sgd_step(p, buf, g, lr, momentum, dampening, weight_decay, first,
-              noop_flag, grad_scale, *, nesterov, wd_after_momentum):
-    return fused_sgd_flat(
-        p, g, buf, lr=lr, momentum=momentum, dampening=dampening,
-        weight_decay=weight_decay, nesterov=nesterov,
-        wd_after_momentum=wd_after_momentum, first_run=first,
-        noop_flag=noop_flag, grad_scale=grad_scale)
 
 
 class FusedSGD(FusedOptimizerBase):
@@ -40,35 +31,27 @@ class FusedSGD(FusedOptimizerBase):
                         wd_after_momentum=wd_after_momentum)
         super().__init__(params, defaults)
 
-    def _init_group_state(self, group):
-        group.state = {"momentum_buffer": jnp.zeros_like(group.master),
-                       # torch clones the grad into a FRESH buffer on the
-                       # first EFFECTIVE step; step==1 is the wrong proxy
-                       # when amp noop-skips it (dampening would then
-                       # scale the seeding grad).  Traced so overflow
-                       # skips need no host sync.
-                       "seeded": jnp.zeros((), jnp.float32)}
+    def _make_tx(self, options):
+        return functional.fused_sgd(
+            lr=options["lr"], momentum=options["momentum"],
+            dampening=options["dampening"],
+            weight_decay=options["weight_decay"],
+            nesterov=bool(options["nesterov"]),
+            wd_after_momentum=bool(options["wd_after_momentum"]))
+
+    def _traced_hyper(self, options):
+        return {"lr": jnp.asarray(options["lr"], jnp.float32),
+                "momentum": jnp.asarray(options["momentum"], jnp.float32),
+                "dampening": jnp.asarray(options["dampening"], jnp.float32),
+                "weight_decay": jnp.asarray(options["weight_decay"],
+                                            jnp.float32)}
 
     def _step_group(self, group, gflat, step, noop_flag, grad_scale):
-        o = group.options
-        # pre-r5 checkpoints lack the flag: any step already taken seeded
-        # the buffer (their step 1 was never recorded as skipped)
-        seeded = group.state.get("seeded")
-        if seeded is None:
-            seeded = jnp.asarray(0.0 if step == 1 else 1.0, jnp.float32)
-        noop = jnp.asarray(noop_flag, jnp.float32)
-        p, buf = _sgd_step(
-            group.master, group.state["momentum_buffer"], gflat,
-            jnp.asarray(o["lr"], jnp.float32),
-            jnp.asarray(o["momentum"], jnp.float32),
-            jnp.asarray(o["dampening"], jnp.float32),
-            jnp.asarray(o["weight_decay"], jnp.float32),
-            1.0 - seeded,
-            noop,
-            jnp.asarray(grad_scale, jnp.float32),
-            nesterov=bool(o["nesterov"]),
-            wd_after_momentum=bool(o["wd_after_momentum"]))
-        group.master = p
-        group.state["momentum_buffer"] = buf
-        group.state["seeded"] = jnp.maximum(
-            seeded, jnp.where(noop > 0.0, 0.0, 1.0))
+        # pre-r5 checkpoints lack the "seeded" flag (torch clones the
+        # grad into a FRESH buffer on the first EFFECTIVE step; traced
+        # so overflow skips need no host sync): any step already taken
+        # seeded the buffer (their step 1 was never recorded as skipped)
+        if "seeded" not in group.state:
+            group.state["seeded"] = jnp.asarray(
+                0.0 if step == 1 else 1.0, jnp.float32)
+        super()._step_group(group, gflat, step, noop_flag, grad_scale)

@@ -1,0 +1,152 @@
+"""Flat-native train step: forward, backward, scaler, and fused update
+as ONE donated XLA program.
+
+The structural insight (PERF.md r5, ISSUE 2): keep the flat fp32 master
+buffer as the *differentiation variable* —
+
+    jax.value_and_grad(lambda flat: loss(unravel(flat)))
+
+— and autodiff *produces* flat gradients.  The per-leaf ``unravel``
+slices fuse into the forward, their transpose is a pad+add chain XLA
+fuses over the flat cotangent, and the 297-leaf grad re-ravel
+``concatenate`` plus the host-driven unscale/update dispatches disappear
+from the step entirely.  Full pytree materialization happens only at
+checkpoint/eval boundaries (``TrainState.params()``).
+
+amp is carried in-program: the loss is scaled before the backward, the
+flat grads are unscaled by the fused non-finite-detecting kernel
+(:func:`apex_tpu.amp.scaler.unscale_flat_grads`), and the overflow flag
+feeds the update kernel's ``noop_flag`` predicate — no host sync
+anywhere between backward and update.
+
+Typical use (the shape ``examples/bert/pretrain_bert.py`` runs)::
+
+    tx = functional.fused_lamb(lr=1e-3, weight_decay=0.01)
+    state = init_train_state(tx, params, loss_scale="dynamic")
+    run = train_loop(loss_fn, tx)          # jitted scan, state donated
+    state, losses = run(state, batches)    # batches: [iters, ...] leaves
+    final_params = state.params()          # checkpoint/eval boundary
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp.scaler import (
+    LossScaleState,
+    init_loss_scale,
+    unscale_flat_grads,
+    update_scale,
+)
+from apex_tpu.optimizers.functional import FlatState
+
+__all__ = ["TrainState", "init_train_state", "make_train_step",
+           "train_loop", "leaf_offsets"]
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Scan-carryable train-loop state: flat optimizer state + (optional)
+    loss-scaler state."""
+    opt: FlatState
+    scaler: Optional[LossScaleState] = None
+
+    def params(self):
+        """Materialize the params pytree (checkpoint/eval boundary)."""
+        return self.opt.params()
+
+
+def init_train_state(tx, params, loss_scale=None) -> TrainState:
+    """Build a TrainState from a params pytree.
+
+    ``loss_scale``: None (no amp scaling), "dynamic", or a fixed float —
+    the same contract as :class:`apex_tpu.amp.scaler.LossScaler`.
+    """
+    scaler = None if loss_scale is None else init_loss_scale(loss_scale)
+    return TrainState(opt=tx.init(params), scaler=scaler)
+
+
+def make_train_step(loss_fn, tx, *, has_aux: bool = False,
+                    grad_transform: Optional[Callable] = None):
+    """Build a pure ``step(state, batch) -> (state, metrics)``.
+
+    ``loss_fn(params, batch)`` takes the MATERIALIZED params pytree (the
+    unravel slices fuse into the forward) and returns a scalar loss (or
+    ``(loss, aux)`` with ``has_aux=True``).  ``metrics`` is the UNSCALED
+    loss (or ``(loss, aux)``).
+
+    ``grad_transform(flat_grads)`` runs between backward and unscale —
+    the hook for data-parallel ``pmean`` or per-leaf collective fixups
+    (see :func:`leaf_offsets`); it must stay on-device and flat.
+
+    The result is a valid ``lax.scan`` body; jit it (or the scan around
+    it) with ``donate_argnums=(0,)`` — the whole state is donation-safe.
+    """
+
+    def step(state: TrainState, batch):
+        opt, scaler = state.opt, state.scaler
+        scale = (scaler.loss_scale if scaler is not None
+                 else jnp.float32(1.0))
+
+        def flat_loss(flat):
+            params = opt.unravel(flat.astype(opt.flat_dtype))
+            out = loss_fn(params, batch)
+            loss, aux = out if has_aux else (out, None)
+            # the scaled loss drives the backward; the raw loss is the
+            # reported metric
+            return loss * scale.astype(loss.dtype), (loss, aux)
+
+        (_, (loss, aux)), flat_g = jax.value_and_grad(
+            flat_loss, has_aux=True)(opt.master)
+        if grad_transform is not None:
+            flat_g = grad_transform(flat_g)
+        if scaler is not None:
+            # fused unscale + overflow detection; found_inf feeds the
+            # update kernel's noop predicate in-program
+            flat_g, scaler = unscale_flat_grads(flat_g, scaler)
+            opt = tx.update(opt, flat_g, noop_flag=scaler.found_inf)
+            scaler = update_scale(scaler)
+        else:
+            opt = tx.update(opt, flat_g)
+        new_state = state.replace(opt=opt, scaler=scaler)
+        return new_state, ((loss, aux) if has_aux else loss)
+
+    return step
+
+
+def train_loop(loss_fn, tx, **step_kwargs):
+    """Jitted ``run(state, batches) -> (state, metrics)``: every step
+    inside one ``lax.scan``, the carried state donated, ONE compiled
+    executable for the whole run.  ``batches`` leaves are stacked along
+    a leading [iters] axis (the scan axis)."""
+    step = make_train_step(loss_fn, tx, **step_kwargs)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(state: TrainState, batches):
+        return jax.lax.scan(step, state, batches)
+
+    return run
+
+
+def leaf_offsets(tree) -> "dict[str, tuple[int, int, tuple]]":
+    """``{keystr: (offset, size, shape)}`` of each leaf inside the
+    raveled flat buffer (``ravel_pytree`` order = ``tree_leaves``
+    order).
+
+    The flat-native escape hatch for per-leaf grad fixups (tied
+    embeddings, replicated-kv psums): ``lax.dynamic_slice_in_dim`` the
+    leaf out of the flat grads, fix it, ``dynamic_update_slice_in_dim``
+    it back — no tree round-trip, no re-ravel concatenate."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out, off = {}, 0
+    for path, leaf in flat:
+        size = int(np.prod(leaf.shape)) if np.ndim(leaf) else 1
+        out[jax.tree_util.keystr(path)] = (off, size,
+                                           tuple(np.shape(leaf)))
+        off += size
+    return out

@@ -268,6 +268,15 @@ def _embedding_lookup_matmul_grad(vocab: int, dtype_str: str):
     Opt-in via ``VocabParallelEmbedding(grad_via_matmul=True)`` pending
     the on-chip A/B (bench_captures/r5_experiments.py).
 
+    MEMORY COST (why this stays opt-in): the backward materializes a
+    ``[num_tokens, vocab_shard]`` one-hot in the incoming-grad dtype —
+    an O(tokens × vocab) transient.  At realistic shapes that is large:
+    8k tokens × 32k vocab fp32 is ~1 GB of HBM live for the duration of
+    the contraction (bf16 dy halves it).  Budget for it before enabling
+    at scale, or keep the default scatter-add path; chunking the
+    contraction over token blocks would bound the transient at the cost
+    of a serial loop and is left to a measured follow-up.
+
     A factory (cached per (vocab, dtype)) because custom_vjp residuals
     must be JAX types — the static table shape/dtype ride the closure."""
     wdtype = jnp.dtype(dtype_str)
@@ -299,7 +308,8 @@ class VocabParallelEmbedding(nn.Module):
     embedding table.
 
     ``grad_via_matmul`` swaps the backward's scatter-add for a one-hot
-    MXU contraction (see ``_embedding_lookup_matmul_grad``)."""
+    MXU contraction — NOTE its O(tokens × vocab_shard) transient (~1 GB
+    at 8k×32k fp32); see ``_embedding_lookup_matmul_grad``."""
     num_embeddings: int
     embedding_dim: int
     init_method: Callable = nn.initializers.normal(stddev=0.02)

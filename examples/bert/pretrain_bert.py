@@ -1,7 +1,14 @@
-"""BERT MLM pretraining loop: standalone BERT + FusedLAMB + dynamic loss
-scaling (BASELINE config 2's model/optimizer pairing — the reference's
-BERT-large phase-1 recipe is amp O2 + FusedLAMB; here bf16 params with
-fp32 LAMB masters and the jit-carried scaler play that role).
+"""BERT MLM pretraining loop: standalone BERT + flat-native FusedLAMB +
+dynamic loss scaling (BASELINE config 2's model/optimizer pairing — the
+reference's BERT-large phase-1 recipe is amp O2 + FusedLAMB; here bf16
+params with fp32 LAMB masters and the jit-carried scaler play that role).
+
+Flat-native structure (matching the gpt example's one-program shape):
+the whole run is ONE jitted ``lax.scan`` over pre-staged batches, built
+by :func:`apex_tpu.train_step.train_loop` — the fp32 flat LAMB master is
+the differentiation variable, so autodiff produces flat grads (no
+per-step grad re-ravel), and the scaler's ``found_inf`` feeds the update
+kernel's ``noop_flag`` in-program (no host sync anywhere in the step).
 
 Synthetic MLM data (recoverable signal: masked positions' labels are a
 deterministic function of their neighbors) so the smoke path needs no
@@ -13,16 +20,18 @@ Run:  python pretrain_bert.py --iters 20
 from __future__ import annotations
 
 import argparse
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 import sys
-sys.path.insert(0, __file__.rsplit("/", 3)[0])   # repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))               # repo root on sys.path
 
-from apex_tpu.amp.scaler import LossScaler
-from apex_tpu.optimizers import FusedLAMB
+from apex_tpu import train_step
+from apex_tpu.optimizers import functional
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.testing import BertConfig, bert_model_provider
 
@@ -102,47 +111,52 @@ def main(argv=None):
                               **apply_kw)
         return loss
 
-    def loss_fn(params, tokens, labels, scale, dropout_key):
+    def loss_fn(params, batch):
         apply_kw = (dict(deterministic=False,
-                         rngs={"dropout": dropout_key})
+                         rngs={"dropout": batch["key"]})
                     if train_mode else {})
-        loss = masked_lm_loss(params, tokens, labels, **apply_kw)
-        return loss * scale, loss        # scaled loss drives the backward
+        return masked_lm_loss(params, batch["tokens"], batch["labels"],
+                              **apply_kw)
 
-    # FusedLAMB keeps fp32 masters of the bf16 params (the O2 regime)
-    optimizer = FusedLAMB(params, lr=args.lr, weight_decay=0.01,
-                          max_grad_norm=1.0)
-    scaler = LossScaler(args.loss_scale if args.loss_scale == "dynamic"
-                        else float(args.loss_scale))
+    # flat-native FusedLAMB: fp32 flat master of the bf16 params (the O2
+    # regime) IS the differentiation variable; loss scaling, overflow
+    # detection, and the noop-predicated update all run in-program
+    tx = functional.fused_lamb(lr=args.lr, weight_decay=0.01,
+                               max_grad_norm=1.0)
+    state = train_step.init_train_state(
+        tx, params, loss_scale=(args.loss_scale
+                                if args.loss_scale == "dynamic"
+                                else float(args.loss_scale)))
 
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
     heldout = synthetic_mlm_batch(rng, args)   # never trained on
-    losses = []
-    # the reference example's `data_prefetcher` flow: batches are staged
-    # on-device a couple of steps ahead so H2D rides the compute window
-    from apex_tpu.utils import DevicePrefetcher
-    batches = DevicePrefetcher(
-        (synthetic_mlm_batch(rng, args) for _ in range(args.iters)),
-        depth=2)
-    dropout_root = jax.random.PRNGKey(args.seed + 1)
-    for it, (tokens, labels) in enumerate(batches):
-        (_, loss), grads = grad_fn(params, tokens, labels,
-                                   scaler.state.loss_scale,
-                                   jax.random.fold_in(dropout_root, it))
-        grads = scaler.unscale_(grads)   # fused unscale + overflow check
-        params = optimizer.step(grads, noop_flag=scaler.found_inf)
-        scaler.update_scale()
-        losses.append(float(loss))
-        if it % 5 == 0:
-            print(f"iter {it:3d} loss {losses[-1]:.4f} "
-                  f"scale {scaler.loss_scale():.0f}")
+    # all batches staged on-device up front: the whole run is one jitted
+    # lax.scan (the gpt example's structure), so there is no per-step
+    # host round-trip for a prefetcher to hide.  NOTE memory is
+    # O(iters): for corpus-scale runs, chunk the stream and call the
+    # jitted loop once per chunk (the carried TrainState composes)
+    toks, labs = zip(*[synthetic_mlm_batch(rng, args)
+                       for _ in range(args.iters)])
+    batches = {"tokens": jnp.stack(toks), "labels": jnp.stack(labs)}
+    if train_mode:
+        dropout_root = jax.random.PRNGKey(args.seed + 1)
+        batches["key"] = jax.vmap(
+            lambda i: jax.random.fold_in(dropout_root, i))(
+                jnp.arange(args.iters))
+    run = train_step.train_loop(loss_fn, tx)
+    state, losses = run(state, batches)
+    losses = [float(l) for l in np.asarray(losses)]
+    for it in range(0, args.iters, 5):
+        print(f"iter {it:3d} loss {losses[it]:.4f}")
     # held-out eval is ALWAYS deterministic (dropout off), so the number
-    # is comparable across dropout settings; one eager call — a second
-    # jit compile would never amortize
-    heldout_loss = masked_lm_loss(params, heldout[0], heldout[1])
-    heldout_loss = float(heldout_loss)
+    # is comparable across dropout settings; one eager call on the
+    # materialized params (the checkpoint/eval boundary) — a second jit
+    # compile would never amortize
+    final_params = state.params()
+    heldout_loss = float(masked_lm_loss(final_params, heldout[0],
+                                        heldout[1]))
     print(f"final loss {losses[-1]:.4f} (first {losses[0]:.4f}) "
-          f"held-out {heldout_loss:.4f}")
+          f"held-out {heldout_loss:.4f} "
+          f"scale {float(state.scaler.loss_scale):.0f}")
     return losses, heldout_loss
 
 

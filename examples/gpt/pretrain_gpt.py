@@ -10,7 +10,8 @@ Everything the parallel stack offers in one loop:
   * data parallelism with bucketed psum gradient reduction,
   * TIED input/output embeddings across the first/last stage with the
     masked-psum embedding-group reduction,
-  * one fused Adam update over the raveled per-rank parameters.
+  * one flat-native fused Adam update (``optimizers.functional``) over
+    the per-rank FlatState carried through the scan.
 
 Synthetic data is next-token-predictable (cyclic sequences), so the loss
 falls fast and the smoke test can assert learning.  Runs anywhere:
@@ -22,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import sys
 
 import jax
@@ -29,9 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-sys.path.insert(0, __file__.rsplit("/", 3)[0])   # repo root on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))               # repo root on sys.path
 
-from apex_tpu.ops.fused_update import fused_adam_flat
+from apex_tpu.optimizers import functional
 from apex_tpu.parallel.distributed import flat_allreduce
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.pipeline_parallel import (
@@ -127,6 +130,8 @@ def main(argv=None):
         num_attention_heads=args.heads, max_seq_length=args.seq,
         hidden_dropout=args.dropout, attention_dropout=args.dropout)
     layer = ParallelTransformerLayer(cfg, causal=True)
+    tx = functional.fused_adam(lr=args.lr, betas=(0.9, 0.999), eps=1e-8,
+                               weight_decay=0.0)
 
     def stage_fn(params, x, mb):
         # injection at VIRTUAL stage 0 only: rank 0 AND chunk 0 (the
@@ -188,12 +193,15 @@ def main(argv=None):
                                     for c in range(args.vpp)])
         else:
             params = chunk_params(0)
-        flat0, _ = tree_ravel(params)
-        opt0 = (jnp.zeros_like(flat0), jnp.zeros_like(flat0))
+        # flat-native functional Adam: ONE ravel at init; the scan
+        # carries the FlatState, params rematerialize per step as
+        # unravel slices that fuse into the forward
+        opt0 = tx.init(params)
 
         def one_step(carry, xs):
-            params, (m, v) = carry
+            st = carry
             step, batch = xs
+            params = st.params()
             loss, grads = fwd_bwd(
                 stage_fn, loss_fn, params, batch,
                 num_microbatches=n_micro, input_fn=input_fn,
@@ -212,16 +220,14 @@ def main(argv=None):
             if dp > 1:
                 grads = flat_allreduce(grads, axis_name="data")
                 grads = jax.tree.map(lambda g: g / dp, grads)
-            flat_p, unravel = tree_ravel(params)
+            # the pipeline executor produces grads per-leaf, so ONE
+            # ravel per step remains here; the params side needs none
             flat_g, _ = tree_ravel(grads)
-            new_p, m, v = fused_adam_flat(
-                flat_p, flat_g, m, v, lr=args.lr, beta1=0.9, beta2=0.999,
-                eps=1e-8, weight_decay=0.0, step=step + 1)
-            return (unravel(new_p), (m, v)), loss
+            return tx.update(st, flat_g), loss
 
         steps = jnp.arange(args.iters)
-        (_, _), losses = jax.lax.scan(
-            one_step, (params, opt0), (steps, all_batches))
+        _, losses = jax.lax.scan(
+            one_step, opt0, (steps, all_batches))
         # fwd_bwd psums the loss over 'pipe' only; average the dp shards
         # so the reported metric is the GLOBAL-batch loss (and the P()
         # out-spec's replication claim actually holds)

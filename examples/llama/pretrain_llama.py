@@ -5,7 +5,9 @@ same TP layers the GPT flagship uses).
 The loop shows the decoder recipe composed with the parallel stack:
   * tensor parallelism inside attention (GQA kv shards) and SwiGLU,
   * data parallelism with psum gradient reduction,
-  * fused Adam over the raveled per-rank parameters.
+  * flat-native fused Adam (``optimizers.functional``): the fp32 flat
+    master is the differentiation variable, so autodiff produces flat
+    grads and the step has no pytree repacking.
 
 Synthetic data is next-token-predictable (cyclic sequences), so the
 loss falls fast and the smoke test can assert learning.  Runs anywhere
@@ -19,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import sys
 
 import jax
@@ -26,15 +29,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-sys.path.insert(0, __file__.rsplit("/", 3)[0])   # repo root on sys.path
+# abspath first: with a relative __main__.__file__ (plain
+# `python pretrain_llama.py`) slicing path components off the raw value
+# would compute a bogus repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))               # repo root on sys.path
 
-from apex_tpu.ops.fused_update import fused_adam_flat
+from apex_tpu import train_step
+from apex_tpu.optimizers import functional
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.testing import LlamaConfig, llama_model_provider
-from apex_tpu.transformer.testing.standalone_llama import (
-    reduce_llama_grads,
-)
-from apex_tpu.utils import tree_ravel
 
 
 def parse_args(argv=None):
@@ -77,41 +81,48 @@ def main(argv=None):
         num_layers=args.layers, num_attention_heads=args.heads,
         num_kv_heads=args.kv_heads, max_seq_length=args.seq)
     model = llama_model_provider(cfg)
+    tx = functional.fused_adam(lr=args.lr, betas=(0.9, 0.999), eps=1e-8,
+                               weight_decay=0.0)
     rng = np.random.default_rng(args.seed)
+    # replicated-kv (MQA/GQA with kv_heads % tp != 0): each rank
+    # backpropagates only its OWN q-heads' contribution to the shared
+    # kv_proj weights — the true grad is the psum over the tensor axis
+    # (same contract as ``standalone_llama.reduce_llama_grads``, applied
+    # here to flat-grad slices so the step stays re-ravel-free)
+    need_kv_psum = args.tp > 1 and cfg.kv_heads % args.tp != 0
 
     def train(stream):
         """One rank's whole run: init, then a scan over the iteration
         stream (my dp shard of it).  Per-rank state — the sharded param
-        tree raveled to one fused-Adam flat buffer — never crosses the
-        shard_map boundary, so no per-leaf specs are needed."""
+        tree flattened into one functional fused-Adam FlatState — never
+        crosses the shard_map boundary, so no per-leaf specs are needed.
+        The fp32 flat master is the differentiation variable: autodiff
+        produces flat grads, no per-step grad re-ravel exists."""
         params = model.init(jax.random.PRNGKey(args.seed + 1),
                             stream[0, 0])
-        flat0, unravel = tree_ravel(params)
-        master = flat0.astype(jnp.float32)
+        st0 = tx.init(params)
+        kv_slices = [(off, size) for key, (off, size, _)
+                     in train_step.leaf_offsets(params).items()
+                     if "kv_proj" in key]
 
-        def loss_fn(tree, tokens):
-            labels = jnp.roll(tokens, -1, axis=1)
-            return model.apply(tree, tokens, labels)
+        def body(st, tokens):
+            def flat_loss(flat):
+                tree = st.unravel(flat.astype(st.flat_dtype))
+                labels = jnp.roll(tokens[0], -1, axis=1)
+                return model.apply(tree, tokens[0], labels)
 
-        def body(state, tokens):
-            master, m, v, n = state
-            tree = unravel(master.astype(flat0.dtype))
-            loss, g_tree = jax.value_and_grad(loss_fn)(tree, tokens[0])
-            # replicated-kv (MQA/GQA with kv_heads % tp != 0) wgrads
-            # are per-rank partials — psum them over the tensor axis
-            g_tree = reduce_llama_grads(g_tree, cfg)
-            g = tree_ravel(g_tree)[0]
+            loss, g = jax.value_and_grad(flat_loss)(st.master)
+            if need_kv_psum:
+                for off, size in kv_slices:
+                    leaf = jax.lax.dynamic_slice_in_dim(g, off, size)
+                    leaf = jax.lax.psum(leaf, parallel_state.TENSOR_AXIS)
+                    g = jax.lax.dynamic_update_slice_in_dim(
+                        g, leaf, off, 0)
             g = jax.lax.pmean(g, parallel_state.DATA_AXIS)
             loss = jax.lax.pmean(loss, parallel_state.DATA_AXIS)
-            p2, m2, v2 = fused_adam_flat(
-                master, g.astype(jnp.float32), m, v, lr=args.lr,
-                beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.0,
-                step=n + 1)
-            return (p2, m2, v2, n + 1), loss
+            return tx.update(st, g), loss
 
-        state = (master, jnp.zeros_like(master), jnp.zeros_like(master),
-                 jnp.zeros((), jnp.int32))
-        _, losses = jax.lax.scan(body, state, stream)
+        _, losses = jax.lax.scan(body, st0, stream)
         return losses
 
     stream = jnp.stack([cyclic_batch(rng, args, args.dp)

@@ -42,6 +42,11 @@ SLOW = {
     # llama fixture (new in r5): train/TP/remat legs measured 9-18 s
     "tests/L1/test_pretrain_llama.py::test_pretrain_llama_tp2_dp2_trains",
     "tests/L1/test_pretrain_llama.py::test_pretrain_llama_mqa_tp2",
+    # r6 re-lane (VERDICT r5 weak #4): the three unlaned >5 s tests that
+    # pushed the fast lane past its 300 s budget
+    "tests/L0/run_transformer/test_llama_minimal.py::test_gqa_variants_finite",
+    "tests/L0/run_transformer/test_llama_minimal.py::test_mqa_under_tp_replicated_kv",
+    "tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py::test_forward_only",
     "tests/L0/run_transformer/test_llama_minimal.py::test_mqa_tp_kv_grad_reduction_keeps_ranks_consistent",
     "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_trains_under_shard_map",
     "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_matches_tp1_exactly",
