@@ -314,7 +314,14 @@ def initialize_torch(model, optimizer, props, num_losses=1,
             for m in models:
                 _wrap_forward_autocast(m, half)
     elif opt_level in ("O2", "O3"):
-        keep_bn = bool(props.keep_batchnorm_fp32) and opt_level == "O2"
+        # honor the properties table as merged by the frontend: O2
+        # defaults keep_batchnorm_fp32=True, O3 defaults False, and an
+        # EXPLICIT keep_batchnorm_fp32=True with O3 is the reference's
+        # canonical "speed of light" mode (main_amp.py --opt-level O3
+        # --keep-batchnorm-fp32 True) — discarding it here ran BN
+        # statistics in bf16 and measurably degraded the O3 loss trace
+        # (tests/L1/test_cross_run_compare.py caught the drift)
+        keep_bn = bool(props.keep_batchnorm_fp32)
         for m in models:
             _cast_module(m, half, keep_bn)
             _wrap_forward_cast_inputs(m, half)

@@ -68,15 +68,23 @@ def unscale_grads(grads, state: LossScaleState):
     return unravel(out), state.replace(found_inf=flag)
 
 
-def unscale_flat_grads(flat_grads, state: LossScaleState):
+def unscale_flat_grads(flat_grads, state: LossScaleState, axis_name=None):
     """Flat-native :func:`unscale_grads`: same fused unscale + overflow
     detection, but over an already-flat grad buffer — the variant the
     flat-native train step uses, where autodiff produced flat grads and
     a tree round-trip would reintroduce the re-ravel concatenate.
 
+    ``axis_name`` reduces the overflow flag across a mesh axis (pmax):
+    under ZeRO each rank unscales only its own grad SHARD, but the
+    skip decision must be replica-uniform — a rank whose shard happens
+    to be finite must still skip when any peer overflowed, or the
+    ranks' masters diverge silently.
+
     Returns (unscaled_flat_grads, new_state with found_inf set).
     """
     out, flag = fused_scale(flat_grads, 1.0 / state.loss_scale)
+    if axis_name is not None:
+        flag = jax.lax.pmax(flag, axis_name)
     return out, state.replace(found_inf=flag)
 
 

@@ -87,6 +87,15 @@ STATIC_FNS = {
 STATIC_ATTRS = {
     "shape", "ndim", "dtype", "size", "itemsize", "nbytes", "sharding",
     "weak_type", "aval", "at",
+    # FlatState's static shard-layout fields (flax.struct
+    # pytree_node=False): reading them off a traced state is a
+    # static-metadata read, same category as .shape/.dtype — branching
+    # on them is a config branch.  Only the DISTINCTIVE names are
+    # listed (not generic ones like `sizes`/`offsets`, which would
+    # blanket-exempt those attribute reads on arbitrary objects and
+    # silence true positives — the lint is AST-based, untyped).
+    "shard", "shard_axis", "shard_dp", "shard_len", "global_numel",
+    "padded_numel",
 }
 
 _DISABLE_RE = re.compile(r"#\s*apex-lint:\s*disable=([A-Z0-9_,\s]+)")

@@ -9,45 +9,86 @@ params; fp32 master shards under fp16/bf16 params.
 TPU-native design: the whole sequence is THREE ops inside the jitted step —
 ``psum_scatter`` (reduce-scatter over the ``data`` axis), the Pallas fused
 update on the local 1/dp shard, ``all_gather`` — and XLA overlaps the
-collectives with neighbouring compute.  State lives as explicit pytrees
-(functional JAX): construct the optimizer OUTSIDE shard_map (static layout
-only), call ``init_state`` / ``step`` INSIDE shard_map with the data axis
-bound.  Memory per rank: params + (master, m, v)/dp — the ZeRO property.
+collectives with neighbouring compute.  Since ISSUE 3 these classes are
+THIN SHELLS over the dp-sharded functional core
+(:mod:`apex_tpu.optimizers.functional`): ``init_state`` builds a sharded
+``FlatState`` (static-slice sharding of the contiguous flat master),
+``step`` reduce-scatters the raveled grads and delegates the math —
+including LAMB's exact per-tensor trust ratios via the
+``lax.switch``-over-ranks static-span machinery in
+:mod:`apex_tpu.optimizers.base` — to the same ``_AdamTx``/``_LambTx``
+transforms the dense ``FusedAdam``/``FusedLAMB`` run, so ZeRO-vs-dense
+equivalence is structural rather than re-implemented.  State lives as
+explicit pytrees (functional JAX): construct the optimizer OUTSIDE
+shard_map (static layout only), call ``init_state`` / ``step`` INSIDE
+shard_map with the data axis bound.  Memory per rank: params +
+(master, m, v)/dp — the ZeRO property.
+
+Checkpointing is shard-aware: ``state_dict(state)`` reassembles the full
+unpadded flat master (accepting the global view a ``P(axis)`` out-spec
+returns, a ``[dp, shard_len]`` stack, or a dp=1 local state), and
+``load_state_dict`` + ``shard_state`` re-pad and re-slice it for any dp —
+a checkpoint taken at dp=4 restores onto dp=8.
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-
-from apex_tpu.ops.fused_update import (
-    fused_adam_flat,
-    fused_lamb_phase1_flat,
-)
 import numpy as np
 
-from apex_tpu.optimizers.base import broadcast_leaf_scalars
+from apex_tpu.optimizers import functional as _functional
 from apex_tpu.utils import cdiv, tree_ravel
 
 __all__ = ["DistributedFusedAdam", "DistributedFusedLAMB"]
 
-#: above this DP width the lax.switch-over-ranks trust-ratio path
-#: (O(dp * n_leaves) compiled branches) gives way to the global-buffer
-#: fallback (O(n) extra HBM traffic, compile size independent of dp)
-_SWITCH_MAX_DP = 32
-
 
 class _DistributedOptimizerBase:
-    """Static layout holder; all state is explicit (functional)."""
+    """Static layout holder; all state is explicit (functional).
 
-    def __init__(self, shard_size_divisor: int, axis_name: str = "data"):
+    Subclasses set ``self._tx`` (a functional transform) and
+    ``_state_keys`` (the slot names, matching ``FlatState.slots``)."""
+
+    _state_keys: tuple = ()
+
+    def __init__(self, shard_size_divisor: int, axis_name: str = "data",
+                 grad_average: bool = True):
         self.axis_name = axis_name
-        self.dp = shard_size_divisor
+        self.dp = int(shard_size_divisor)
+        self.grad_average = grad_average
+        self._numel: Optional[int] = None
+        self._sizes: Optional[tuple] = None
 
     # -- layout helpers ------------------------------------------------------
     def _padded(self, n: int) -> int:
         return cdiv(n, self.dp) * self.dp
+
+    def _record_layout(self, tree) -> tuple:
+        leaves = jax.tree_util.tree_leaves(tree)
+        sizes = tuple(int(x.size) for x in leaves)
+        self._sizes = sizes
+        self._numel = sum(sizes)
+        return sizes
+
+    def _shard(self) -> tuple:
+        return (self.axis_name, self.dp)
+
+    def _flat_state(self, state: dict, sizes: tuple):
+        """Legacy state dict -> sharded FlatState (zero-copy views)."""
+        return _functional.FlatState(
+            master=state["master"],
+            count=state["step"].astype(jnp.float32),
+            slots={k: state[k] for k in self._state_keys},
+            sizes=sizes,
+            shard=self._shard() if self.dp > 1 else ())
+
+    def init_state(self, params) -> dict:
+        """Build the sharded state for my rank (call inside shard_map)."""
+        sizes = self._record_layout(params)
+        fs = self._tx.init(params, shard=self._shard())
+        return {"step": jnp.zeros((), jnp.int32), "master": fs.master,
+                **{k: fs.slots[k] for k in self._state_keys}}
 
     def _shard_grads(self, grads):
         """ravel + reduce-scatter: returns (grad shard [n_pad/dp], n,
@@ -57,14 +98,16 @@ class _DistributedOptimizerBase:
         gflat, unravel = tree_ravel(grads)
         self._flat_dtype = gflat.dtype
         n = gflat.shape[0]
+        if self.dp == 1:
+            return gflat, n, unravel
         pad = self._padded(n) - n
         if pad:
             gflat = jnp.concatenate(
                 [gflat, jnp.zeros((pad,), gflat.dtype)])
-        if self.dp == 1:
-            return gflat, n, unravel
         gshard = jax.lax.psum_scatter(
             gflat, self.axis_name, scatter_dimension=0, tiled=True)
+        if self.grad_average:
+            gshard = gshard / self.dp
         return gshard, n, unravel
 
     def _gather_params(self, pshard, n, unravel):
@@ -74,30 +117,99 @@ class _DistributedOptimizerBase:
             pshard, self.axis_name, axis=0, tiled=True)[:n]
         return unravel(pfull.astype(self._flat_dtype))
 
-    def init_state(self, params) -> dict:
-        """Build the sharded state for my rank (call inside shard_map)."""
-        flat, _ = tree_ravel(params)
-        n = flat.shape[0]
-        npad = self._padded(n)
-        if npad != n:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((npad - n,), flat.dtype)])
-        shard_len = npad // self.dp
-        idx = jax.lax.axis_index(self.axis_name) if self.dp > 1 else 0
-        master = jax.lax.dynamic_slice_in_dim(
-            flat.astype(jnp.float32), idx * shard_len, shard_len)
-        return {
-            "step": jnp.zeros((), jnp.int32),
-            "master": master,
-            **{k: jnp.zeros_like(master) for k in self._state_keys},
-        }
+    def step(self, state: dict, grads, *, lr: Optional[float] = None,
+             noop_flag=0.0, grad_scale=1.0):
+        """One ZeRO step (inside shard_map binding the data axis).
+
+        Returns ``(params, new_state)``; params in the original dtypes.
+        """
+        sizes = self._record_layout(grads)
+        gshard, n, unravel = self._shard_grads(grads)
+        fs = self._flat_state(state, sizes)
+        fs = self._tx.update(fs, gshard,
+                             noop_flag=jnp.asarray(noop_flag, jnp.float32),
+                             grad_scale=jnp.asarray(grad_scale,
+                                                    jnp.float32),
+                             lr=lr)
+        new_state = {"step": state["step"] + 1, "master": fs.master,
+                     **{k: fs.slots[k] for k in self._state_keys}}
+        params = self._gather_params(fs.master, n, unravel)
+        return params, new_state
+
+    # -- checkpointing (shard-aware: reassembles the full flat master) ------
+    def _full_buffer(self, buf) -> np.ndarray:
+        """Accept the global 1-D padded view (``P(axis)`` out-spec), a
+        stacked ``[dp, shard_len]`` per-rank view, or a dp=1 local
+        buffer; return the UNPADDED full fp-precision vector."""
+        arr = np.asarray(buf)
+        if arr.ndim == 2:                      # [dp, shard_len] stack
+            arr = arr.reshape(-1)
+        n = self._numel
+        if arr.shape[0] < n:
+            raise ValueError(
+                f"state buffer has {arr.shape[0]} elements < numel {n}; "
+                "pass the GLOBAL view (out_specs=P(axis_name)) or the "
+                "[dp, shard_len] stack, not one rank's shard")
+        return arr[:n].copy()
+
+    def state_dict(self, state: dict) -> dict:
+        """Shard-aware checkpoint: the full (reassembled, unpadded) flat
+        master + slots.  ``state`` must be the post-``shard_map`` global
+        view (``out_specs=P(axis_name)`` on the sharded leaves) or a
+        ``[dp, shard_len]`` stack; a dp=1 state passes through."""
+        if self._numel is None:
+            raise ValueError(
+                "state_dict before init_state/step: the optimizer has "
+                "not seen the parameter layout yet")
+        return {"step": int(np.asarray(state["step"])),
+                "numel": int(self._numel),
+                "master": self._full_buffer(state["master"]),
+                **{k: self._full_buffer(state[k])
+                   for k in self._state_keys}}
+
+    def load_state_dict(self, sd: dict) -> dict:
+        """Full-buffer checkpoint -> padded GLOBAL state for THIS
+        optimizer's dp (re-pads, so the saving and restoring dp may
+        differ).  Feed the result through ``shard_state`` inside
+        shard_map (or use directly when dp == 1)."""
+        n = int(sd["numel"])
+        self._numel = n
+
+        def pad_full(v):
+            v = jnp.asarray(v, jnp.float32)
+            pad = self._padded(n) - n
+            if pad:
+                v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+            return v
+
+        return {"step": jnp.asarray(int(sd["step"]), jnp.int32),
+                "master": pad_full(sd["master"]),
+                **{k: pad_full(sd[k]) for k in self._state_keys}}
+
+    def shard_state(self, full_state: dict) -> dict:
+        """Slice MY rank's shard out of a padded GLOBAL state (call
+        inside shard_map with the axis bound)."""
+        if self.dp == 1:
+            return dict(full_state)
+        shard_len = full_state["master"].shape[0] // self.dp
+        idx = jax.lax.axis_index(self.axis_name)
+
+        def slc(v):
+            return jax.lax.dynamic_slice_in_dim(
+                v, idx * shard_len, shard_len)
+
+        return {"step": full_state["step"],
+                "master": slc(full_state["master"]),
+                **{k: slc(full_state[k]) for k in self._state_keys}}
 
 
 class DistributedFusedAdam(_DistributedOptimizerBase):
     """Parity surface for ``DistributedFusedAdam(params, lr, bias_correction,
     betas, eps, adam_w_mode, weight_decay, ...)``; distribution knobs
     (process groups, bucket sizes, overlap flags) collapse into the mesh
-    axis name — XLA owns bucketing/overlap."""
+    axis name — XLA owns bucketing/overlap.  The update math is the
+    functional ``_AdamTx`` the dense ``FusedAdam`` runs, applied to the
+    local shard."""
 
     _state_keys = ("exp_avg", "exp_avg_sq")
 
@@ -106,38 +218,16 @@ class DistributedFusedAdam(_DistributedOptimizerBase):
                  eps: float = 1e-8, adam_w_mode: bool = True,
                  weight_decay: float = 0.0, axis_name: str = "data",
                  grad_average: bool = True, **_parity_kwargs):
-        super().__init__(shard_size_divisor, axis_name)
+        super().__init__(shard_size_divisor, axis_name, grad_average)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
-        self.grad_average = grad_average
-
-    def step(self, state: dict, grads, *, lr: Optional[float] = None,
-             noop_flag=0.0, grad_scale=1.0):
-        """One ZeRO step (inside shard_map binding the data axis).
-
-        Returns ``(params, new_state)``; params in the original dtypes.
-        """
-        gshard, n, unravel = self._shard_grads(grads)
-        if self.grad_average and self.dp > 1:
-            gshard = gshard / self.dp
-        step = state["step"] + 1
-        p, m, v = fused_adam_flat(
-            state["master"], gshard.astype(jnp.float32),
-            state["exp_avg"], state["exp_avg_sq"],
-            lr=self.lr if lr is None else lr,
-            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
-            weight_decay=self.weight_decay, step=step,
-            adam_w_mode=self.adam_w_mode,
-            bias_correction=self.bias_correction,
-            noop_flag=noop_flag, grad_scale=grad_scale)
-        new_state = {"step": step, "master": p, "exp_avg": m,
-                     "exp_avg_sq": v}
-        params = self._gather_params(p, n, unravel)
-        return params, new_state
+        self._tx = _functional.fused_adam(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            adam_w_mode=adam_w_mode, bias_correction=bias_correction)
 
 
 class DistributedFusedLAMB(_DistributedOptimizerBase):
@@ -146,12 +236,16 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
     norms for the trust ratio, phase-2 scaled apply, then all-gather.
 
     The reference computes exact per-tensor norms across shards
-    (``multi_tensor_l2norm`` + group allreduce); here each shard computes
-    per-tensor partial sums of squares over the static leaf-span layout
-    (a ``lax.switch`` over ranks keeps every slice static — per-element
-    gathers measure seconds on TPU, see ``_shard_leaf_spans``), psum'd
-    over the data axis — same math, one collective, EXACT per-tensor
-    trust ratios.
+    (``multi_tensor_l2norm`` + group allreduce); the functional
+    ``_LambTx`` does the same on sharded state — shard-local per-tensor
+    partial sums of squares over the static leaf-span layout (a
+    ``lax.switch`` over ranks keeps every slice static — per-element
+    gathers measure seconds on TPU, see
+    ``optimizers.base.shard_leaf_spans``), psum'd over the data axis —
+    same math, one collective, EXACT per-tensor trust ratios.  Above
+    ``optimizers.base._SWITCH_MAX_DP`` the switch path (O(dp·n_leaves)
+    compiled branches) gives way to a bounded-compile global-buffer
+    fallback.
     """
 
     _state_keys = ("exp_avg", "exp_avg_sq")
@@ -162,165 +256,15 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
                  max_grad_norm: float = 1.0, axis_name: str = "data",
                  grad_average: bool = True, use_nvlamb: bool = False,
                  **_parity_kwargs):
-        super().__init__(shard_size_divisor, axis_name)
+        super().__init__(shard_size_divisor, axis_name, grad_average)
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
         self.weight_decay = weight_decay
         self.max_grad_norm = max_grad_norm
-        self.grad_average = grad_average
         self.use_nvlamb = use_nvlamb
-
-    def _shard_leaf_spans(self, sizes, n: int):
-        """Static leaf spans per rank: ``spans[r]`` lists
-        ``(leaf_id, lo, hi)`` — the intersection of each leaf's
-        ``[offset, offset+size)`` with rank r's padded shard window, in
-        shard-local coordinates.  The padding tail is covered by no span.
-
-        Leaf boundaries AND the shard length are static, so every rank's
-        spans are plain Python — only *which* rank we are is dynamic, and
-        a ``lax.switch`` over ranks keeps every slice static.  This is
-        load-bearing for TPU: per-element gathers (``segment_sum`` /
-        ``trust[seg]``) over a BERT-large-sized shard measure seconds per
-        call (see ``broadcast_leaf_scalars``), while static slices +
-        concat are copies.
-
-        Compile cost is O(dp · n_leaves) HLO ops (dead branches are
-        compiled, not executed); above ``_SWITCH_MAX_DP`` ``step``
-        switches to the global-buffer fallback — the leaf layout is
-        globally static and only the shard offset is dynamic, so the
-        shard is placed into a zeroed full-size buffer (norms) and the
-        full-size static scale vector is dynamically sliced (apply),
-        bounding compile size at the cost of O(n) extra HBM traffic."""
-        shard_len = self._padded(n) // self.dp
-        offs = [0]
-        for s in sizes:
-            offs.append(offs[-1] + s)
-        spans = []
-        for r in range(self.dp):
-            start, end = r * shard_len, (r + 1) * shard_len
-            rs = [(i, max(o, start) - start, min(o + s, end) - start)
-                  for i, (o, s) in enumerate(zip(offs, sizes))
-                  if min(o + s, end) > max(o, start)]
-            spans.append(rs)
-        return spans, shard_len
-
-    def step(self, state: dict, grads, *, lr: Optional[float] = None,
-             noop_flag=0.0, grad_scale=1.0):
-        leaves = jax.tree.leaves(grads)
-        gshard, n, unravel = self._shard_grads(grads)
-        if self.grad_average and self.dp > 1:
-            gshard = gshard / self.dp
-        # global grad-norm clip (reference: pre-LAMB global L2 clip)
-        sq = jnp.sum(jnp.square(gshard.astype(jnp.float32)))
-        if self.dp > 1:
-            sq = jax.lax.psum(sq, self.axis_name)
-        gnorm = jnp.sqrt(sq)
-        # same formula as optimizers.FusedLAMB._lamb_step for equivalence
-        clip = jnp.where(gnorm > self.max_grad_norm,
-                         self.max_grad_norm / (gnorm + 1e-6), 1.0) \
-            if self.max_grad_norm else 1.0
-        step = state["step"] + 1
-        m, v, u = fused_lamb_phase1_flat(
-            state["master"], gshard * clip, state["exp_avg"],
-            state["exp_avg_sq"], beta1=self.betas[0], beta2=self.betas[1],
-            eps=self.eps, weight_decay=self.weight_decay, step=step,
-            bias_correction=self.bias_correction, grad_scale=grad_scale)
-        # EXACT per-tensor trust ratios (reference: multi_tensor_l2norm per
-        # tensor + group allreduce): shard-local per-tensor partial sq-sums
-        # over static leaf spans (lax.switch over ranks — no per-element
-        # gathers, see _shard_leaf_spans), psum over dp, per-tensor ratio
-        # broadcast back through static-slice concatenation.
-        p32 = state["master"]
-        sizes = [int(l.size) for l in leaves]
-        n_tensors = len(sizes)
-        large_dp = self.dp > _SWITCH_MAX_DP
-        if large_dp:        # spans unused — skip the O(dp*n_leaves) build
-            spans, shard_len = None, self._padded(n) // self.dp
-        else:
-            spans, shard_len = self._shard_leaf_spans(sizes, n)
-        idx = jax.lax.axis_index(self.axis_name) if self.dp > 1 else 0
-
-        def _norms_branch(rs):
-            def f(pu):
-                p_, u_ = pu
-                out = []
-                for vec in (p_, u_):
-                    row = [jnp.float32(0.0)] * n_tensors
-                    for i, lo, hi in rs:
-                        row[i] = jnp.sum(jnp.square(
-                            jax.lax.dynamic_slice_in_dim(vec, lo, hi - lo)))
-                    out.append(jnp.stack(row))
-                return jnp.stack(out)
-            return f
-
-        if large_dp:
-            # bounded-compile fallback: only the shard's OFFSET is
-            # dynamic (idx * shard_len) — place the shard into a
-            # zeroed GLOBAL buffer at that offset, then every leaf
-            # reduction is a static slice.  Costs one full-buffer temp
-            # (O(n) HBM traffic, ~3 ms on a 335M tree) instead of the
-            # switch path's O(dp * n_leaves) compiled branches.
-            npad = self._padded(n)
-            offs = list(np.cumsum([0] + sizes[:-1]))
-
-            def global_sq_norms(vec):
-                full = jax.lax.dynamic_update_slice_in_dim(
-                    jnp.zeros((npad,), jnp.float32), jnp.square(vec),
-                    idx * shard_len, axis=0)
-                return jnp.stack([
-                    jnp.sum(jax.lax.dynamic_slice_in_dim(full, o, s))
-                    for o, s in zip(offs, sizes)])
-            sq = jnp.stack([global_sq_norms(p32), global_sq_norms(u)])
-            sq = jax.lax.psum(sq, self.axis_name)
-        elif self.dp > 1:
-            sq = jax.lax.switch(idx, [_norms_branch(rs) for rs in spans],
-                                (p32, u))
-            sq = jax.lax.psum(sq, self.axis_name)
-        else:
-            sq = _norms_branch(spans[0])((p32, u))
-        psq, usq = sq[0], sq[1]
-        pnorm, unorm = jnp.sqrt(psq), jnp.sqrt(usq)
-        if self.use_nvlamb:
-            trust = pnorm / jnp.maximum(unorm, 1e-12)
-        else:
-            trust = jnp.where((pnorm > 0) & (unorm > 0), pnorm / unorm, 1.0)
-
-        def _scale_branch(rs):
-            def f(trust):
-                vals = [trust[i] for i, _, _ in rs]
-                span_sizes = [hi - lo for _, lo, hi in rs]
-                covered = sum(span_sizes)
-                if covered < shard_len:     # padding tail: ratio 1
-                    vals.append(jnp.float32(1.0))
-                    span_sizes.append(shard_len - covered)
-                return broadcast_leaf_scalars(jnp.stack(vals), span_sizes)
-            return f
-
-        if large_dp:
-            # global scale vector is static-structured (leaf layout);
-            # my shard's window is one dynamic slice of it
-            npad = self._padded(n)
-            gsizes = list(sizes)
-            if npad > n:
-                gsizes.append(npad - n)
-            gtrust = (jnp.concatenate([trust, jnp.ones((1,), jnp.float32)])
-                      if npad > n else trust)
-            scale = jax.lax.dynamic_slice_in_dim(
-                broadcast_leaf_scalars(gtrust, gsizes),
-                idx * shard_len, shard_len)
-        elif self.dp > 1:
-            scale = jax.lax.switch(
-                idx, [_scale_branch(rs) for rs in spans], trust)
-        else:
-            scale = _scale_branch(spans[0])(trust)
-        p = p32 - (self.lr if lr is None else lr) * scale * u
-        skip = jnp.asarray(noop_flag, jnp.float32) > 0
-        p = jnp.where(skip, p32, p)
-        m = jnp.where(skip, state["exp_avg"], m)
-        v = jnp.where(skip, state["exp_avg_sq"], v)
-        new_state = {"step": step, "master": p, "exp_avg": m,
-                     "exp_avg_sq": v}
-        params = self._gather_params(p, n, unravel)
-        return params, new_state
+        self._tx = _functional.fused_lamb(
+            lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+            max_grad_norm=max_grad_norm, bias_correction=bias_correction,
+            use_nvlamb=use_nvlamb)

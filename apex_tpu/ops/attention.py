@@ -49,6 +49,7 @@ mode is exact and the fused-vs-split tests hold at 1e-5.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -707,7 +708,29 @@ def _plan_block(s: int, preferred: int):
 #: 828 µs vs 119 µs for plain XLA einsum attention; at s=256 it is
 #: 707 vs 379; from s=512 the kernel wins (777 vs 2033, and 4.3x at
 #: s=2048).  Auto-dispatch sends padded-seq <= 256 to the XLA path.
+#: The 256 boundary itself is interpolated from those four points, not
+#: measured densely — override per-run with the environment variable
+#: ``APEX_TPU_ATTN_XLA_MAX_SEQ`` or per-call with the
+#: ``flash_attention(..., xla_max_seq=)`` kwarg (0 disables the XLA
+#: path entirely); bench attn captures stamp the effective value.
 _XLA_PATH_MAX_SEQ = 256
+
+_XLA_MAX_SEQ_ENV = "APEX_TPU_ATTN_XLA_MAX_SEQ"
+
+
+def xla_path_max_seq(override=None) -> int:
+    """The effective auto-dispatch crossover: explicit kwarg override >
+    ``APEX_TPU_ATTN_XLA_MAX_SEQ`` env var > the measured default."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get(_XLA_MAX_SEQ_ENV)
+    if env:
+        try:
+            return int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_XLA_MAX_SEQ_ENV} must be an int, got {env!r}") from e
+    return _XLA_PATH_MAX_SEQ
 
 
 def _xla_attention(q, k, v, *, causal, scale, mask, rate, seed):
@@ -752,7 +775,8 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                     block_k: Optional[int] = None,
                     dropout_rate: float = 0.0,
                     dropout_seed=None,
-                    use_kernel: Optional[bool] = None):
+                    use_kernel: Optional[bool] = None,
+                    xla_max_seq: Optional[int] = None):
     """Fused blockwise attention, ``[b, h, s, d]`` layout.
 
     Drop-in fused path for the reference's ``fmhalib`` /
@@ -764,12 +788,15 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     old behavior here was a silent O(s²) oracle fallback).
 
     ``use_kernel=None`` auto-dispatches: on TPU backends, sequences at
-    or under ``_XLA_PATH_MAX_SEQ`` (measured crossover — see its note)
-    run as one fused XLA einsum chain instead of the Pallas kernels;
-    identical semantics including the dropout mask stream.  Explicit
-    ``block_q``/``block_k`` forces the kernel (the caller is tuning
-    it), as does ``use_kernel=True``; non-TPU backends always take the
-    kernel so interpret-mode tests exercise kernel code.
+    or under the crossover (``xla_max_seq`` kwarg >
+    ``APEX_TPU_ATTN_XLA_MAX_SEQ`` env var > the measured default
+    ``_XLA_PATH_MAX_SEQ`` — see its note; the guessed 256 boundary is
+    tunable without a code edit) run as one fused XLA einsum chain
+    instead of the Pallas kernels; identical semantics including the
+    dropout mask stream.  Explicit ``block_q``/``block_k`` forces the
+    kernel (the caller is tuning it), as does ``use_kernel=True``;
+    non-TPU backends always take the kernel so interpret-mode tests
+    exercise kernel code.
 
     ``dropout_rate`` > 0 drops attention *probabilities* in-kernel (the
     reference's philox softmax+dropout fusion; see the module
@@ -808,7 +835,7 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
                 f"{tuple(mask.shape)}")
     if use_kernel is None:
         use_kernel = (block_q is not None or block_k is not None
-                      or max(sq, sk) > _XLA_PATH_MAX_SEQ
+                      or max(sq, sk) > xla_path_max_seq(xla_max_seq)
                       or jax.default_backend() not in ("tpu", "axon"))
     if not use_kernel:
         return _xla_attention(q, k, v, causal=causal, scale=scale,

@@ -22,10 +22,18 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.utils import tree_ravel
 
-__all__ = ["FusedOptimizerBase", "broadcast_leaf_scalars"]
+__all__ = ["FusedOptimizerBase", "broadcast_leaf_scalars",
+           "shard_leaf_spans", "sharded_leaf_sq_norms",
+           "sharded_leaf_broadcast"]
+
+#: above this DP width the lax.switch-over-ranks static-span paths
+#: (O(dp * n_leaves) compiled branches) give way to the global-buffer
+#: fallback (O(n) extra HBM traffic, compile size independent of dp)
+_SWITCH_MAX_DP = 32
 
 
 def broadcast_leaf_scalars(scalars: jax.Array,
@@ -43,6 +51,122 @@ def broadcast_leaf_scalars(scalars: jax.Array,
     return jnp.concatenate([
         jnp.broadcast_to(scalars[i], (int(s),))
         for i, s in enumerate(sizes)])
+
+
+def shard_leaf_spans(sizes: Sequence[int], dp: int, shard_len: int):
+    """Static leaf spans per rank: ``spans[r]`` lists ``(leaf_id, lo,
+    hi)`` — the intersection of each leaf's ``[offset, offset+size)``
+    with rank r's padded shard window, in shard-local coordinates.  The
+    padding tail is covered by no span.
+
+    Leaf boundaries AND the shard length are static, so every rank's
+    spans are plain Python — only *which* rank we are is dynamic, and a
+    ``lax.switch`` over ranks keeps every slice static.  This is
+    load-bearing for TPU: per-element gathers (``segment_sum`` /
+    ``trust[seg]``) over a BERT-large-sized shard measure seconds per
+    call (see ``broadcast_leaf_scalars``), while static slices + concat
+    are copies."""
+    offs = [0]
+    for s in sizes:
+        offs.append(offs[-1] + int(s))
+    spans = []
+    for r in range(dp):
+        start, end = r * shard_len, (r + 1) * shard_len
+        rs = [(i, max(o, start) - start, min(o + s, end) - start)
+              for i, (o, s) in enumerate(zip(offs, sizes))
+              if min(o + int(s), end) > max(o, start)]
+        spans.append(rs)
+    return spans
+
+
+def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
+                          *, dp: int, shard_len: int,
+                          rank: jax.Array) -> jax.Array:
+    """``[len(vecs), n_leaves]`` per-tensor partial sums of squares of MY
+    shard of each flat vector, over the static leaf-span layout.  The
+    caller ``psum``s the result over the dp axis to get global norms.
+
+    Compile cost of the switch path is O(dp · n_leaves) HLO ops (dead
+    branches are compiled, not executed); above ``_SWITCH_MAX_DP`` this
+    falls back to placing the shard into a zeroed global buffer (the
+    leaf layout is globally static and only the shard offset is
+    dynamic), bounding compile size at the cost of O(n) extra HBM
+    traffic."""
+    sizes = [int(s) for s in sizes]
+    n_tensors = len(sizes)
+    if dp > _SWITCH_MAX_DP:
+        npad = dp * shard_len
+        offs = list(np.cumsum([0] + sizes[:-1]))
+
+        def global_sq_norms(vec):
+            full = jax.lax.dynamic_update_slice_in_dim(
+                jnp.zeros((npad,), jnp.float32),
+                jnp.square(vec.astype(jnp.float32)),
+                rank * shard_len, axis=0)
+            return jnp.stack([
+                jnp.sum(jax.lax.dynamic_slice_in_dim(full, o, s))
+                for o, s in zip(offs, sizes)])
+        return jnp.stack([global_sq_norms(v) for v in vecs])
+
+    spans = shard_leaf_spans(sizes, dp, shard_len)
+
+    def branch(rs):
+        def f(vs):
+            out = []
+            for vec in vs:
+                row = [jnp.float32(0.0)] * n_tensors
+                for i, lo, hi in rs:
+                    row[i] = jnp.sum(jnp.square(
+                        jax.lax.dynamic_slice_in_dim(
+                            vec, lo, hi - lo).astype(jnp.float32)))
+                out.append(jnp.stack(row))
+            return jnp.stack(out)
+        return f
+
+    if dp == 1:
+        return branch(spans[0])(tuple(vecs))
+    return jax.lax.switch(rank, [branch(rs) for rs in spans], tuple(vecs))
+
+
+def sharded_leaf_broadcast(scalars: jax.Array, sizes: Sequence[int], *,
+                           dp: int, shard_len: int, rank: jax.Array,
+                           pad_value: float = 1.0) -> jax.Array:
+    """Shard-local :func:`broadcast_leaf_scalars`: expand a
+    ``(n_leaves,)`` vector to MY rank's ``[shard_len]`` window of the
+    flat per-element buffer (padding tail filled with ``pad_value``).
+    Same static-span / ``lax.switch`` discipline as
+    :func:`sharded_leaf_sq_norms`, with the same bounded-compile
+    global-buffer fallback above ``_SWITCH_MAX_DP``."""
+    sizes = [int(s) for s in sizes]
+    if dp > _SWITCH_MAX_DP:
+        npad = dp * shard_len
+        n = sum(sizes)
+        gsizes = list(sizes)
+        gscalars = scalars
+        if npad > n:
+            gsizes.append(npad - n)
+            gscalars = jnp.concatenate(
+                [scalars, jnp.full((1,), pad_value, scalars.dtype)])
+        return jax.lax.dynamic_slice_in_dim(
+            broadcast_leaf_scalars(gscalars, gsizes),
+            rank * shard_len, shard_len)
+
+    spans = shard_leaf_spans(sizes, dp, shard_len)
+
+    def branch(rs):
+        def f(scalars):
+            vals = [scalars[i] for i, _, _ in rs]
+            span_sizes = [hi - lo for _, lo, hi in rs]
+            covered = sum(span_sizes)
+            if covered < shard_len:     # padding tail
+                vals.append(jnp.asarray(pad_value, scalars.dtype))
+                span_sizes.append(shard_len - covered)
+            return broadcast_leaf_scalars(jnp.stack(vals), span_sizes)
+        return f
+
+    if dp == 1:
+        return branch(spans[0])(scalars)
+    return jax.lax.switch(rank, [branch(rs) for rs in spans], scalars)
 
 
 def _leaf_sizes(tree) -> tuple[int, ...]:

@@ -49,7 +49,7 @@ from apex_tpu.ops.fused_update import (
     fused_lamb_phase1_flat,
     fused_sgd_flat,
 )
-from apex_tpu.utils import tree_ravel
+from apex_tpu.utils import cdiv, tree_ravel
 
 __all__ = [
     "FlatState",
@@ -58,6 +58,7 @@ __all__ = [
     "fused_sgd",
     "fused_novograd",
     "fused_adagrad",
+    "shard_flat_grads",
 ]
 
 
@@ -75,8 +76,19 @@ class FlatState:
     round-trip for checkpoint/eval boundaries.  ``update`` never touches
     them, so carrying a FlatState through ``lax.scan`` keeps the treedef
     stable.
+
+    ``shard`` is the ZeRO-1/2 mode: ``()`` (dense, the default) or
+    ``(axis_name, dp)`` — the flat master was padded to a ``dp``
+    multiple and THIS state holds one ``1/dp`` shard of master and
+    slots, owned by one rank of the named mesh axis.  Element-wise
+    rules update the shard unchanged; per-leaf rules (LAMB trust
+    ratios, NovoGrad per-tensor moments) compute shard-local partial
+    norms over the static leaf-span layout and ``psum`` them global
+    (see :mod:`apex_tpu.optimizers.base`).  Because the flat master is
+    ONE contiguous buffer, sharding it is a static slice — not a
+    297-leaf bucketing problem.
     """
-    master: jax.Array               # fp32 flat master buffer
+    master: jax.Array               # fp32 flat master buffer (or shard)
     count: jax.Array                # f32 scalar: completed update count
     slots: dict                     # rule buffers, keyed like state_dict
     sizes: tuple = flax.struct.field(pytree_node=False, default=())
@@ -84,6 +96,7 @@ class FlatState:
                                         default="float32")
     unravel: Optional[Callable] = flax.struct.field(pytree_node=False,
                                                     default=None)
+    shard: tuple = flax.struct.field(pytree_node=False, default=())
 
     @property
     def offsets(self) -> tuple:
@@ -93,21 +106,95 @@ class FlatState:
             off += s
         return tuple(out)
 
+    # -- ZeRO shard layout (all static Python ints) --------------------------
+    @property
+    def shard_axis(self) -> Optional[str]:
+        return self.shard[0] if self.shard else None
+
+    @property
+    def shard_dp(self) -> int:
+        return int(self.shard[1]) if self.shard else 1
+
+    @property
+    def global_numel(self) -> int:
+        """Unpadded element count of the GLOBAL flat master."""
+        return sum(self.sizes)
+
+    @property
+    def padded_numel(self) -> int:
+        return cdiv(self.global_numel, self.shard_dp) * self.shard_dp
+
+    @property
+    def shard_len(self) -> int:
+        """Per-rank shard length: ``ceil(P_padded / dp)`` elements."""
+        return self.padded_numel // self.shard_dp
+
+    def _full_master(self, dtype=None):
+        """GLOBAL unpadded flat master.  For a sharded LOCAL view this
+        all-gathers over the shard axis (call inside the mapped region);
+        a sharded GLOBAL view (buffers already full-size, e.g. a state
+        passed OUT of shard_map with a dp-sharded out-spec) and the
+        dense case just slice."""
+        flat = self.master
+        if dtype is not None:
+            flat = flat.astype(dtype)
+        if self.shard and self.shard_dp > 1 \
+                and flat.shape[0] != self.padded_numel:
+            flat = jax.lax.all_gather(flat, self.shard_axis, axis=0,
+                                      tiled=True)
+        n = self.global_numel
+        return flat[:n] if flat.shape[0] != n else flat
+
     def params(self):
         """Materialize the params pytree (construction dtypes).
 
         This is the checkpoint/eval boundary — inside a jitted train
-        step the unravel slices fuse into the consumer instead."""
+        step the unravel slices fuse into the consumer instead.  A
+        sharded state all-gathers its master (in the construction
+        dtype, so bf16 params cost bf16 comm bytes)."""
         if self.unravel is None:
             raise ValueError(
                 "FlatState was initialized from a flat buffer (no "
                 "unravel); call .master directly or init from a pytree")
-        return self.unravel(self.master.astype(self.flat_dtype))
+        return self.unravel(self._full_master(self.flat_dtype))
 
 
-def _init_state(tx, params) -> FlatState:
+def shard_flat_grads(flat_grads: jax.Array, state: FlatState, *,
+                     mean: bool = True) -> jax.Array:
+    """Reduce-scatter a FULL per-rank flat grad buffer into MY shard's
+    window (the ZeRO-2 grad reduction): zero-pad to the padded length,
+    ``psum_scatter`` over the shard axis, and (by default) divide by dp
+    for data-parallel mean semantics.  Comm bytes equal the old
+    all-reduce's reduce-scatter half; the all-gather half moves to the
+    params side (:meth:`FlatState.params` / the zero train step).
+
+    No-op (beyond the mean) when ``state`` is dense or dp == 1 — so the
+    same step code serves every topology."""
+    if not state.shard or state.shard_dp == 1:
+        return flat_grads
+    pad = state.padded_numel - state.global_numel
+    if pad:
+        flat_grads = jnp.concatenate(
+            [flat_grads, jnp.zeros((pad,), flat_grads.dtype)])
+    gshard = jax.lax.psum_scatter(
+        flat_grads, state.shard_axis, scatter_dimension=0, tiled=True)
+    return gshard / state.shard_dp if mean else gshard
+
+
+def _shard_of(flat: jax.Array, shard_len: int, rank):
+    return jax.lax.dynamic_slice_in_dim(
+        flat, jnp.asarray(rank, jnp.int32) * shard_len, shard_len)
+
+
+def _init_state(tx, params, shard=None) -> FlatState:
     """Shared init: ravel a pytree (or accept an already-flat buffer)
-    into a donation-safe fp32 master + the rule's zero slots."""
+    into a donation-safe fp32 master + the rule's zero slots.
+
+    ``shard=(axis_name, dp[, rank])`` materializes only rank's
+    ``1/dp`` shard of the dp-padded master (and slots).  ``rank``
+    defaults to ``lax.axis_index(axis_name)`` — the in-``shard_map``
+    case; pass an explicit int to build one rank's shard eagerly
+    (checkpoint resharding, tests)."""
     if hasattr(params, "ndim") and params.ndim == 1:
         flat, unravel = params, None
         sizes = (int(flat.size),)
@@ -120,13 +207,28 @@ def _init_state(tx, params) -> FlatState:
     # Explicit copy: the master is donated every step, and ravel of a
     # single fp32 leaf can alias the caller's param array.
     master = jnp.array(flat, dtype=jnp.float32, copy=True)
+    shard_static: tuple = ()
+    if shard is not None:
+        axis_name, dp, *rank_opt = shard
+        dp = int(dp)
+        shard_static = (axis_name, dp)
+        n = int(master.shape[0])
+        padded = cdiv(n, dp) * dp
+        if padded != n:
+            master = jnp.concatenate(
+                [master, jnp.zeros((padded - n,), master.dtype)])
+        if dp > 1:
+            rank = rank_opt[0] if rank_opt \
+                else jax.lax.axis_index(axis_name)
+            master = _shard_of(master, padded // dp, rank)
     return FlatState(
         master=master,
         count=jnp.zeros((), jnp.float32),
         slots=tx.init_slots(master, sizes=sizes),
         sizes=sizes,
         flat_dtype=flat_dtype,
-        unravel=unravel)
+        unravel=unravel,
+        shard=shard_static)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -140,8 +242,8 @@ class _AdamTx:
     adam_w_mode: bool = True
     bias_correction: bool = True
 
-    def init(self, params) -> FlatState:
-        return _init_state(self, params)
+    def init(self, params, shard=None) -> FlatState:
+        return _init_state(self, params, shard=shard)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"exp_avg": jnp.zeros_like(master),
@@ -191,8 +293,8 @@ class _LambTx:
     grad_averaging: bool = True
     use_nvlamb: bool = False
 
-    def init(self, params) -> FlatState:
-        return _init_state(self, params)
+    def init(self, params, shard=None) -> FlatState:
+        return _init_state(self, params, shard=shard)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"exp_avg": jnp.zeros_like(master),
@@ -206,12 +308,18 @@ class _LambTx:
         m = state.slots["exp_avg"]
         v = state.slots["exp_avg_sq"]
         offsets, sizes = state.offsets, state.sizes
+        sharded = bool(state.shard) and state.shard_dp > 1
+        axis, dp = state.shard_axis, state.shard_dp
         mgn = _f32(self.max_grad_norm if max_grad_norm is None
                    else max_grad_norm)
         g32 = flat_grads.astype(jnp.float32) * _f32(grad_scale)
         # global grad norm clip (reference: first multi_tensor_l2norm
-        # launch)
-        gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+        # launch); under ZeRO each rank holds one grad shard, so the
+        # shard-local sum of squares is psum'd into the global norm
+        gsq = jnp.sum(g32 * g32)
+        if sharded:
+            gsq = jax.lax.psum(gsq, axis)
+        gnorm = jnp.sqrt(gsq)
         clip = jnp.where((mgn > 0) & (gnorm > mgn), mgn / (gnorm + 1e-6),
                          1.0)
         m_new, v_new, u = fused_lamb_phase1_flat(
@@ -224,21 +332,41 @@ class _LambTx:
             step=t, bias_correction=self.bias_correction,
             grad_scale=clip, grad_averaging=self.grad_averaging)
 
-        def sq_norms(flat):
-            return jnp.stack([
-                jnp.sum(jnp.square(
-                    jax.lax.dynamic_slice_in_dim(flat, off, size)))
-                for off, size in zip(offsets, sizes)])
+        if sharded:
+            # EXACT per-tensor trust ratios across shards (reference:
+            # DistributedFusedLAMB's multi_tensor_l2norm + group
+            # allreduce): shard-local per-tensor partial sq-sums over
+            # the static leaf-span layout (lax.switch over ranks — no
+            # per-element gathers), psum'd over dp.
+            from apex_tpu.optimizers.base import (
+                sharded_leaf_broadcast, sharded_leaf_sq_norms)
+            rank = jax.lax.axis_index(axis)
+            sq = sharded_leaf_sq_norms(
+                (p, u), sizes, dp=dp, shard_len=state.shard_len,
+                rank=rank)
+            sq = jax.lax.psum(sq, axis)
+            w_norm, u_norm = jnp.sqrt(sq[0]), jnp.sqrt(sq[1])
+        else:
+            def sq_norms(flat):
+                return jnp.stack([
+                    jnp.sum(jnp.square(
+                        jax.lax.dynamic_slice_in_dim(flat, off, size)))
+                    for off, size in zip(offsets, sizes)])
 
-        w_norm = jnp.sqrt(sq_norms(p))
-        u_norm = jnp.sqrt(sq_norms(u))
+            w_norm = jnp.sqrt(sq_norms(p))
+            u_norm = jnp.sqrt(sq_norms(u))
         # NVLAMB applies the trust ratio to every param; default LAMB
         # skips params with zero norm (reference kernel's `use_nvlamb`).
         ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm,
                           jnp.float32(1.0))
         if self.use_nvlamb:
             ratio = w_norm / jnp.maximum(u_norm, 1e-12)
-        scale = _broadcast_leaf_scalars(ratio, sizes)
+        if sharded:
+            scale = sharded_leaf_broadcast(
+                ratio, sizes, dp=dp, shard_len=state.shard_len,
+                rank=rank)
+        else:
+            scale = _broadcast_leaf_scalars(ratio, sizes)
         p_new = p - _f32(self.lr if lr is None else lr) * scale * u
 
         skip = _f32(noop_flag) > 0
@@ -262,8 +390,8 @@ class _SgdTx:
     nesterov: bool = False
     wd_after_momentum: bool = False
 
-    def init(self, params) -> FlatState:
-        return _init_state(self, params)
+    def init(self, params, shard=None) -> FlatState:
+        return _init_state(self, params, shard=shard)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"momentum_buffer": jnp.zeros_like(master),
@@ -307,8 +435,8 @@ class _NovoGradTx:
     grad_averaging: bool = True
     init_zero: bool = False
 
-    def init(self, params) -> FlatState:
-        return _init_state(self, params)
+    def init(self, params, shard=None) -> FlatState:
+        return _init_state(self, params, shard=shard)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"exp_avg": jnp.zeros_like(master),
@@ -322,19 +450,38 @@ class _NovoGradTx:
         m = state.slots["exp_avg"]
         v = state.slots["exp_avg_sq"]
         offsets, sizes = state.offsets, state.sizes
+        sharded = bool(state.shard) and state.shard_dp > 1
         b1 = _f32(self.beta1 if beta1 is None else beta1)
         b2 = _f32(self.beta2 if beta2 is None else beta2)
         g32 = flat_grads.astype(jnp.float32) * _f32(grad_scale)
-        gsq = jnp.stack([
-            jnp.sum(jnp.square(
-                jax.lax.dynamic_slice_in_dim(g32, off, size)))
-            for off, size in zip(offsets, sizes)])
+        if sharded:
+            # per-tensor ||g||² from grad SHARDS: static-span partial
+            # sums, psum'd global (the exp_avg_sq slot is one scalar
+            # per leaf — replicated, NOT sharded)
+            from apex_tpu.optimizers.base import (
+                sharded_leaf_broadcast, sharded_leaf_sq_norms)
+            rank = jax.lax.axis_index(state.shard_axis)
+            gsq = jax.lax.psum(
+                sharded_leaf_sq_norms(
+                    (g32,), sizes, dp=state.shard_dp,
+                    shard_len=state.shard_len, rank=rank)[0],
+                state.shard_axis)
+        else:
+            gsq = jnp.stack([
+                jnp.sum(jnp.square(
+                    jax.lax.dynamic_slice_in_dim(g32, off, size)))
+                for off, size in zip(offsets, sizes)])
         first = t <= 1.0
         v_init = jnp.zeros_like(gsq) if self.init_zero else gsq
         v_new = jnp.where(first, v_init, b2 * v + (1.0 - b2) * gsq)
-        denom = _broadcast_leaf_scalars(
-            jnp.sqrt(v_new) + _f32(self.eps if eps is None else eps),
-            sizes)
+        denom_scalars = (jnp.sqrt(v_new)
+                         + _f32(self.eps if eps is None else eps))
+        if sharded:
+            denom = sharded_leaf_broadcast(
+                denom_scalars, sizes, dp=state.shard_dp,
+                shard_len=state.shard_len, rank=rank)
+        else:
+            denom = _broadcast_leaf_scalars(denom_scalars, sizes)
         ghat = g32 / denom + _f32(self.weight_decay if weight_decay is None
                                   else weight_decay) * p
         coef = (1.0 - b1) if self.grad_averaging else 1.0
@@ -360,8 +507,8 @@ class _AdagradTx:
     weight_decay: float = 0.0
     w_mode: bool = False
 
-    def init(self, params) -> FlatState:
-        return _init_state(self, params)
+    def init(self, params, shard=None) -> FlatState:
+        return _init_state(self, params, shard=shard)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"sum": jnp.zeros_like(master)}

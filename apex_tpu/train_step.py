@@ -45,8 +45,8 @@ from apex_tpu.amp.scaler import (
 )
 from apex_tpu.optimizers.functional import FlatState
 
-__all__ = ["TrainState", "init_train_state", "make_train_step",
-           "train_loop", "leaf_offsets"]
+__all__ = ["TrainState", "init_train_state", "init_zero_train_state",
+           "make_train_step", "train_loop", "leaf_offsets"]
 
 
 @flax.struct.dataclass
@@ -61,18 +61,72 @@ class TrainState:
         return self.opt.params()
 
 
-def init_train_state(tx, params, loss_scale=None) -> TrainState:
+def init_train_state(tx, params, loss_scale=None, shard=None) -> TrainState:
     """Build a TrainState from a params pytree.
 
     ``loss_scale``: None (no amp scaling), "dynamic", or a fixed float —
     the same contract as :class:`apex_tpu.amp.scaler.LossScaler`.
+
+    ``shard=(axis_name, dp[, rank])`` builds a ZeRO dp-sharded optimizer
+    state (see :class:`~apex_tpu.optimizers.functional.FlatState`);
+    without an explicit rank this must run inside ``shard_map`` with the
+    axis bound.  Pair with ``make_train_step(..., zero=True)``.
     """
     scaler = None if loss_scale is None else init_loss_scale(loss_scale)
-    return TrainState(opt=tx.init(params), scaler=scaler)
+    return TrainState(opt=tx.init(params, shard=shard), scaler=scaler)
+
+
+def init_zero_train_state(tx, params, axis_name: str, dp: int,
+                          loss_scale=None):
+    """GLOBAL-view ZeRO state + its PartitionSpec tree, for the
+    init-outside / step-inside pattern.
+
+    Returns ``(state, specs)``: ``state`` is a :class:`TrainState` whose
+    dp-shardable buffers are FULL (padded) length, and ``specs`` is a
+    matching pytree of ``PartitionSpec`` — pass the state through
+    ``shard_map(..., in_specs=(specs, ...), out_specs=(specs, ...))``
+    and each rank's inside view is exactly its local ``1/dp`` shard.
+    The state that comes back OUT is again the global view:
+    ``state.params()`` / checkpointing see the reassembled flat master
+    with no extra code."""
+    from jax.sharding import PartitionSpec as P
+
+    # dense init first (it makes the donation-safe copy of the raveled
+    # params), then stamp the shard layout and pad — no throwaway
+    # per-rank slicing, and the padding arithmetic lives in the
+    # FlatState properties
+    state = init_train_state(tx, params, loss_scale=loss_scale)
+    opt = state.opt.replace(shard=(axis_name, int(dp)))
+    padded, n = opt.padded_numel, opt.global_numel
+    if padded != n:
+        master = jnp.concatenate(
+            [opt.master, jnp.zeros((padded - n,), opt.master.dtype)])
+        opt = opt.replace(
+            master=master, slots=tx.init_slots(master, sizes=opt.sizes))
+    state = state.replace(opt=opt)
+
+    def spec_of(leaf):
+        return (P(axis_name)
+                if hasattr(leaf, "ndim") and leaf.ndim == 1
+                and leaf.shape[0] == padded else P())
+
+    specs = jax.tree.map(spec_of, state)
+    return state, specs
+
+
+def _pmean_float_leaves(aux, axis):
+    """pmean the float leaves of an aux pytree over ``axis``; integer/
+    bool leaves pass through (dtype dispatch is static)."""
+    def leaf(a):
+        if jnp.issubdtype(jnp.result_type(a), jnp.inexact):
+            return jax.lax.pmean(a, axis)
+        return a
+    return jax.tree.map(leaf, aux)
 
 
 def make_train_step(loss_fn, tx, *, has_aux: bool = False,
-                    grad_transform: Optional[Callable] = None):
+                    grad_transform: Optional[Callable] = None,
+                    zero: bool = False):
     """Build a pure ``step(state, batch) -> (state, metrics)``.
 
     ``loss_fn(params, batch)`` takes the MATERIALIZED params pytree (the
@@ -82,7 +136,24 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
 
     ``grad_transform(flat_grads)`` runs between backward and unscale —
     the hook for data-parallel ``pmean`` or per-leaf collective fixups
-    (see :func:`leaf_offsets`); it must stay on-device and flat.
+    (see :func:`leaf_offsets`); it must stay on-device and flat.  Under
+    ``zero=True`` it receives the local grad SHARD (already dp-meaned),
+    so per-leaf offset fixups do not apply there.
+
+    ``zero=True`` is the ZeRO-sharded step: the state's optimizer must
+    be dp-sharded (``init_train_state(..., shard=(axis, dp))``) and the
+    step must run inside ``shard_map`` with the axis bound.  The flat
+    master SHARD stays the differentiation variable: the forward
+    consumes ``all_gather(shard.astype(bf16))`` — so autodiff's
+    transpose IS the ``psum_scatter`` of the flat bf16 grads (comm
+    bytes match the old all-reduce: RS(2N) + AG(2N) vs AR(4N) in ring
+    terms) — the fused unscale + overflow flag run on the shard with
+    the flag pmax'd replica-uniform, and the Pallas fused update touches
+    only the local ``1/dp`` of master/slots.  Per-chip optimizer state,
+    update FLOPs, and update HBM traffic all drop dp×; everything still
+    composes into ONE donated XLA program.  The reported loss — and
+    every float leaf of ``aux`` — is ``pmean``'d over the axis (the
+    global-batch metric); integer/bool aux diagnostics stay rank-local.
 
     The result is a valid ``lax.scan`` body; jit it (or the scan around
     it) with ``donate_argnums=(0,)`` — the whole state is donation-safe.
@@ -92,9 +163,24 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
         opt, scaler = state.opt, state.scaler
         scale = (scaler.loss_scale if scaler is not None
                  else jnp.float32(1.0))
+        if zero and not opt.shard:
+            raise ValueError(
+                "make_train_step(zero=True) needs a dp-sharded state: "
+                "init_train_state(tx, params, shard=(axis_name, dp))")
+        axis = opt.shard_axis if zero else None
+        dp = opt.shard_dp if zero else 1
+        n, padded = opt.global_numel, (opt.padded_numel if zero else 0)
 
         def flat_loss(flat):
-            params = opt.unravel(flat.astype(opt.flat_dtype))
+            full = flat.astype(opt.flat_dtype)
+            if zero and dp > 1:
+                # params all-gather in the CONSTRUCTION dtype (bf16
+                # comm for bf16 models); the [:n] unpad's transpose is
+                # a zero-pad of the flat cotangent
+                full = jax.lax.all_gather(full, axis, axis=0, tiled=True)
+                if padded != n:
+                    full = full[:n]
+            params = opt.unravel(full)
             out = loss_fn(params, batch)
             loss, aux = out if has_aux else (out, None)
             # the scaled loss drives the backward; the raw loss is the
@@ -103,17 +189,33 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
 
         (_, (loss, aux)), flat_g = jax.value_and_grad(
             flat_loss, has_aux=True)(opt.master)
+        if zero and dp > 1:
+            # autodiff already psum_scatter'd (all_gather's transpose):
+            # flat_g is my SUM-reduced shard; take the dp mean
+            flat_g = flat_g / dp
         if grad_transform is not None:
             flat_g = grad_transform(flat_g)
         if scaler is not None:
             # fused unscale + overflow detection; found_inf feeds the
-            # update kernel's noop predicate in-program
-            flat_g, scaler = unscale_flat_grads(flat_g, scaler)
+            # update kernel's noop predicate in-program (pmax'd
+            # replica-uniform under ZeRO)
+            flat_g, scaler = unscale_flat_grads(
+                flat_g, scaler,
+                axis_name=axis if zero and dp > 1 else None)
             opt = tx.update(opt, flat_g, noop_flag=scaler.found_inf)
             scaler = update_scale(scaler)
         else:
             opt = tx.update(opt, flat_g)
         new_state = state.replace(opt=opt, scaler=scaler)
+        if zero and dp > 1:
+            loss = jax.lax.pmean(loss, axis)
+            # aux floats get the same global-batch semantics as the
+            # loss next to them (a rank-local metric beside a pmean'd
+            # loss reads as global and silently is not); integer/bool
+            # diagnostics stay rank-local — averaging would corrupt
+            # their dtype/meaning
+            if aux is not None:
+                aux = _pmean_float_leaves(aux, axis)
         return new_state, ((loss, aux) if has_aux else loss)
 
     return step

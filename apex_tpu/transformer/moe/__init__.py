@@ -24,7 +24,8 @@ needed; ep=1 degrades to a single-host MoE with zero collectives.
 from apex_tpu.transformer.moe.router import (TopKRouter,
                                              load_balancing_loss, sinkhorn)
 from apex_tpu.transformer.moe.experts import GroupedMLP
-from apex_tpu.transformer.moe.layer import MoELayer, reduce_moe_grads
+from apex_tpu.transformer.moe.layer import (MoELayer, reduce_moe_grads,
+                                            resolve_dispatch_mode)
 
 __all__ = ["TopKRouter", "GroupedMLP", "MoELayer", "load_balancing_loss",
-           "reduce_moe_grads", "sinkhorn"]
+           "reduce_moe_grads", "resolve_dispatch_mode", "sinkhorn"]

@@ -201,6 +201,41 @@ def compute_dispatch_indices(gates, expert_index, num_experts: int,
         num_experts, capacity), token_slot, token_gate)
 
 
+#: provisional auto-dispatch crossover (``dispatch_mode="auto"``):
+#: gather from this many experts, one-hot below.  Seeded from the two
+#: data points available (documented PROVISIONAL until a clean on-chip
+#: gather crossover lands — the r5 capture's gather timings collapsed
+#: into the tunnel RTT, ``us_gather: 0.0``):
+#:  * the CPU-mesh sweep (E in {4..128}, tokens=256, h=64): gather won
+#:    at EVERY E (1.1-2.3x) — an upper bound on where gather can win,
+#:    since interpret-mode lacks the MXU advantage that makes the dense
+#:    [S,E,C] one-hot einsums cheap at small E on TPU;
+#:  * the r5 on-chip ONE-HOT E-sweep ([8192,1024,4096], top-2): step
+#:    time roughly doubled from E=32 (3567 us) to E=64 (7155 us) — the
+#:    O(S*E*C*h) dispatch/combine volume overtaking the E-independent
+#:    expert GEMM work right around Switch-scale expert counts.
+_AUTO_GATHER_MIN_E = 64
+
+
+def resolve_dispatch_mode(dispatch_mode: str, num_experts: int,
+                          tokens: int, capacity: int,
+                          hidden: int) -> str:
+    """Resolve ``"auto"`` to a concrete dispatch mode from the shape.
+
+    The decision variable is the dense one-hot volume ``S*E*C*h`` (what
+    the GShard formulation einsums through) against the gather path's
+    ``(S + E*C)*h`` row movement; at the capacity formula's
+    ``C ~ f*S*k/E`` the ratio reduces to growing with E, so the policy
+    is an expert-count threshold (``_AUTO_GATHER_MIN_E`` — see its
+    provenance note).  ``tokens``/``capacity``/``hidden`` are accepted
+    so a measured on-chip crossover can refine the policy without
+    changing call sites."""
+    if dispatch_mode != "auto":
+        return dispatch_mode
+    del tokens, capacity, hidden   # reserved for the on-chip refinement
+    return "gather" if num_experts >= _AUTO_GATHER_MIN_E else "onehot"
+
+
 class MoELayer(nn.Module):
     """Sparsely-activated FFN (Megatron-core: ``MoELayer``).
 
@@ -254,8 +289,12 @@ class MoELayer(nn.Module):
     # O(S*E*C*h) MACs — best at small E).  "gather": index-based
     # dispatch (same routing, same drops) moving only O(E*C*h) rows —
     # wins at Switch-scale E; measured crossover in PERF.md /
-    # moe_dispatch_sweep.
-    dispatch_mode: str = "onehot"             # | "gather"
+    # moe_dispatch_sweep.  "auto" (the default) picks from the shape
+    # via :func:`resolve_dispatch_mode` — a PROVISIONAL expert-count
+    # threshold until the on-chip crossover lands (see
+    # ``_AUTO_GATHER_MIN_E``); both modes share one slot-assignment
+    # rule, so the choice changes data movement only, not routing.
+    dispatch_mode: str = "auto"               # | "onehot" | "gather"
 
     def _expert_init(self, init: Callable) -> Callable:
         """Fold the expert-axis and tensor-axis ranks into the init key
@@ -285,10 +324,10 @@ class MoELayer(nn.Module):
         if self.ffn_hidden_size % tp:
             raise ValueError(f"ffn_hidden_size ({self.ffn_hidden_size}) "
                              f"not divisible by tensor_parallel_size ({tp})")
-        if self.dispatch_mode not in ("onehot", "gather"):
+        if self.dispatch_mode not in ("auto", "onehot", "gather"):
             raise ValueError(
-                f"dispatch_mode must be 'onehot' or 'gather', got "
-                f"{self.dispatch_mode!r}")
+                f"dispatch_mode must be 'auto', 'onehot' or 'gather', "
+                f"got {self.dispatch_mode!r}")
         if self.sequence_parallel:
             # gather the sequence shards so all TP ranks route the same
             # tokens.  tensor_parallel_output_grad=False: by the time
@@ -313,7 +352,8 @@ class MoELayer(nn.Module):
             load_balancing_type=self.load_balancing_type, name="router")(
                 tokens, deterministic=deterministic)
         dt = tokens.dtype
-        gather = self.dispatch_mode == "gather"
+        gather = resolve_dispatch_mode(
+            self.dispatch_mode, self.num_experts, s, cap, h) == "gather"
         if gather:
             slot_token, token_slot, token_gate = compute_dispatch_indices(
                 gates, expert_index, self.num_experts, cap)

@@ -180,18 +180,35 @@ def _timed(run, iters: int, rtt: float) -> Timing:
     return Timing(best, median)
 
 
-def _bench_loop(step_fn, state, batch, iters: int, rtt: float) -> Timing:
+def _bench_loop(step_fn, state, batch, iters: int, rtt: float,
+                shard=None) -> Timing:
     """Seconds per step: `iters` steps in one program, optimizer state
     carried through the scan (prevents dead-code elimination and matches
-    real training); syncs via device_get; RTT subtracted."""
+    real training); syncs via device_get; RTT subtracted.
 
-    @jax.jit
-    def loop(state, batch):
+    ``shard=(mesh, state_specs, batch_specs)`` runs the scan inside
+    ``shard_map`` (the ZeRO legs): the carried state crosses the
+    boundary under ``state_specs`` so each rank scans over its local
+    shard; the tiny anti-DCE reduction stays OUTSIDE the mapped region
+    (it reads the global view)."""
+
+    def scan_steps(state, batch):
         def body(state, _):
             return step_fn(state, batch), None
         state, _ = jax.lax.scan(body, state, None, length=iters)
+        return state
+
+    inner = scan_steps
+    if shard is not None:
+        mesh, state_specs, batch_specs = shard
+        inner = functools.partial(jax.shard_map, check_vma=False)(
+            scan_steps, mesh=mesh, in_specs=(state_specs, batch_specs),
+            out_specs=state_specs)
+
+    @jax.jit
+    def loop(state, batch):
         return jax.tree.map(lambda x: jnp.sum(x[:1]) if x.ndim else x,
-                            state)
+                            inner(state, batch))
 
     _retry(lambda: jax.device_get(loop(state, batch)),
            tag="compile")                       # compile + warm
@@ -301,7 +318,8 @@ def _microbench_layernorm(rtt: float, on_tpu: bool):
 
 def _microbench_attention(rtt: float, on_tpu: bool):
     """Flash attention fwd+bwd vs materialized-softmax oracle."""
-    from apex_tpu.ops.attention import flash_attention, mha_reference
+    from apex_tpu.ops.attention import (flash_attention, mha_reference,
+                                        xla_path_max_seq)
 
     b, h, s, d = ((_ov("batch", 4), 16, _ov("seq", 2048), 64) if on_tpu
                   else (1, 2, 128, 32))
@@ -332,7 +350,11 @@ def _microbench_attention(rtt: float, on_tpu: bool):
     out = {"flash_attn_us": round(t_flash.best * 1e6, 1),
            "flash_attn_us_median": round(t_flash.median * 1e6, 1),
            "flash_attn_speedup": round(t_ref.best / t_flash.best, 3),
-           "flash_attn_shape": [b, h, s, d]}
+           "flash_attn_shape": [b, h, s, d],
+           # the effective kernel/XLA auto-dispatch crossover (env
+           # APEX_TPU_ATTN_XLA_MAX_SEQ-tunable, VERDICT weak #8): every
+           # capture records which boundary it measured under
+           "attn_xla_max_seq": xla_path_max_seq()}
     if bq or bk:
         out["flash_attn_blocks"] = [bq, bk]
     return out
@@ -374,6 +396,34 @@ def _bench_setup(force_cpu: bool):
     on_tpu = jax.default_backend() in ("tpu", "axon")
     rtt = _retry(_rtt, tag="rtt") if on_tpu else 0.0
     return on_tpu, rtt
+
+
+def _zero_train_setup(loss_fn, tx, params, batch_specs):
+    """Shared ``--override zero=1`` machinery for the main/bert/llama
+    legs: a ZeRO dp-sharded train step over a ``data`` mesh of the
+    local devices (``--override zero_dp=N`` narrows it; the single-chip
+    default dp=1 measures the zero program shape — gather/scatter
+    become no-ops — so multi-chip tunnel sessions can flip dp without
+    a code edit).
+
+    Returns ``(state, step_fn, shard, dp)`` with ``shard`` shaped for
+    :func:`_bench_loop` and ``dp`` for the capture extras.  The batch
+    stays REPLICATED (``batch_specs`` of P()): per-chip compute matches
+    the non-zero leg, so the delta is exactly the collective +
+    sharded-update cost."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import train_step as ts
+
+    devs = jax.devices()
+    dp = int(_ov("zero_dp", len(devs)))
+    dp = max(1, min(dp, len(devs)))
+    mesh = Mesh(np.array(devs[:dp]), ("data",))
+    state, specs = ts.init_zero_train_state(tx, params, "data", dp)
+    step = ts.make_train_step(loss_fn, tx, zero=True)
+    # TrainState without a scaler: specs tree matches (scaler=None)
+    return state, step, (mesh, specs, batch_specs), dp
 
 
 def _microbench_moe(rtt: float, on_tpu: bool):
@@ -536,19 +586,35 @@ def _microbench_bert(rtt: float, on_tpu: bool):
             return tx.update(st, g)
 
         state = tx.init(params)
-    t = _bench_loop(step, state, (tokens, types, labels), iters, rtt)
+    zero_shard = zero_dp = None
+    if _ov("zero", 0):
+        from jax.sharding import PartitionSpec as P
+
+        def tree_loss(tree, batch_args):
+            loss, _ = model.apply(tree, batch_args[0], batch_args[1],
+                                  lm_labels=batch_args[2])
+            return loss
+
+        state, zstep, zero_shard, zero_dp = _zero_train_setup(
+            tree_loss, tx, params, (P(), P(), P()))
+        step = lambda s, b: zstep(s, b)[0]              # noqa: E731
+    t = _bench_loop(step, state, (tokens, types, labels), iters, rtt,
+                    shard=zero_shard)
     value = batch * seq / t.best
     peak_tflops, _ = _chip_spec()
     # bidirectional attention: full 12*L*s*h (no causal halving)
     flops_per_token = (6 * n_params
                        + 12 * cfg.num_layers * seq * cfg.hidden_size)
     mfu = value * flops_per_token / (peak_tflops * 1e12)
-    return {"bert_tokens_per_s": round(value, 1),
-            "bert_mfu": round(mfu, 4),
-            "bert_sec_per_step": round(t.best, 5),
-            "bert_sec_per_step_median": round(t.median, 5),
-            "bert_n_params": n_params,
-            "bert_shape": [batch, seq, cfg.num_layers, cfg.hidden_size]}
+    out = {"bert_tokens_per_s": round(value, 1),
+           "bert_mfu": round(mfu, 4),
+           "bert_sec_per_step": round(t.best, 5),
+           "bert_sec_per_step_median": round(t.median, 5),
+           "bert_n_params": n_params,
+           "bert_shape": [batch, seq, cfg.num_layers, cfg.hidden_size]}
+    if zero_dp is not None:
+        out["bert_zero_dp"] = zero_dp
+    return out
 
 
 def _microbench_llama(rtt: float, on_tpu: bool):
@@ -600,18 +666,30 @@ def _microbench_llama(rtt: float, on_tpu: bool):
         return tx.update(st, g)
 
     state = tx.init(params)
-    t = _bench_loop(step, state, (tokens, labels), iters, rtt)
+    zero_shard = zero_dp = None
+    if _ov("zero", 0):
+        from jax.sharding import PartitionSpec as P
+
+        state, zstep, zero_shard, zero_dp = _zero_train_setup(
+            lambda tree, b: model.apply(tree, b[0], b[1]), tx, params,
+            (P(), P()))
+        step = lambda s, b: zstep(s, b)[0]              # noqa: E731
+    t = _bench_loop(step, state, (tokens, labels), iters, rtt,
+                    shard=zero_shard)
     value = batch * seq / t.best
     peak_tflops, _ = _chip_spec()
     flops_per_token = (6 * n_params
                        + 6 * cfg.num_layers * seq * cfg.hidden_size)
     mfu = value * flops_per_token / (peak_tflops * 1e12)
-    return {"llama_tokens_per_s": round(value, 1),
-            "llama_mfu": round(mfu, 4),
-            "llama_sec_per_step": round(t.best, 5),
-            "llama_n_params": n_params,
-            "llama_shape": [batch, seq, cfg.num_layers, cfg.hidden_size,
-                            cfg.kv_heads]}
+    out = {"llama_tokens_per_s": round(value, 1),
+           "llama_mfu": round(mfu, 4),
+           "llama_sec_per_step": round(t.best, 5),
+           "llama_n_params": n_params,
+           "llama_shape": [batch, seq, cfg.num_layers, cfg.hidden_size,
+                           cfg.kv_heads]}
+    if zero_dp is not None:
+        out["llama_zero_dp"] = zero_dp
+    return out
 
 
 MICRO_LEGS = {
@@ -729,8 +807,23 @@ def _bench_main(force_cpu: bool = False) -> None:
                    if _ov("split_state", 0) else tx.init(flat_params))
     batch_args = (tokens, labels)
 
+    zero_shard = zero_dp = None
+    if _ov("zero", 0):
+        # ZeRO leg (--override zero=1): dp-sharded optimizer state,
+        # reduce-scatter'd grads, all-gather'd params — same model,
+        # same per-chip batch (takes precedence over split_state)
+        from jax.sharding import PartitionSpec as P
+
+        def tree_loss(tree, batch):
+            return model.apply(tree, batch[0], batch[1])
+
+        fused_state, zstep, zero_shard, zero_dp = _zero_train_setup(
+            tree_loss, tx, params, (P(), P()))
+        fused_step = lambda s, b: zstep(s, b)[0]        # noqa: E731
+
     # Fused leg is THE metric: hard-fail (after retries) if it can't run.
-    t_fused = _bench_loop(fused_step, fused_state, batch_args, iters, rtt)
+    t_fused = _bench_loop(fused_step, fused_state, batch_args, iters, rtt,
+                          shard=zero_shard)
     # Baseline + microbench legs are auxiliary: degrade to null.
     t_naive = _aux(
         lambda: _bench_loop(naive_step, state, batch_args, iters, rtt),
@@ -754,6 +847,8 @@ def _bench_main(force_cpu: bool = False) -> None:
         "chip": jax.devices()[0].device_kind,
         "backend": "tpu" if on_tpu else "cpu",
     }
+    if zero_dp is not None:
+        extras["zero_dp"] = zero_dp
     if _OVERRIDES:
         extras["overrides"] = dict(_OVERRIDES)   # capture self-describes
     print(json.dumps({

@@ -54,6 +54,15 @@ def parse_args(argv=None):
                         "toy default stays 0 so the smoke run converges "
                         "in tens of steps")
     p.add_argument("--loss-scale", type=str, default="dynamic")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO dp-sharded optimizer state over a 'data' "
+                        "mesh: the fp32 LAMB master + moments shard "
+                        "1/dp per device, grads reduce-scatter, params "
+                        "all-gather — same numerics as the dense run "
+                        "(the dryrun 'zero' leg asserts it)")
+    p.add_argument("--dp", type=int, default=None,
+                   help="data-parallel width for --zero (default: all "
+                        "local devices)")
     p.add_argument("--platform", type=str, default=None,
                    help="force a jax platform (e.g. cpu); the axon TPU "
                         "plugin ignores JAX_PLATFORMS, so this calls "
@@ -123,10 +132,25 @@ def main(argv=None):
     # detection, and the noop-predicated update all run in-program
     tx = functional.fused_lamb(lr=args.lr, weight_decay=0.01,
                                max_grad_norm=1.0)
-    state = train_step.init_train_state(
-        tx, params, loss_scale=(args.loss_scale
-                                if args.loss_scale == "dynamic"
-                                else float(args.loss_scale)))
+    loss_scale = (args.loss_scale if args.loss_scale == "dynamic"
+                  else float(args.loss_scale))
+    dp = args.dp or len(jax.devices())
+    if args.zero:
+        if dp > len(jax.devices()):
+            # a short mesh would psum_scatter over fewer ranks than the
+            # /dp mean assumes — silently wrong gradients, so refuse
+            raise SystemExit(f"--zero: --dp {dp} exceeds the "
+                             f"{len(jax.devices())} available devices")
+        if args.batch_size % dp:
+            raise SystemExit(f"--zero: batch size {args.batch_size} "
+                             f"must divide over dp={dp}")
+        # GLOBAL-view sharded state built outside; shard_map slices each
+        # rank's 1/dp window via the returned spec tree
+        state, state_specs = train_step.init_zero_train_state(
+            tx, params, "data", dp, loss_scale=loss_scale)
+    else:
+        state = train_step.init_train_state(tx, params,
+                                            loss_scale=loss_scale)
 
     heldout = synthetic_mlm_batch(rng, args)   # never trained on
     # all batches staged on-device up front: the whole run is one jitted
@@ -142,7 +166,28 @@ def main(argv=None):
         batches["key"] = jax.vmap(
             lambda i: jax.random.fold_in(dropout_root, i))(
                 jnp.arange(args.iters))
-    run = train_step.train_loop(loss_fn, tx)
+    if args.zero:
+        # ZeRO run: the scan body is the zero step (psum_scatter'd bf16
+        # grads -> local fused LAMB on the master shard -> all-gather'd
+        # bf16 params into the next forward), the whole run still ONE
+        # donated executable; the batch shards over the mesh's data
+        # axis, so this IS data-parallel training, with optimizer state
+        # 1/dp per device
+        import functools
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+        zstep = train_step.make_train_step(loss_fn, tx, zero=True)
+        batch_specs = {"tokens": P(None, "data"),
+                       "labels": P(None, "data")}
+        if train_mode:
+            batch_specs["key"] = P()
+        run = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+            lambda st, bs: jax.lax.scan(zstep, st, bs), mesh=mesh,
+            in_specs=(state_specs, batch_specs),
+            out_specs=(state_specs, P())), donate_argnums=(0,))
+    else:
+        run = train_step.train_loop(loss_fn, tx)
     state, losses = run(state, batches)
     losses = [float(l) for l in np.asarray(losses)]
     for it in range(0, args.iters, 5):

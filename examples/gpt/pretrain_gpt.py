@@ -74,6 +74,12 @@ def parse_args(argv=None):
                         "attention part runs IN-KERNEL on the softmax "
                         "probabilities). Toy default 0 so the smoke run "
                         "converges fast")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO over the data axis: the flat fused-Adam "
+                        "master/moments shard 1/dp per rank; the dp "
+                        "grad all-reduce becomes reduce-scatter and "
+                        "the per-step params materialize via "
+                        "all-gather (numerics match the dense run)")
     p.add_argument("--platform", type=str, default=None,
                    help="force a jax platform (e.g. cpu)")
     return p.parse_args(argv)
@@ -195,8 +201,10 @@ def main(argv=None):
             params = chunk_params(0)
         # flat-native functional Adam: ONE ravel at init; the scan
         # carries the FlatState, params rematerialize per step as
-        # unravel slices that fuse into the forward
-        opt0 = tx.init(params)
+        # unravel slices that fuse into the forward.  Under --zero the
+        # state is the local 1/dp shard and st.params() all-gathers.
+        opt0 = tx.init(params,
+                       shard=("data", dp) if args.zero else None)
 
         def one_step(carry, xs):
             st = carry
@@ -217,6 +225,12 @@ def main(argv=None):
             else:
                 g_embed = embedding_grads_all_reduce(g_embed)
             grads["embed"] = g_embed
+            if args.zero:
+                # ZeRO-2: the dp all-reduce becomes ONE reduce-scatter
+                # into my master shard's window (+ the dp mean)
+                flat_g, _ = tree_ravel(grads)
+                return tx.update(
+                    st, functional.shard_flat_grads(flat_g, st)), loss
             if dp > 1:
                 grads = flat_allreduce(grads, axis_name="data")
                 grads = jax.tree.map(lambda g: g / dp, grads)

@@ -55,6 +55,11 @@ def parse_args(argv=None):
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO: shard the fused-Adam master/moments 1/dp "
+                        "over the data axis (reduce-scatter grads, "
+                        "all-gather params; numerics match the dense "
+                        "run)")
     p.add_argument("--platform", type=str, default=None)
     return p.parse_args(argv)
 
@@ -71,6 +76,12 @@ def main(argv=None):
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     parallel_state.destroy_model_parallel()
+    if args.tp * args.dp > len(jax.devices()):
+        # a short mesh would shrink the data axis under the ZeRO step's
+        # /dp mean (and the TP shards) — refuse rather than train wrong
+        raise SystemExit(
+            f"tp={args.tp} x dp={args.dp} needs {args.tp * args.dp} "
+            f"devices, have {len(jax.devices())}")
     # dp is inferred as n_devices // tp — restrict the mesh to tp*dp
     parallel_state.initialize_model_parallel(
         tensor_model_parallel_size_=args.tp,
@@ -90,6 +101,13 @@ def main(argv=None):
     # (same contract as ``standalone_llama.reduce_llama_grads``, applied
     # here to flat-grad slices so the step stays re-ravel-free)
     need_kv_psum = args.tp > 1 and cfg.kv_heads % args.tp != 0
+    if args.zero and need_kv_psum:
+        # the kv fixup indexes FULL-grad offsets; under ZeRO the grads
+        # arrive pre-scattered as shards, so those offsets don't apply
+        raise SystemExit(
+            "--zero requires kv_heads % tp == 0 (the replicated-kv "
+            "psum fixup operates on full-grad offsets, which do not "
+            "exist in the reduce-scattered shard)")
 
     def train(stream):
         """One rank's whole run: init, then a scan over the iteration
@@ -100,6 +118,19 @@ def main(argv=None):
         produces flat grads, no per-step grad re-ravel exists."""
         params = model.init(jax.random.PRNGKey(args.seed + 1),
                             stream[0, 0])
+        if args.zero:
+            # ZeRO: the fp32 master SHARD is the differentiation
+            # variable — the zero step all-gathers params into the
+            # forward and autodiff's transpose reduce-scatters the flat
+            # grads; per-rank optimizer state is 1/dp of the dense run
+            zstep = train_step.make_train_step(
+                lambda tree, tokens: model.apply(
+                    tree, tokens[0], jnp.roll(tokens[0], -1, axis=1)),
+                tx, zero=True)
+            st0 = train_step.init_train_state(
+                tx, params, shard=(parallel_state.DATA_AXIS, args.dp))
+            _, losses = jax.lax.scan(zstep, st0, stream)
+            return losses
         st0 = tx.init(params)
         kv_slices = [(off, size) for key, (off, size, _)
                      in train_step.leaf_offsets(params).items()

@@ -373,3 +373,34 @@ def test_xla_kernel_rect_causal_parity(sq, sk):
     both directions, through the backward — the one branch the random
     sweep's seeds never draw."""
     _xla_kernel_parity_case(1, 2, sq, sk, 64, seed=50, causal=True)
+
+
+def test_xla_max_seq_override_env_and_kwarg(monkeypatch):
+    """The kernel/XLA auto-dispatch crossover is tunable without a code
+    edit: APEX_TPU_ATTN_XLA_MAX_SEQ env var, overridden in turn by the
+    per-call kwarg (VERDICT weak #8 — the 256 default is interpolated,
+    not densely measured)."""
+    from apex_tpu.ops.attention import (_XLA_PATH_MAX_SEQ,
+                                        xla_path_max_seq)
+
+    monkeypatch.delenv("APEX_TPU_ATTN_XLA_MAX_SEQ", raising=False)
+    assert xla_path_max_seq() == _XLA_PATH_MAX_SEQ
+    monkeypatch.setenv("APEX_TPU_ATTN_XLA_MAX_SEQ", "512")
+    assert xla_path_max_seq() == 512
+    assert xla_path_max_seq(1024) == 1024      # kwarg beats env
+    assert xla_path_max_seq(0) == 0            # 0 disables the XLA path
+    monkeypatch.setenv("APEX_TPU_ATTN_XLA_MAX_SEQ", "not-an-int")
+    with pytest.raises(ValueError, match="APEX_TPU_ATTN_XLA_MAX_SEQ"):
+        xla_path_max_seq()
+
+
+def test_flash_attention_accepts_xla_max_seq_kwarg():
+    """The kwarg threads through flash_attention and does not change
+    values (on CPU the kernel path is taken either way; the dispatch
+    decision itself is pinned by test_xla_max_seq_override_env_and_kwarg)."""
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 128, 32),
+                          jnp.bfloat16)
+    base = flash_attention(q, q, q, causal=True)
+    via_kwarg = flash_attention(q, q, q, causal=True, xla_max_seq=0)
+    np.testing.assert_array_equal(np.asarray(base, np.float32),
+                                  np.asarray(via_kwarg, np.float32))

@@ -161,8 +161,11 @@ def test_dist_adam_preserves_bf16_dtypes():
 
 def test_dist_lamb_large_dp_fallback_matches_switch(monkeypatch):
     """The bounded-compile global-buffer path (dp > _SWITCH_MAX_DP) must
-    produce the same params as the lax.switch static-span path."""
-    import apex_tpu.contrib.optimizers as co
+    produce the same params as the lax.switch static-span path.  The
+    span machinery lives in ``optimizers.base`` since the ZeRO rewire
+    (the contrib classes are shells over the sharded functional core),
+    so the threshold is patched there."""
+    import apex_tpu.optimizers.base as co
 
     params = _params(jax.random.PRNGKey(9))
     nflat = 37 * 13 + 13

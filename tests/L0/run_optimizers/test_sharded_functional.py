@@ -1,0 +1,230 @@
+"""ZeRO dp-sharding of the functional optimizer core (ISSUE 3).
+
+Properties pinned here:
+
+1. **Shard layout.** ``tx.init(params, shard=(axis, dp, rank))``
+   materializes exactly ``ceil(P_padded / dp)`` elements per rank for
+   the master and every master-sized slot, for every rank, and the
+   concatenation of all ranks' shards reassembles the padded master.
+2. **Dense equivalence.** For ALL FIVE rules (Adam, LAMB, SGD,
+   NovoGrad, Adagrad) two sharded updates on a CPU mesh match the dense
+   update bitwise-close — including LAMB's per-tensor trust ratios and
+   NovoGrad's per-tensor moments, whose leaf spans straddle shard
+   boundaries (the lax.switch static-span machinery in
+   ``optimizers.base``).
+3. **shard_flat_grads.** pad + psum_scatter + dp-mean equals slicing
+   the mean of the per-rank full grads.
+4. **Shard-aware checkpointing.** The contrib shells' ``state_dict``
+   reassembles the full flat master from the global view, and
+   ``load_state_dict`` + ``shard_state`` restore it at a DIFFERENT dp
+   with identical continuation.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.optimizers import functional as fopt
+from apex_tpu.utils import cdiv
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    # total 409 (odd): every dp in {2, 4} pads, and the leaf spans
+    # straddle shard boundaries
+    return {"w0": jnp.asarray(rng.randn(13, 15) * 0.3, jnp.float32),
+            "b0": jnp.asarray(rng.randn(15) * 0.01, jnp.float32),
+            "w1": jnp.asarray(rng.randn(15, 11) * 0.3, jnp.float32),
+            "b1": jnp.asarray(rng.randn(11) * 0.01, jnp.float32),
+            "head": jnp.asarray(rng.randn(3), jnp.float32)}
+
+
+def _numel(tree):
+    return sum(int(x.size) for x in jax.tree.leaves(tree))
+
+
+ALL_TX = [
+    ("adam", lambda: fopt.fused_adam(lr=1e-2, weight_decay=0.01)),
+    ("lamb", lambda: fopt.fused_lamb(lr=1e-2, weight_decay=0.01,
+                                     max_grad_norm=1.0)),
+    ("sgd", lambda: fopt.fused_sgd(lr=1e-2, momentum=0.9)),
+    ("novograd", lambda: fopt.fused_novograd(lr=1e-2)),
+    ("adagrad", lambda: fopt.fused_adagrad(lr=1e-2)),
+]
+
+
+@pytest.mark.parametrize("dp", [2, 4])
+def test_shard_lengths_exact(dp):
+    params = _params()
+    n = _numel(params)
+    padded = cdiv(n, dp) * dp
+    shard_len = cdiv(padded, dp)
+    tx = fopt.fused_adam(lr=1e-3)
+    shards = []
+    for rank in range(dp):
+        st = tx.init(params, shard=("data", dp, rank))
+        assert st.master.shape == (shard_len,), (rank, st.master.shape)
+        for k, slot in st.slots.items():
+            assert slot.shape == (shard_len,), (rank, k, slot.shape)
+        assert st.shard == ("data", dp)
+        assert st.shard_len == shard_len
+        assert st.global_numel == n and st.padded_numel == padded
+        shards.append(np.asarray(st.master))
+    # concatenated shards == padded full master (zeros in the tail)
+    full = np.concatenate(shards)
+    flat, _ = jax.flatten_util.ravel_pytree(params)
+    np.testing.assert_array_equal(full[:n], np.asarray(flat))
+    np.testing.assert_array_equal(full[n:], 0.0)
+
+
+@pytest.mark.parametrize("txname,mk", ALL_TX)
+def test_sharded_update_matches_dense(txname, mk):
+    dp = 4
+    tx = mk()
+    params = _params()
+    n = _numel(params)
+    padded = cdiv(n, dp) * dp
+    g = jnp.asarray(np.random.RandomState(7).randn(n), jnp.float32) * 0.1
+
+    st = tx.init(params)
+    st = tx.update(st, g)
+    st = tx.update(st, g * 0.5, noop_flag=0.0, grad_scale=2.0)
+    dense = np.asarray(st.master)
+
+    mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+    gpad = jnp.concatenate([g, jnp.zeros((padded - n,), g.dtype)])
+
+    def body(gfull):
+        st = tx.init(params, shard=("data", dp))
+        rank = jax.lax.axis_index("data")
+        gsh = jax.lax.dynamic_slice_in_dim(
+            gfull, rank * (padded // dp), padded // dp)
+        st = tx.update(st, gsh)
+        st = tx.update(st, gsh * 0.5, noop_flag=0.0, grad_scale=2.0)
+        return st.master
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P(),), out_specs=P("data")))(gpad)
+    np.testing.assert_allclose(np.asarray(out)[:n], dense,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_sharded_noop_skip_freezes_shard():
+    dp = 2
+    tx = fopt.fused_lamb(lr=1e-2)
+    params = _params()
+    n = _numel(params)
+    padded = cdiv(n, dp) * dp
+    mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+
+    def body():
+        st = tx.init(params, shard=("data", dp))
+        before = st.master
+        st = tx.update(st, jnp.ones((padded // dp,), jnp.float32),
+                       noop_flag=1.0)
+        return before, st.master, st.slots["exp_avg"]
+
+    before, after, m = jax.jit(
+        functools.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh, in_specs=(),
+            out_specs=(P("data"), P("data"), P("data"))))()
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    np.testing.assert_array_equal(np.asarray(m), 0.0)
+
+
+def test_shard_flat_grads_reduce_scatter_mean():
+    dp = 4
+    tx = fopt.fused_adam(lr=1e-3)
+    params = _params()
+    n = _numel(params)
+    padded = cdiv(n, dp) * dp
+    per_rank = jnp.asarray(
+        np.random.RandomState(9).randn(dp, n), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+
+    def body(granks):
+        st = tx.init(params, shard=("data", dp))
+        return fopt.shard_flat_grads(granks[0], st)
+
+    out = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(P("data"),), out_specs=P("data")))(
+        per_rank)
+    want = np.zeros(padded, np.float32)
+    want[:n] = np.asarray(per_rank).mean(axis=0)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6,
+                               atol=1e-7)
+
+
+def test_contrib_state_dict_reassembles_and_reshards():
+    """Checkpoint at dp=4, restore at dp=2: the continuation matches the
+    uninterrupted dense FusedAdam trajectory."""
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.optimizers import FusedAdam
+
+    params = _params(3)
+    n = _numel(params)
+    g = jax.tree.map(lambda x: jnp.full_like(x, 0.02), params)
+
+    def run_steps(opt, dp, state_in, n_steps):
+        mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+
+        def body(state):
+            # the P("data") in_specs already sliced my local shard out
+            # of the padded global view load_state_dict rebuilt
+            if state is None:
+                state = opt.init_state(params)
+            for _ in range(n_steps):
+                p, state = opt.step(state, g)
+            return p, state
+
+        specs = {"step": P(), "master": P("data"), "exp_avg": P("data"),
+                 "exp_avg_sq": P("data")}
+        if state_in is not None:
+            return jax.jit(functools.partial(
+                jax.shard_map, check_vma=False)(
+                body, mesh=mesh, in_specs=(specs,), out_specs=(P(), specs)
+            ))(state_in)
+        return jax.jit(functools.partial(
+            jax.shard_map, check_vma=False)(
+            lambda: body(None), mesh=mesh, in_specs=(),
+            out_specs=(P(), specs)))()
+
+    opt4 = DistributedFusedAdam(4, lr=1e-2, weight_decay=0.01)
+    _, state4 = run_steps(opt4, 4, None, 2)
+    sd = opt4.state_dict(state4)
+    assert sd["master"].shape == (n,)        # unpadded full reassembly
+
+    opt2 = DistributedFusedAdam(2, lr=1e-2, weight_decay=0.01)
+    opt2._record_layout(params)
+    full2 = opt2.load_state_dict(sd)
+    p_final, _ = run_steps(opt2, 2, full2, 1)
+
+    ref = FusedAdam(params, lr=1e-2, weight_decay=0.01)
+    for _ in range(3):
+        ref_p = ref.step(g)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-6),
+        p_final, ref_p)
+
+
+def test_state_dict_rejects_single_shard():
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    params = _params(4)
+    opt = DistributedFusedAdam(4, lr=1e-3)
+    # before the optimizer has seen the layout: the crafted error, not
+    # a TypeError from int(None)
+    with pytest.raises(ValueError, match="before init_state"):
+        opt.state_dict({"step": jnp.zeros((), jnp.int32)})
+    opt._record_layout(params)
+    shard_len = cdiv(cdiv(_numel(params), 4) * 4, 4)
+    bogus = {"step": jnp.zeros((), jnp.int32),
+             "master": jnp.zeros((shard_len,)),
+             "exp_avg": jnp.zeros((shard_len,)),
+             "exp_avg_sq": jnp.zeros((shard_len,))}
+    with pytest.raises(ValueError, match="GLOBAL view"):
+        opt.state_dict(bogus)

@@ -859,3 +859,47 @@ def test_aux_losses_uniform_routing():
     chosen = jnp.zeros((32, E)).at[:, :K].set(1.0)
     assert abs(float(load_balancing_loss(probs, chosen)) - 1.0) < 1e-5
     assert float(router_z_loss(jnp.zeros((32, E)))) >= 0.0
+
+
+def test_dispatch_mode_auto_policy():
+    """``dispatch_mode="auto"`` resolves from the shape: one-hot below
+    the provisional Switch-scale threshold, gather at/above it;
+    explicit modes pass through untouched (the policy is documented
+    provisional until the on-chip crossover lands)."""
+    from apex_tpu.transformer.moe import resolve_dispatch_mode
+    from apex_tpu.transformer.moe.layer import _AUTO_GATHER_MIN_E
+
+    assert resolve_dispatch_mode("auto", 8, 256, 64, 64) == "onehot"
+    assert resolve_dispatch_mode(
+        "auto", _AUTO_GATHER_MIN_E, 256, 64, 64) == "gather"
+    assert resolve_dispatch_mode(
+        "auto", 4 * _AUTO_GATHER_MIN_E, 256, 64, 64) == "gather"
+    # explicit modes are never second-guessed by the policy
+    assert resolve_dispatch_mode("onehot", 512, 256, 64, 64) == "onehot"
+    assert resolve_dispatch_mode("gather", 2, 256, 64, 64) == "gather"
+
+
+def test_dispatch_mode_auto_matches_explicit():
+    """An auto layer's forward equals the explicitly-selected mode's,
+    on both sides of the threshold (same routing, same drops)."""
+    from apex_tpu.transformer.moe.layer import _AUTO_GATHER_MIN_E
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 16))
+    for e, expect in ((4, "onehot"), (_AUTO_GATHER_MIN_E, "gather")):
+        kw = dict(num_experts=e, hidden_size=16, ffn_hidden_size=32,
+                  top_k=2)
+        auto = MoELayer(dispatch_mode="auto", **kw)
+        explicit = MoELayer(dispatch_mode=expect, **kw)
+        p = auto.init(jax.random.PRNGKey(1), x)
+        y_auto, _ = auto.apply(p, x)
+        y_exp, _ = explicit.apply(p, x)
+        np.testing.assert_array_equal(np.asarray(y_auto),
+                                      np.asarray(y_exp))
+
+
+def test_dispatch_mode_invalid_rejected():
+    x = jnp.zeros((8, 16))
+    layer = MoELayer(num_experts=4, hidden_size=16, ffn_hidden_size=32,
+                     dispatch_mode="bogus")
+    with pytest.raises(ValueError, match="dispatch_mode"):
+        layer.init(jax.random.PRNGKey(0), x)

@@ -1,0 +1,27 @@
+"""Driver-visible ZeRO dryrun leg (slow lane): the same subprocess
+invocation the driver's ``dryrun_multichip`` makes must print an OK
+line for every (optimizer, dp) combination — dp ∈ {2, 4} × {FusedAdam,
+FusedLAMB} — each of which asserts loss/grads/post-step params against
+the dense replay and the bitwise overflow-skip internally.
+
+Subprocess for the same reason as test_config5_topology: the dryrun
+re-initializes the CPU backend's device count.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def test_zero_leg_all_combos_green():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"),
+         "8", "2", "2", "zero"],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    for tx in ("adam", "lamb"):
+        for dp in (2, 4):
+            assert f"ZeRO {tx} dp={dp}" in out, out
+    assert out.count(" OK") >= 4, out

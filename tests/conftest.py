@@ -58,6 +58,12 @@ SLOW = {
     "tests/L1/test_main_amp.py::test_static_loss_scale_runs",
     "tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py::test_1f1b_stage_fn_sees_correct_microbatch",
     "tests/distributed/test_ddp_race_condition.py::test_matches_full_batch_single_device",
+    # two-OS-process jax.distributed smoke (ISSUE 3 satellite): spawns
+    # subprocesses, each paying a cold jax import (~10 s)
+    "tests/distributed/test_multiprocess_cpu.py::test_two_process_distributed_init_and_kv_exchange",
+    # full ZeRO dryrun leg in a subprocess (4 combos x jit, ~60 s); the
+    # fast lane covers the same path via tests/L1/test_zero_train_step.py
+    "tests/L1/test_zero_dryrun_leg.py::test_zero_leg_all_combos_green",
     "tests/L0/run_attention/test_attention_dropout.py::test_block_independent_and_large_bh",
     "tests/L0/run_contrib/test_parity_shims.py::TestFMHA::test_p_dropout_wired_and_needs_seed",
     "tests/L0/run_attention/test_attention_dropout.py::test_forward_matches_masked_oracle",
