@@ -165,6 +165,11 @@ def init(enabled: bool = True, verbose: bool = False,
         _apply_lists(handle, torch.Tensor, tensor_overrides)
         _apply_lists(handle, F, functional_overrides)
 
+        # RNN family: nn.{RNN,GRU,LSTM}/*Cell dispatch through _VF, not
+        # the public namespaces above (reference: new_rnn_cast)
+        from apex_tpu.amp import rnn_compat
+        rnn_compat.whitelist_rnn_cells(handle, verbose)
+
         for module, name, category in _USER_REGISTRY:
             if isinstance(module, str):
                 module = importlib.import_module(module)

@@ -8,10 +8,34 @@ Three categories per namespace, mirroring the reference's registry:
 * ``FP32_FUNCS`` — numerically sensitive ops (transcendentals, softmax,
   norms, losses, big reductions) always run fp32.
 * ``CASTS`` — multi-arg ops promoted to the widest floating dtype among
-  their args; ``SEQUENCE_CASTS`` take a sequence first-arg (cat/stack).
+  their args; ``SEQUENCE_CASTS`` take a sequence first-arg (cat/stack);
+  ``INPLACE_CASTS`` mutate arg0's storage, so the OTHER args cast to
+  arg0's dtype (the reference's ``promote_match_arg0`` semantics).
 
 Names are strings resolved with ``hasattr`` at patch time so the lists
 stay valid across torch versions.
+
+Intentional deltas vs the reference tables (everything else is parity;
+tests/L0/run_amp/test_patch_lists.py pins each category end to end):
+
+* **Half type is bf16 by default**, fp16 via ``half_dtype`` — the
+  reference is fp16-only.  Consequence: the reference's CUDA-9.1 gate
+  that demotes ``bmm``/``addbmm``/``baddbmm`` to fp32 on old toolkits
+  has no analog; the batched matmuls are unconditionally 16-bit here
+  (every supported backend has fast bf16 matmul).
+* **RNN-family casts patch ``torch.nn.modules.rnn._VF``** (the modern
+  dispatch point ``nn.LSTM``/``GRU``/``RNN`` and the ``*Cell`` modules
+  call) via ``rnn_compat.whitelist_rnn_cells``; the reference's legacy
+  ``torch.nn.backends.thnn`` backend wrapping (``rnn_cast``) targets a
+  torch that no longer exists and stays tombstoned.
+* **No banned-function error wrappers**: the reference plants loud
+  errors on in-place blacklist ops (``err_if_any_half``); here the
+  in-place surface uses match-arg0 promotion instead — an in-place op
+  never silently rebinds, so the failure mode those errors guarded
+  against (alias divergence) cannot occur.
+* ``einsum`` rides the plain half-cast wrapper (the equation string
+  passes through the cast untouched); the reference routes it through a
+  bespoke handler for torch versions whose einsum took a sequence arg.
 """
 from apex_tpu.amp.lists import (  # noqa: F401
     functional_overrides,

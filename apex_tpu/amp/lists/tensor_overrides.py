@@ -14,6 +14,7 @@ FP32_FUNCS = [
     "pow", "__pow__", "__rpow__",
     "softmax", "log_softmax",
     "cumprod", "cumsum", "prod", "sum",
+    "mean", "std", "var",
     "dist", "norm", "renorm",
 ]
 
@@ -28,8 +29,12 @@ CASTS = [
 # In-place methods mutate arg0's storage: the other args are cast to
 # arg0's dtype (promote_match_arg0), never arg0 itself — a widest-dtype
 # promote would rebind instead of mutate and break parameter aliasing.
+# The named ``*_`` forms are the reference's ``as_inplace`` expansion of
+# the promote list.
 INPLACE_CASTS = [
     "__iadd__", "__idiv__", "__imul__", "__isub__", "__itruediv__",
+    "add_", "sub_", "mul_", "div_",
+    "addcdiv_", "addcmul_", "atan2_", "fmod_",
 ]
 
 SEQUENCE_CASTS = []

@@ -1,7 +1,10 @@
 """O1 cast lists for the ``torch`` namespace (reference:
 ``apex/amp/lists/torch_overrides.py``)."""
 
-# matmul/conv family -> 16-bit (MXU-shaped work)
+# matmul/conv family -> 16-bit (MXU-shaped work).  einsum's equation
+# string is not a tensor, so the generic cast wrapper passes it through
+# and half-casts only the operands (reference parity for the
+# tensor-varargs einsum signature).
 FP16_FUNCS = [
     "conv1d", "conv2d", "conv3d",
     "conv_transpose1d", "conv_transpose2d", "conv_transpose3d",
@@ -9,6 +12,7 @@ FP16_FUNCS = [
     "matmul", "mm", "mv", "bmm",
     "addmm", "addmv", "addr", "addbmm", "baddbmm",
     "prelu",
+    "einsum",
 ]
 
 # precision-sensitive -> fp32
@@ -19,8 +23,21 @@ FP32_FUNCS = [
     "pow",
     "softmax", "log_softmax",
     "cumprod", "cumsum", "prod", "sum",
+    "mean", "std", "var",
     "dist", "norm", "renorm",
     "cosine_similarity",
+]
+
+# RNN-family dispatch targets on ``torch.nn.modules.rnn._VF`` — the
+# point every ``nn.{RNN,GRU,LSTM}`` forward and ``*Cell`` call funnels
+# through in modern torch (reference: ``rnn_cast``/``new_rnn_cast`` on
+# the legacy THNN backend).  Patched by
+# ``rnn_compat.whitelist_rnn_cells``, not ``_apply_lists`` (the target
+# module is resolved at init time, and the packed-sequence overloads
+# share these names).
+RNN_CAST_FUNCS = [
+    "rnn_tanh", "rnn_relu", "lstm", "gru",
+    "rnn_tanh_cell", "rnn_relu_cell", "lstm_cell", "gru_cell",
 ]
 
 # multi-arg ops -> widest dtype among the args

@@ -1,26 +1,63 @@
 """RNN compatibility shims for O1 patching (reference:
-``apex/amp/rnn_compat.py`` — wraps torch's legacy RNN backend factories so
+``apex/amp/rnn_compat.py`` — wraps torch's RNN backend so
 patched-function autocast reaches RNN cells).
 
-The legacy fused-RNN surface this patched (``apex.RNN``) is deprecated in
-the reference and tombstoned here (see ``apex_tpu/RNN``); modern recurrent
-models run through scan + the patched functional ops, which O1 already
-covers.  The module keeps the reference's probe helper so callers can
-feature-test it.
+The reference targets two backends: the legacy THNN factories
+(``torch.nn.backends.thnn``, ``rnn_cast``) and the ``_rnn_impls`` /
+``_VF`` dispatch table (``new_rnn_cast``).  The THNN surface this
+rebuild's torch no longer ships stays tombstoned
+(:func:`has_old_rnns` is always False, see ``apex_tpu/RNN``); the
+modern equivalent — every ``nn.{RNN,GRU,LSTM}`` forward and ``*Cell``
+call funnels through ``torch.nn.modules.rnn._VF`` — IS patched:
+:func:`whitelist_rnn_cells` wraps the names in
+``torch_overrides.RNN_CAST_FUNCS`` with the standard half-cast wrapper.
+The flat weight lists are nested sequences of leaf parameters, which
+the cast wrapper maps structurally and memoizes per-parameter in the
+handle's cache — the reference's ``cached_cast``-inside-``rnn_cast``
+behavior, for free.
 """
 from __future__ import annotations
 
-__all__ = ["has_old_rnns", "whitelist_rnn_cells"]
+__all__ = ["has_old_rnns", "has_vf_rnns", "whitelist_rnn_cells"]
 
 
 def has_old_rnns() -> bool:
-    """The legacy torch RNN backend the reference patches does not exist
-    on this stack (reference probes ``torch.nn.backends.thnn``)."""
+    """The legacy torch THNN RNN backend the reference patches does not
+    exist on this stack (reference probes ``torch.nn.backends.thnn``)."""
     return False
 
 
+def _vf_module():
+    try:
+        import torch.nn.modules.rnn as rnn_mod
+    except ImportError:  # pragma: no cover — torch absent
+        return None
+    return getattr(rnn_mod, "_VF", None)
+
+
+def has_vf_rnns() -> bool:
+    """True when the modern ``_VF`` RNN dispatch point is patchable."""
+    vf = _vf_module()
+    return vf is not None and hasattr(vf, "lstm")
+
+
 def whitelist_rnn_cells(handle, verbose: bool = False) -> None:
-    """No-op: RNN cells route through already-patched functional ops
-    (reference registers fp16 casts on the legacy cell backends)."""
-    if verbose:
-        print("apex_tpu.amp.rnn_compat: no legacy RNN backend to patch")
+    """Register half casts on the RNN-family ``_VF`` entry points
+    (reference: ``new_rnn_cast``), through ``handle._patch`` so
+    ``_deactivate`` restores the originals."""
+    from apex_tpu.amp.amp import _get_cache, _is_active
+    from apex_tpu.amp.lists.torch_overrides import RNN_CAST_FUNCS
+    from apex_tpu.amp.wrap import make_cast_wrapper
+
+    vf = _vf_module()
+    if vf is None:
+        if verbose:
+            print("apex_tpu.amp.rnn_compat: no RNN backend to patch")
+        return
+    for name in RNN_CAST_FUNCS:
+        if not hasattr(vf, name):
+            continue
+        handle._patch(vf, name, make_cast_wrapper(
+            getattr(vf, name), True, _get_cache, _is_active))
+        if verbose:
+            print(f"apex_tpu.amp.rnn_compat: half-casting _VF.{name}")

@@ -118,6 +118,56 @@ def _builders():
         variables = jax.eval_shape(layer.init, key, x)
         return (lambda v, x_: layer.apply(v, x_), (variables, x))
 
+    def decode_attention():
+        from apex_tpu.ops import decode_attention as op
+        q = s((2, 4, 1, 64), bf16)
+        kv = s((2, 2, 128, 64), bf16)
+        return (lambda q_, k_, v_, n: op(q_, k_, v_, n),
+                (q, kv, kv, s((2,), jnp.int32)))
+
+    def _engine_audit_pieces():
+        """Shared tiny-GPT engine fixture for the inference entries:
+        abstract params (eval_shape — no FLOPs) + an abstract cache."""
+        import flax  # noqa: F401 — optional dep; ImportError skips
+        from apex_tpu.inference import kv_cache
+        from apex_tpu.inference.sampling import SamplingConfig
+        from apex_tpu.transformer import parallel_state
+        from apex_tpu.transformer.testing import (GPTConfig,
+                                                  gpt_model_provider)
+        # the TP layers' tp=1 identity fast path reads parallel_state;
+        # tracing outside a test harness needs it initialized (same
+        # single-rank init every consumer of these models performs)
+        if not parallel_state.model_parallel_is_initialized():
+            parallel_state.initialize_model_parallel(1)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_attention_heads=4, max_seq_length=64,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        params_dtype=bf16)
+        model = gpt_model_provider(cfg)
+        params = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                                s((1, 8), jnp.int32))
+        cache = jax.eval_shape(
+            lambda: kv_cache.init_cache(2, cfg.num_layers,
+                                        cfg.num_attention_heads, 64,
+                                        64 // cfg.num_attention_heads))
+        key = s((2,), jnp.uint32)
+        return cfg, SamplingConfig(), params, cache, key
+
+    def inference_prefill():
+        from apex_tpu.inference.engine import make_prefill_fn
+        cfg, sampling, params, cache, key = _engine_audit_pieces()
+        fn = make_prefill_fn("gpt", cfg, sampling)
+        return (fn, (cache, params, s((16,), jnp.int32),
+                     s((), jnp.int32), s((), jnp.int32), key,
+                     s((), jnp.int32)))
+
+    def inference_decode():
+        from apex_tpu.inference.engine import make_decode_fn
+        cfg, sampling, params, cache, key = _engine_audit_pieces()
+        fn = make_decode_fn("gpt", cfg, sampling)
+        return (fn, (cache, params, s((2,), jnp.int32), s((2,), bool),
+                     key, s((), jnp.int32)))
+
     return {
         # budgets are the measured entry upcasts (γ/β applied in fp32 by
         # design — see the kernel docstrings); any increase fails
@@ -137,6 +187,25 @@ def _builders():
         # transfer discipline only
         "moe_layer": (moe_layer, "apex_tpu/transformer/moe/layer.py",
                       None, None),
+        # the inference subsystem's device programs (ISSUE 4): the
+        # decode core holds the full bf16 policy; the whole prefill/
+        # decode executables pin output dtypes (cache bf16 / sampled
+        # tokens int32 / logits fp32) and transfer discipline — a host
+        # callback sneaking into the serving hot loop fails the audit.
+        # Per-layer LN entry upcasts make a whole-model upcast budget
+        # churn with depth, so the engine entries skip that one check
+        # (decode_attention carries it).
+        "decode_attention": (decode_attention,
+                             "apex_tpu/ops/attention.py",
+                             ("bfloat16",), 0),
+        "inference_prefill": (inference_prefill,
+                              "apex_tpu/inference/engine.py",
+                              ("bfloat16", "bfloat16", "int32", "int32",
+                               "float32"), None),
+        "inference_decode": (inference_decode,
+                             "apex_tpu/inference/engine.py",
+                             ("bfloat16", "bfloat16", "int32", "int32",
+                              "float32"), None),
     }
 
 

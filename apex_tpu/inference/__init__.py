@@ -1,0 +1,43 @@
+"""apex_tpu.inference — TPU-native serving over the standalone models.
+
+The inference workload as a first-class subsystem (ISSUE 4): a
+prefill/decode engine whose decode step is ONE donated XLA executable
+over a statically shaped slot KV cache, fed by a host-side
+continuous-batching scheduler.
+
+    engine     prefill/decode executables, weight export boundaries
+    kv_cache   [slots, layers, kv_heads, max_seq, d] donated cache
+    models     pure cache-aware forwards over the flax param trees
+    sampling   greedy / temperature / top-k with explicit key threading
+    scheduler  static-bucket continuous batching (host-side slots)
+
+Quick start (see README "Inference")::
+
+    from apex_tpu.inference import InferenceEngine
+    engine = InferenceEngine("gpt", cfg, params, slots=8)
+    outputs = engine.generate(prompts, max_new_tokens=32)
+"""
+from apex_tpu.inference.engine import (
+    InferenceEngine,
+    make_decode_fn,
+    make_prefill_fn,
+    prefill_bucket,
+)
+from apex_tpu.inference.kv_cache import KVCache, init_cache
+from apex_tpu.inference.sampling import SamplingConfig, greedy, sample_token
+from apex_tpu.inference.scheduler import Request, SlotScheduler, generate
+
+__all__ = [
+    "InferenceEngine",
+    "KVCache",
+    "init_cache",
+    "SamplingConfig",
+    "greedy",
+    "sample_token",
+    "Request",
+    "SlotScheduler",
+    "generate",
+    "make_prefill_fn",
+    "make_decode_fn",
+    "prefill_bucket",
+]

@@ -1,0 +1,237 @@
+"""Prefill/decode inference engine: each phase is ONE donated XLA
+executable.
+
+Workload split (the flash-attention/Megatron serving shape):
+
+* **Prefill** — the whole prompt in one causal forward through the flash
+  kernels, k/v for every layer parked into one cache slot
+  (``kv_cache.insert``), the first token sampled from the last real
+  position's logits.  Compiled once per prompt *bucket* (prompts pad up
+  to a power-of-two length) with the cache donated.
+* **Decode** — one token for EVERY slot per step: embed, per-layer
+  qkv + cache append + ``decode_attention`` over the slot's live
+  length, lm head, sampling, length advance — all in one jitted program
+  with the cache donated, so the executable's cache output aliases its
+  input and no per-step reallocation exists.  The step's PRNG key is
+  derived in-program (``fold_in(key, step)``), so sampled decoding adds
+  no second executable.
+
+No host transfer appears anywhere in either jaxpr (audited by
+``analysis/jaxpr_audit.py`` — the inference entries trace these exact
+step builders); the only device<->host traffic is the scheduler reading
+sampled tokens *between* steps, which is the continuous-batching control
+loop by construction.
+
+Weights: any checkpoint that can produce the flat fp32 master restores
+straight into the engine — :meth:`InferenceEngine.from_train_state`
+exports bf16 params from ``FlatState.params(dtype=...)`` (gathering
+shards if the state is ZeRO-sharded), and
+:meth:`InferenceEngine.from_state_dict` consumes the contrib
+``DistributedFused*`` shard-aware ``state_dict`` written at ANY dp.
+
+BERT rides along as the encode-only path (``kind="bert"``): one jitted
+bidirectional forward, no cache — prefill and decode degenerate to the
+same executable-shape discipline with nothing to split.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.inference import kv_cache, models
+from apex_tpu.inference.sampling import SamplingConfig, sample_token
+
+__all__ = ["InferenceEngine", "make_prefill_fn", "make_decode_fn",
+           "prefill_bucket"]
+
+
+def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig):
+    """Pure prefill step: ``(cache, params, tokens [s], slot, length,
+    key, step) -> (cache, next_token, last_logits)``.  ``length`` is the
+    real prompt length inside the bucket-padded ``tokens``."""
+
+    def prefill_fn(cache, params, tokens, slot, length, key, step):
+        # length threads into the forward so the lm head projects ONLY
+        # the last real position, not every bucket-padded row
+        logits, ks, vs = models.prefill_forward(kind, cfg, params,
+                                                tokens[None], length)
+        cache = kv_cache.insert(cache, slot, ks, vs, length)
+        last = logits[0].astype(jnp.float32)                # [vocab]
+        tok = sample_token(last, jax.random.fold_in(key, step), sampling)
+        return cache, tok, last
+
+    return prefill_fn
+
+
+def make_decode_fn(kind: str, cfg, sampling: SamplingConfig):
+    """Pure decode step: ``(cache, params, tokens [slots], active
+    [slots], key, step) -> (cache, next_tokens, logits)``.  Every slot
+    computes (static shape); only active slots advance their length."""
+
+    def decode_fn(cache, params, tokens, active, key, step):
+        logits, cache = models.decode_forward(kind, cfg, params, cache,
+                                              tokens)
+        logits = logits.astype(jnp.float32)
+        toks = sample_token(logits, jax.random.fold_in(key, step),
+                            sampling)
+        cache = kv_cache.advance(cache, active)
+        return cache, toks, logits
+
+    return decode_fn
+
+
+def prefill_bucket(n: int, max_seq: int, min_bucket: int = 64) -> int:
+    """Smallest power-of-two bucket >= n (clamped to max_seq): prompts
+    pad up to it so the prefill executable count stays O(log max_seq)."""
+    if n < 1 or n > max_seq:
+        raise ValueError(f"prompt length {n} outside [1, {max_seq}]")
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return min(b, max_seq)
+
+
+class InferenceEngine:
+    """Single-chip serving engine over a standalone GPT/LLaMA/BERT.
+
+    Static shape contract: ``slots`` concurrent sequences, each with a
+    ``max_seq``-deep cache line, decode always batched over every slot.
+    The host-side request plumbing lives in
+    :class:`apex_tpu.inference.scheduler.SlotScheduler`; this class owns
+    the device programs and the cache geometry.
+    """
+
+    def __init__(self, kind: str, cfg, params, *, slots: int = 4,
+                 max_seq: Optional[int] = None, dtype=None,
+                 cache_dtype=jnp.bfloat16,
+                 sampling: SamplingConfig = SamplingConfig(),
+                 seed: int = 0):
+        if kind not in ("gpt", "llama", "bert"):
+            raise ValueError(f"unknown model kind {kind!r}")
+        if kind != "bert":
+            models.check_supported(kind, cfg)
+        self.kind, self.cfg = kind, cfg
+        self.slots = int(slots)
+        self.max_seq = min(int(max_seq or cfg.max_seq_length),
+                           cfg.max_seq_length)
+        self.cache_dtype = cache_dtype
+        self.sampling = sampling
+        if dtype is not None:
+            from apex_tpu.optimizers.functional import _cast_floating
+            params = _cast_floating(params, dtype)
+        self.params = params
+        self._key = jax.random.PRNGKey(seed)
+        self._step = 0
+        if kind == "bert":
+            self._encode = jax.jit(self._make_bert_encode())
+        else:
+            self.dims = models.model_dims(kind, cfg)
+            self._prefill = jax.jit(
+                make_prefill_fn(kind, cfg, sampling), donate_argnums=(0,))
+            self._decode = jax.jit(
+                make_decode_fn(kind, cfg, sampling), donate_argnums=(0,))
+
+    # -- cache ---------------------------------------------------------------
+    def init_cache(self) -> kv_cache.KVCache:
+        if self.kind == "bert":
+            raise ValueError("BERT is the encode-only path (no KV "
+                             "cache); use encode()")
+        d = self.dims
+        return kv_cache.init_cache(
+            self.slots, d["layers"], d["kv_heads"], self.max_seq,
+            d["head_dim"], dtype=self.cache_dtype)
+
+    # -- generative path -----------------------------------------------------
+    def _next_step(self):
+        # numpy scalar, not jnp: an eager jnp.asarray of a python int
+        # compiles a throwaway convert program per call — a numpy
+        # operand binds into the jitted step with no extra executable
+        s = self._step
+        self._step += 1
+        return np.int32(s)
+
+    def prefill(self, cache, tokens, slot):
+        """Admit one prompt into ``slot``: returns ``(cache, next_token,
+        last_logits)``.  ``tokens`` is the UNPADDED prompt (list/array of
+        ints); padding to the executable bucket happens here."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.shape[0]
+        bucket = prefill_bucket(n, self.max_seq)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = tokens
+        return self._prefill(cache, self.params, padded,
+                             np.int32(slot), np.int32(n),
+                             self._key, self._next_step())
+
+    def decode(self, cache, last_tokens, active=None):
+        """One token for every slot: returns ``(cache, next_tokens,
+        logits)``; only ``active`` slots advance their cache length.
+
+        Capacity contract: a slot whose length has reached ``max_seq``
+        must be retired (deactivated) by the caller before further
+        steps — the scheduler tracks this host-side from prompt/output
+        lengths.  Past capacity the cache clamps (see
+        :func:`kv_cache.advance`) rather than corrupting earlier rows,
+        but the emitted tokens for that slot are no longer meaningful.
+        """
+        if active is None:
+            active = np.ones((self.slots,), bool)
+        return self._decode(cache, self.params,
+                            np.asarray(last_tokens, np.int32),
+                            np.asarray(active, bool),
+                            self._key, self._next_step())
+
+    def generate(self, prompts, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None):
+        """Convenience wrapper over the continuous-batching scheduler:
+        ``prompts`` (list of token lists) -> list of generated token
+        lists, in submission order."""
+        from apex_tpu.inference import scheduler
+        return scheduler.generate(self, prompts,
+                                  max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id)
+
+    # -- encode-only path (BERT) --------------------------------------------
+    def _make_bert_encode(self):
+        from apex_tpu.transformer.testing import bert_model_provider
+        model = bert_model_provider(self.cfg, add_binary_head=False)
+
+        def encode(params, tokens, token_types):
+            return model.apply(params, tokens, token_types)
+
+        return encode
+
+    def encode(self, tokens, token_types=None):
+        """BERT path: one bidirectional forward, logits out."""
+        if self.kind != "bert":
+            raise ValueError("encode() is the BERT path; use "
+                             "prefill()/decode() for generative models")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        if token_types is None:
+            token_types = jnp.zeros(tokens.shape, jnp.int32)
+        return self._encode(self.params, tokens, token_types)
+
+    # -- checkpoint boundaries ----------------------------------------------
+    @classmethod
+    def from_train_state(cls, kind: str, cfg, state, *,
+                         dtype=jnp.bfloat16, **kwargs):
+        """Build from a :class:`~apex_tpu.train_step.TrainState` (or bare
+        ``FlatState``): weights export in ``dtype`` (bf16 serving
+        default) via ``FlatState.params(dtype=...)`` — a ZeRO-sharded
+        state all-gathers its master, so a checkpoint written at any dp
+        restores straight into the engine."""
+        opt = getattr(state, "opt", state)
+        return cls(kind, cfg, opt.params(dtype=dtype), **kwargs)
+
+    @classmethod
+    def from_state_dict(cls, kind: str, cfg, sd, params_template, *,
+                        dtype=jnp.bfloat16, **kwargs):
+        """Build from a contrib ``DistributedFused*`` shard-aware
+        ``state_dict`` (the reassembled full flat master) plus the model
+        param template that defines the leaf layout."""
+        from apex_tpu.optimizers.functional import export_params
+        params = export_params(sd["master"], params_template, dtype=dtype)
+        return cls(kind, cfg, params, **kwargs)

@@ -1,0 +1,167 @@
+"""Slot-based KV cache: statically shaped, donated pure updates.
+
+The serving-side analog of the flat optimizer master (ISSUE 2/3): ONE
+statically shaped buffer pair
+
+    k, v : [slots, layers, kv_heads, max_seq, head_dim]
+
+plus a ``[slots]`` length vector, carried through the jitted
+prefill/decode executables and donated every step — the cache is
+allocated once at engine construction and never reallocated, the same
+way the train step's FlatState master is.
+
+Design positions:
+
+* **Slots, not sequences.**  A slot is a fixed-capacity cache line; the
+  host-side scheduler (``inference/scheduler.py``) maps live requests
+  onto slots between device steps, so admitting/retiring requests never
+  changes a device shape — the decode executable compiles once.
+* **GQA/MQA-aware.**  The cache stores ``kv_heads`` (the model's
+  ``cfg.kv_heads``), not query heads: k/v are cached at their
+  pre-broadcast width, so LLaMA's grouped/replicated-kv layout is
+  cached once per kv head and the group broadcast happens (implicitly)
+  inside :func:`apex_tpu.ops.attention.decode_attention`'s grouped
+  einsum — ``h // kv_heads``× less cache HBM, the whole point of GQA at
+  serving time.
+* **Pure donated updates.**  Every mutation is a
+  ``lax.dynamic_update_slice`` (prefill insert: one static-shape slab;
+  decode append: a vmap over slots, each writing one token row at its
+  own length) returning ``cache.replace(...)`` — donation-safe and
+  scan-carryable exactly like ``FlatState``.
+* **Eviction is metadata.**  Retiring a request zeroes the slot's
+  length; the stale k/v rows are dead weight masked out by the length
+  and overwritten by the next insert.  No data movement on the retire
+  path.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+__all__ = ["KVCache", "init_cache"]
+
+
+@flax.struct.dataclass
+class KVCache:
+    """Static-shape slot cache (see the module docstring for layout)."""
+    k: jax.Array          # [slots, layers, kv_heads, max_seq, head_dim]
+    v: jax.Array          # same shape/dtype as k
+    lengths: jax.Array    # [slots] int32: live tokens per slot
+
+    @property
+    def slots(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def layers(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def kv_heads(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+
+def init_cache(slots: int, layers: int, kv_heads: int, max_seq: int,
+               head_dim: int, dtype=jnp.bfloat16) -> KVCache:
+    """Allocate an empty cache (every slot free, length 0)."""
+    shape = (slots, layers, kv_heads, max_seq, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def insert(cache: KVCache, slot, k, v, length) -> KVCache:
+    """Prefill write: park a prompt's k/v into one slot.
+
+    ``k``/``v``: ``[layers, kv_heads, s, head_dim]`` with ``s`` the
+    (possibly bucket-padded) prompt length, ``s <= max_seq``; ``length``
+    is the number of REAL tokens (padding rows beyond it are stored but
+    masked by the length everywhere they could be read).  ``slot`` and
+    ``length`` may be traced — one compiled insert serves every slot.
+    """
+    s = k.shape[2]
+    if k.shape != v.shape or k.shape[:2] != (cache.layers, cache.kv_heads) \
+            or k.shape[3] != cache.head_dim:
+        raise ValueError(
+            f"prefill k/v must be [layers={cache.layers}, "
+            f"kv_heads={cache.kv_heads}, s, head_dim={cache.head_dim}], "
+            f"got k {tuple(k.shape)} v {tuple(v.shape)}")
+    if s > cache.max_seq:
+        raise ValueError(
+            f"prompt length {s} exceeds cache max_seq {cache.max_seq}")
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.int32(0)
+    start = (slot, zero, zero, zero, zero)
+    new_k = jax.lax.dynamic_update_slice(
+        cache.k, k[None].astype(cache.k.dtype), start)
+    new_v = jax.lax.dynamic_update_slice(
+        cache.v, v[None].astype(cache.v.dtype), start)
+    new_len = jax.lax.dynamic_update_slice(
+        cache.lengths, jnp.asarray(length, jnp.int32)[None], (slot,))
+    return cache.replace(k=new_k, v=new_v, lengths=new_len)
+
+
+def append_layer(cache: KVCache, layer: int, k_tok, v_tok) -> KVCache:
+    """Decode write for ONE layer: each slot's token row lands at that
+    slot's current length.
+
+    ``k_tok``/``v_tok``: ``[slots, kv_heads, head_dim]`` — the new
+    token's k/v per slot.  ``layer`` is static (the decode forward is an
+    unrolled python loop over layers).  Lengths do NOT advance here —
+    call :func:`advance` once after the last layer so every layer of a
+    decode step writes to the same position.
+    """
+    if k_tok.shape != (cache.slots, cache.kv_heads, cache.head_dim):
+        raise ValueError(
+            f"token k/v must be [slots={cache.slots}, "
+            f"kv_heads={cache.kv_heads}, head_dim={cache.head_dim}], "
+            f"got {tuple(k_tok.shape)}")
+
+    def write(buf, tok, pos):
+        # buf [kv_heads, max_seq, d], tok [kv_heads, d]: one token row
+        # at this slot's own position
+        return jax.lax.dynamic_update_slice(
+            buf, tok[:, None, :].astype(buf.dtype),
+            (jnp.int32(0), pos, jnp.int32(0)))
+
+    upd = jax.vmap(write)
+    new_k = cache.k.at[:, layer].set(
+        upd(cache.k[:, layer], k_tok, cache.lengths))
+    new_v = cache.v.at[:, layer].set(
+        upd(cache.v[:, layer], v_tok, cache.lengths))
+    return cache.replace(k=new_k, v=new_v)
+
+
+def advance(cache: KVCache, active) -> KVCache:
+    """Advance the active slots' lengths by the one token the decode
+    step just appended; inactive slots stay put (their garbage write at
+    position ``length`` stays dead).
+
+    Lengths clamp at ``max_seq``: a slot decoded past capacity stops
+    growing instead of walking its length off the buffer (the append's
+    clamped write would otherwise keep overwriting the last row while
+    the mask treats ever more rows as live).  Retiring full slots is
+    the scheduler's job — the clamp just bounds the damage of a missing
+    guard to the final cache row."""
+    return cache.replace(
+        lengths=jnp.minimum(
+            cache.lengths + jnp.asarray(active, jnp.int32),
+            jnp.int32(cache.max_seq)))
+
+
+def evict(cache: KVCache, slot) -> KVCache:
+    """Retire a slot: zero its length.  Metadata-only — the k/v rows are
+    left in place, masked by the length, and overwritten by the next
+    insert into this slot."""
+    slot = jnp.asarray(slot, jnp.int32)
+    return cache.replace(
+        lengths=jax.lax.dynamic_update_slice(
+            cache.lengths, jnp.zeros((1,), jnp.int32), (slot,)))

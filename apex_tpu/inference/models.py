@@ -1,0 +1,301 @@
+"""Pure prefill/decode forwards over the standalone model param trees.
+
+The training models (``transformer/testing/standalone_{gpt,llama}``) are
+flax modules built for the training shapes; inference needs the same
+math split into a *prefill* (full prompt, causal flash attention,
+emitting every layer's k/v for the cache) and a *decode* (one token per
+slot against the cache).  These functions consume the EXACT param pytree
+``model.init`` produces — no re-keying, no conversion step — and mirror
+the modules' op sequence call for call (same fused LayerNorm/RMSNorm
+kernels, same flash attention, same RoPE convention, same qkv
+reshape/split layout), so prefill logits reproduce ``model.apply``
+bit-for-bit on the same weights and the parity tests in
+``tests/L0/run_inference`` can pin decode against the full forward.
+
+Single-chip serving (tp = 1): the TP layers all collapse to plain
+matmuls at world size 1, which is what these forwards implement.
+Unsupported training-only configs (scan_layers, MoE FFN, sequence/
+context parallelism) fail loudly at engine construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.inference import kv_cache
+from apex_tpu.ops import layer_norm, rms_norm
+from apex_tpu.ops.attention import decode_attention, flash_attention
+from apex_tpu.transformer.functional.fused_rope import (
+    fused_apply_rotary_pos_emb_cached,
+)
+from apex_tpu.transformer.testing.standalone_llama import _rope_cos_sin
+
+__all__ = ["model_dims", "check_supported", "prefill_forward",
+           "decode_forward"]
+
+
+def model_dims(kind: str, cfg) -> dict:
+    """Static cache geometry for a model config: layers / kv_heads /
+    head_dim (+ query heads)."""
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    kv_heads = (cfg.kv_heads if kind == "llama"
+                else cfg.num_attention_heads)
+    return {"layers": cfg.num_layers, "heads": cfg.num_attention_heads,
+            "kv_heads": kv_heads, "head_dim": head_dim}
+
+
+def check_supported(kind: str, cfg) -> None:
+    if kind not in ("gpt", "llama"):
+        raise ValueError(f"unknown generative model kind {kind!r} "
+                         "(expected 'gpt' or 'llama')")
+    for flag in ("sequence_parallel", "context_parallel", "scan_layers"):
+        if getattr(cfg, flag, False):
+            raise ValueError(
+                f"inference forwards run tp=1 unrolled; cfg.{flag} is a "
+                "training-topology knob — export the weights into a "
+                "plain config instead")
+    if getattr(cfg, "num_moe_experts", None):
+        raise ValueError("MoE FFN decode is not implemented yet")
+
+
+def _params_subtree(params):
+    """Accept ``model.init``'s ``{"params": ...}`` or the bare tree."""
+    return params["params"] if "params" in params and isinstance(
+        params["params"], dict) else params
+
+
+def _linear(p, x):
+    """Column/RowParallelLinear at tp=1: ``x @ W.T (+ b)`` with the
+    layers' ``[out, in]`` weight layout."""
+    y = jnp.matmul(x, p["weight"].T)
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+# --------------------------------------------------------------------------
+# GPT (standalone_gpt mirror)
+# --------------------------------------------------------------------------
+
+def _gpt_attn_proj(lp, h, heads, head_dim):
+    """qkv projection + the model's reshape/split layout: returns
+    q/k/v with a trailing ``[..., heads, head_dim]``."""
+    qkv = _linear(lp["self_attention"]["query_key_value"], h)
+    qkv = qkv.reshape(*h.shape[:-1], heads, 3 * head_dim)
+    return jnp.split(qkv, 3, axis=-1)
+
+
+def _gpt_mlp(lp, h):
+    return _linear(lp["mlp"]["dense_4h_to_h"],
+                   jax.nn.gelu(_linear(lp["mlp"]["dense_h_to_4h"], h)))
+
+
+def _last_row(h, length):
+    """Hidden state at the last REAL position (``length - 1``) of a
+    bucket-padded ``[s, b, hid]`` activation — sliced BEFORE the lm
+    head, so the O(s·vocab·hidden) projection runs on one row instead
+    of every dead padding position (~1/3 of prefill FLOPs at the
+    flagship shape)."""
+    return jax.lax.dynamic_index_in_dim(h, length - 1, axis=0,
+                                        keepdims=False)       # [b, hid]
+
+
+def _gpt_prefill(cfg, params, tokens, length=None):
+    p = _params_subtree(params)
+    b, s = tokens.shape
+    dims = model_dims("gpt", cfg)
+    heads, head_dim = dims["heads"], dims["head_dim"]
+
+    emb_w = p["embedding"]["word_embeddings"]["weight"]
+    h = jnp.take(emb_w, tokens, axis=0)                     # [b, s, h]
+    h = h + p["embedding"]["position_embeddings"][None, :s, :]
+    h = h.transpose(1, 0, 2)                                # [s, b, h]
+
+    ks, vs = [], []
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        x = h
+        h1 = layer_norm(x, lp["input_layernorm"]["weight"],
+                        lp["input_layernorm"]["bias"])
+        q, k, v = _gpt_attn_proj(lp, h1, heads, head_dim)   # [s, b, n, d]
+        q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        ks.append(k[0])                                     # [n, s, d]
+        vs.append(v[0])
+        ctx = flash_attention(q, k, v, causal=True)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
+        x = x + _linear(lp["self_attention"]["dense"], ctx)
+        h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
+                        lp["post_attention_layernorm"]["bias"])
+        h = x + _gpt_mlp(lp, h2)
+
+    h = layer_norm(h, p["final_layernorm"]["weight"],
+                   p["final_layernorm"]["bias"])
+    if length is not None:
+        logits = jnp.einsum("bh,vh->bv", _last_row(h, length), emb_w)
+    else:
+        logits = jnp.einsum("sbh,vh->sbv", h, emb_w)        # tied head
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def _gpt_decode(cfg, params, cache, tokens):
+    p = _params_subtree(params)
+    dims = model_dims("gpt", cfg)
+    heads, head_dim = dims["heads"], dims["head_dim"]
+    positions = cache.lengths                               # [slots]
+
+    emb_w = p["embedding"]["word_embeddings"]["weight"]
+    h = jnp.take(emb_w, tokens, axis=0)                     # [slots, h]
+    h = h + jnp.take(p["embedding"]["position_embeddings"],
+                     positions, axis=0)
+
+    live = positions + 1                    # incl. the token written now
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        x = h
+        h1 = layer_norm(x, lp["input_layernorm"]["weight"],
+                        lp["input_layernorm"]["bias"])
+        q, k_tok, v_tok = _gpt_attn_proj(lp, h1, heads, head_dim)
+        cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
+        ctx = decode_attention(q, cache.k[:, i], cache.v[:, i], live)
+        x = x + _linear(lp["self_attention"]["dense"],
+                        ctx.reshape(ctx.shape[0], -1))
+        h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
+                        lp["post_attention_layernorm"]["bias"])
+        h = x + _gpt_mlp(lp, h2)
+
+    h = layer_norm(h, p["final_layernorm"]["weight"],
+                   p["final_layernorm"]["bias"])
+    logits = jnp.einsum("bh,vh->bv", h, emb_w)
+    return logits, cache
+
+
+# --------------------------------------------------------------------------
+# LLaMA (standalone_llama mirror; GQA/MQA cached once per kv head)
+# --------------------------------------------------------------------------
+
+def _llama_rope_table(cfg, head_dim, max_seq):
+    """Flat ``[max_seq, head_dim]`` cos/sin tables (the model's
+    ``_rope_cos_sin`` values, position-indexable for decode)."""
+    cos, sin = _rope_cos_sin(max_seq, head_dim, cfg.rope_theta)
+    return cos.reshape(max_seq, head_dim), sin.reshape(max_seq, head_dim)
+
+
+def _llama_proj(lp, h, cfg, heads, kv_heads, head_dim):
+    q = _linear(lp["attention"]["q_proj"], h)
+    kv = _linear(lp["attention"]["kv_proj"], h)
+    q = q.reshape(*h.shape[:-1], heads, head_dim)
+    k, v = jnp.split(kv.reshape(*h.shape[:-1], kv_heads, 2 * head_dim),
+                     2, axis=-1)
+    return q, k, v
+
+
+def _llama_mlp(lp, h):
+    gate = _linear(lp["mlp"]["gate_proj"], h)
+    up = _linear(lp["mlp"]["up_proj"], h)
+    return _linear(lp["mlp"]["down_proj"], jax.nn.silu(gate) * up)
+
+
+def _llama_prefill(cfg, params, tokens, length=None):
+    p = _params_subtree(params)
+    b, s = tokens.shape
+    dims = model_dims("llama", cfg)
+    heads, kv_heads = dims["heads"], dims["kv_heads"]
+    head_dim, group = dims["head_dim"], heads // kv_heads
+
+    h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
+    h = h.transpose(1, 0, 2)                                # [s, b, h]
+    cos, sin = _rope_cos_sin(s, head_dim, cfg.rope_theta)   # [s, 1, 1, d]
+
+    ks, vs = [], []
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        x = h
+        h1 = rms_norm(x, lp["input_norm"]["weight"], eps=cfg.rms_eps)
+        q, k, v = _llama_proj(lp, h1, cfg, heads, kv_heads, head_dim)
+        q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
+        k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
+        # cache the PRE-broadcast kv (once per kv head)
+        ks.append(k.transpose(1, 2, 0, 3)[0])               # [kv, s, d]
+        vs.append(v.transpose(1, 2, 0, 3)[0])
+        if group > 1:                   # GQA: share kv across the group
+            k, v = (jnp.broadcast_to(
+                t[:, :, :, None, :], (s, b, kv_heads, group, head_dim)
+            ).reshape(s, b, heads, head_dim) for t in (k, v))
+        q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        ctx = flash_attention(q, k, v, causal=True)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
+        x = x + _linear(lp["attention"]["o_proj"], ctx)
+        h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
+                      eps=cfg.rms_eps)
+        h = x + _llama_mlp(lp, h1)
+
+    h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
+    if length is not None:
+        logits = _linear(p["lm_head"], _last_row(h, length))  # [b, v]
+    else:
+        logits = _linear(p["lm_head"], h)                     # [s, b, v]
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def _llama_decode(cfg, params, cache, tokens):
+    p = _params_subtree(params)
+    dims = model_dims("llama", cfg)
+    heads, kv_heads = dims["heads"], dims["kv_heads"]
+    head_dim = dims["head_dim"]
+    positions = cache.lengths
+
+    h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
+    cos_t, sin_t = _llama_rope_table(cfg, head_dim, cache.max_seq)
+    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]    # [slots, 1, d]
+    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
+
+    live = positions + 1
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        x = h
+        h1 = rms_norm(x, lp["input_norm"]["weight"], eps=cfg.rms_eps)
+        q, k_tok, v_tok = _llama_proj(lp, h1, cfg, heads, kv_heads,
+                                      head_dim)
+        q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
+        k_tok = fused_apply_rotary_pos_emb_cached(k_tok, cos, sin)
+        cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
+        # grouped-query scoring straight off the per-kv-head cache
+        ctx = decode_attention(q, cache.k[:, i], cache.v[:, i], live)
+        x = x + _linear(lp["attention"]["o_proj"],
+                        ctx.reshape(ctx.shape[0], -1))
+        h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
+                      eps=cfg.rms_eps)
+        h = x + _llama_mlp(lp, h1)
+
+    h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
+    logits = _linear(p["lm_head"], h)                       # [slots, v]
+    return logits, cache
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+
+def prefill_forward(kind: str, cfg, params, tokens, length=None):
+    """Full-prompt forward: ``tokens [1, s]`` -> ``(logits, k_stack,
+    v_stack)`` with k/v ``[layers, kv_heads, s, head_dim]`` ready for
+    :func:`kv_cache.insert`.
+
+    With ``length`` (the real prompt length inside a bucket-padded
+    ``s``, traced OK) the lm head runs on ONLY the last real position —
+    ``logits [1, v]``; without it every position is projected
+    (``logits [s, 1, v]``, the full-forward shape parity tests pin)."""
+    if tokens.ndim != 2 or tokens.shape[0] != 1:
+        raise ValueError(
+            f"prefill takes one prompt [1, s], got {tuple(tokens.shape)}")
+    fn = _gpt_prefill if kind == "gpt" else _llama_prefill
+    return fn(cfg, params, tokens, length)
+
+
+def decode_forward(kind: str, cfg, params, cache, tokens):
+    """One-token step for every slot: ``tokens [slots]`` ->
+    ``(logits [slots, v], cache)`` with the new k/v appended at each
+    slot's position.  Lengths do not advance here (the engine advances
+    active slots once per step)."""
+    fn = _gpt_decode if kind == "gpt" else _llama_decode
+    return fn(cfg, params, cache, tokens)

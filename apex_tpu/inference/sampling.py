@@ -1,0 +1,77 @@
+"""Token sampling: greedy / temperature / top-k with explicit key
+threading.
+
+JAX PRNG discipline (the analyzer's APX103 rule): a key is a VALUE —
+every sampling call consumes exactly one key the caller derived for it,
+and nothing here ever reuses a key.  The engine folds the step counter
+into its base key (``jax.random.fold_in``) so N decode steps draw N
+independent keys from one seed, in-program, with no key array carried in
+the device state.
+
+``sample_token`` is the single entry the engine compiles into the
+prefill/decode executables: the config is static (a frozen dataclass —
+greedy compiles to pure argmax with the PRNG dead-code-eliminated;
+sampled configs compile the categorical draw in).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SamplingConfig", "greedy", "sample_token"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Static sampling policy (hashable: lives in jit closures).
+
+    ``temperature = 0`` means greedy (matching the HF convention);
+    ``top_k = 0`` means the full vocabulary.
+    """
+    temperature: float = 0.0
+    top_k: int = 0
+
+    def __post_init__(self):
+        # fail fast: a negative temperature would silently INVERT the
+        # distribution (categorical over -logits samples the least
+        # likely tokens), degrading generation with no error anywhere
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0 (0 = greedy), got "
+                f"{self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = full vocab), "
+                             f"got {self.top_k}")
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def greedy(logits):
+    """Argmax over the last axis -> int32 token ids."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _top_k_mask(logits, k: int):
+    """Mask logits outside the per-row top k to -inf (k static)."""
+    thresh = jax.lax.top_k(logits, k)[0][..., -1:]        # k-th largest
+    return jnp.where(logits >= thresh, logits, -jnp.inf)
+
+
+def sample_token(logits, key, cfg: SamplingConfig):
+    """Draw one token per row of ``logits [..., vocab]``.
+
+    ``key`` is consumed (derive a fresh one per call — the engine folds
+    the step index into its base key); it is ignored under greedy but
+    kept in the signature so the compiled decode step has ONE shape for
+    every policy.
+    """
+    if cfg.is_greedy:
+        return greedy(logits)
+    scaled = logits.astype(jnp.float32) / cfg.temperature
+    if cfg.top_k:
+        scaled = _top_k_mask(scaled, min(cfg.top_k, logits.shape[-1]))
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

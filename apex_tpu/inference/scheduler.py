@@ -1,0 +1,160 @@
+"""Static-bucket continuous batching: a host-side slot allocator.
+
+The Megatron/vLLM-style serving loop reduced to its TPU-native core: the
+DEVICE programs never change shape — decode is always ``[slots]``-wide,
+prefill pads to one of O(log max_seq) buckets — and the HOST admits and
+retires requests between device steps:
+
+    admit:   free slot + queued request -> prefill into the slot
+             (one donated executable; first token sampled in-program)
+    step:    one decode executable over every slot (inactive slots
+             compute garbage that is masked and never advances)
+    retire:  EOS or the token budget frees the slot; eviction is pure
+             metadata (the next insert overwrites), so retiring moves
+             zero bytes on device
+
+A wave of requests therefore flows through a FIXED set of compiled
+programs — the continuous-batching property: a finished sequence's slot
+is refilled on the next loop iteration while the other slots keep
+decoding, with no recompile and no cache reallocation anywhere.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Request", "SlotScheduler", "generate"]
+
+
+@dataclasses.dataclass
+class Request:
+    uid: int
+    prompt: list
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _SlotState:
+    """Host bookkeeping for one occupied slot."""
+    uid: int
+    generated: list
+    max_new_tokens: int
+    eos_id: Optional[int]
+    prompt_len: int = 0
+
+    def done(self) -> bool:
+        if self.eos_id is not None and self.generated \
+                and self.generated[-1] == self.eos_id:
+            return True
+        return len(self.generated) >= self.max_new_tokens
+
+    def cache_len(self) -> int:
+        """The slot's device cache length, derived host-side: the
+        prompt plus one append per decode step taken (the first
+        generated token comes from prefill and is written by the NEXT
+        decode) — so the capacity guard never reads the device."""
+        return self.prompt_len + len(self.generated) - 1
+
+
+class SlotScheduler:
+    """Maps a request queue onto the engine's fixed slots."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.queue: collections.deque = collections.deque()
+        self._next_uid = 0
+
+    def submit(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> int:
+        """Queue one request; returns its uid (results key)."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.engine.max_seq:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds engine max_seq "
+                f"{self.engine.max_seq}")
+        uid = self._next_uid
+        self._next_uid += 1
+        self.queue.append(Request(uid, prompt, int(max_new_tokens),
+                                  eos_id))
+        return uid
+
+    def run(self, cache=None) -> dict:
+        """Drain the queue; returns ``{uid: generated token list}``.
+
+        One pass of the loop = admit every free slot it can, then one
+        batched decode step.  The device sees only the fixed-shape
+        prefill/decode executables; everything else here is host-side
+        bookkeeping on ints.
+        """
+        eng = self.engine
+        if cache is None:
+            cache = eng.init_cache()
+        slots: list = [None] * eng.slots
+        free = list(range(eng.slots))
+        last = np.zeros((eng.slots,), np.int32)
+        results: dict = {}
+
+        def retire(slot):
+            st = slots[slot]
+            # token budget may have been crossed by an EOS cut
+            gen = st.generated[:st.max_new_tokens]
+            if st.eos_id is not None and st.eos_id in gen:
+                gen = gen[:gen.index(st.eos_id) + 1]
+            results[st.uid] = gen
+            slots[slot] = None
+            free.append(slot)          # eviction = metadata; insert
+            # on re-admit overwrites the stale cache rows
+
+        while self.queue or any(s is not None for s in slots):
+            # admit: fill every free slot from the queue
+            while self.queue and free:
+                req = self.queue.popleft()
+                slot = free.pop()
+                cache, tok, _ = eng.prefill(cache, req.prompt, slot)
+                tok = int(np.asarray(tok))
+                slots[slot] = _SlotState(req.uid, [tok],
+                                         req.max_new_tokens, req.eos_id,
+                                         prompt_len=len(req.prompt))
+                last[slot] = tok
+                if slots[slot].done():
+                    retire(slot)
+            active = np.array([s is not None for s in slots], bool)
+            if not active.any():
+                continue
+            # guard: a slot at cache capacity cannot take another token.
+            # Lengths are derived host-side (_SlotState.cache_len) — no
+            # device readback in the control loop beyond the sampled
+            # tokens themselves.
+            for slot, st in enumerate(slots):
+                if st is not None and st.cache_len() >= eng.max_seq:
+                    retire(slot)
+                    active[slot] = False
+            if not active.any():
+                continue
+            cache, toks, _ = eng.decode(cache, last, active)
+            toks = np.asarray(toks)
+            for slot, st in enumerate(slots):
+                if st is None or not active[slot]:
+                    continue
+                st.generated.append(int(toks[slot]))
+                last[slot] = toks[slot]
+                if st.done():
+                    retire(slot)
+        return results
+
+
+def generate(engine, prompts, max_new_tokens: int = 16,
+             eos_id: Optional[int] = None):
+    """One-shot continuous-batching run: list of prompts in, list of
+    generated token lists out (submission order)."""
+    sched = SlotScheduler(engine)
+    uids = [sched.submit(p, max_new_tokens=max_new_tokens, eos_id=eos_id)
+            for p in prompts]
+    out = sched.run()
+    return [out[u] for u in uids]

@@ -21,7 +21,7 @@ from .fused_update import (
     fused_lamb_phase1_flat,
     adam_reference,
 )
-from .attention import flash_attention, mha_reference
+from .attention import decode_attention, flash_attention, mha_reference
 from .ring_attention import ring_attention, ring_attention_reference
 from .ulysses_attention import ulysses_attention
 from .xentropy import softmax_cross_entropy_loss, xentropy_reference
@@ -43,6 +43,7 @@ __all__ = [
     "fused_lamb_phase1_flat",
     "adam_reference",
     "flash_attention",
+    "decode_attention",
     "mha_reference",
     "softmax_cross_entropy_loss",
     "xentropy_reference",

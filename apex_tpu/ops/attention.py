@@ -60,7 +60,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils import cdiv, interpret_mode
 
-__all__ = ["flash_attention", "mha_reference"]
+__all__ = ["flash_attention", "mha_reference", "decode_attention"]
 
 _NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
 # The kernels work in BASE-2 log domain: the dot's scalar scale absorbs
@@ -911,3 +911,129 @@ def flash_attention(q, k, v, *, causal: bool = False, mask=None,
     if padded:
         out = out[:, :sq, :]
     return out.reshape(b, h, sq, d)
+
+
+# --------------------------------------------------------------------------
+# single-token decode attention against a KV cache
+# --------------------------------------------------------------------------
+
+#: decode (q_len = 1) kernel/XLA crossover.  A single query row feeds the
+#: Pallas kernel a q block padded up to the 128-lane grid — 128x wasted
+#: MXU rows — while the whole op is one bandwidth-bound matvec over the
+#: cache that XLA lowers to clean VPU code.  The XLA path therefore wins
+#: everywhere the O(b·h·S) score tensor stays small; the kernel only
+#: pays off once the materialized scores outgrow VMEM-friendly sizes at
+#: very long contexts.  4096 is a PROVISIONAL boundary (same status the
+#: attention crossover had before the r5 sweep); override per-run with
+#: ``APEX_TPU_DECODE_XLA_MAX_SEQ`` or per-call with ``xla_max_seq=``
+#: (0 forces the kernel path), and bench infer captures stamp the
+#: effective value so on-chip sweeps can refine it without a code edit.
+_DECODE_XLA_MAX_SEQ = 4096
+
+_DECODE_XLA_MAX_SEQ_ENV = "APEX_TPU_DECODE_XLA_MAX_SEQ"
+
+
+def decode_xla_max_seq(override=None) -> int:
+    """Effective decode crossover: explicit kwarg override >
+    ``APEX_TPU_DECODE_XLA_MAX_SEQ`` env var > the provisional default."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get(_DECODE_XLA_MAX_SEQ_ENV)
+    if env:
+        try:
+            return int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_DECODE_XLA_MAX_SEQ_ENV} must be an int, got "
+                f"{env!r}") from e
+    return _DECODE_XLA_MAX_SEQ
+
+
+def decode_attention(q, k, v, lengths, *, sm_scale: Optional[float] = None,
+                     use_kernel: Optional[bool] = None,
+                     xla_max_seq: Optional[int] = None):
+    """Single-token attention against a per-slot KV cache.
+
+    The inference engine's decode core: one query per sequence slot
+    scores the slot's whole (statically shaped) cache, masked to the
+    slot's live length.
+
+    * ``q``: ``[b, h, 1, d]`` (or ``[b, h, d]``) — the current token's
+      query heads per slot.
+    * ``k``/``v``: ``[b, kv_heads, S, d]`` — the cache, ``kv_heads``
+      dividing ``h`` (GQA/MQA: each kv head serves ``h // kv_heads``
+      query heads, so LLaMA's replicated-kv layout is scored straight
+      from its once-per-kv-head cache with no broadcast materialized on
+      the XLA path).
+    * ``lengths``: ``[b]`` int32 — valid entries per slot; positions at
+      or past a slot's length are masked out.  A slot with length 0
+      emits zeros (the kernels' fully-masked-row convention).
+
+    ``use_kernel=None`` auto-dispatches on the cache length: at or under
+    the crossover (``xla_max_seq`` kwarg > ``APEX_TPU_DECODE_XLA_MAX_SEQ``
+    env var > the provisional default ``_DECODE_XLA_MAX_SEQ``) the op is
+    a fused XLA einsum chain — the VPU-friendly shape for a bandwidth
+    -bound matvec; above it the flash kernel streams the cache blockwise
+    (k/v broadcast to the query heads, the length mask as the kernel's
+    boolean mask operand).  Numerics mirror the kernels: input-dtype
+    operands into the MXU with fp32 accumulation, fp32 softmax.
+    """
+    squeezed = q.ndim == 3
+    if squeezed:
+        q = q[:, :, None, :]
+    b, h, q_len, d = q.shape
+    if q_len != 1:
+        raise ValueError(
+            f"decode_attention is the q_len == 1 path, got q_len {q_len}; "
+            "use flash_attention for prefill")
+    if k.shape != v.shape or k.ndim != 4 or k.shape[0] != b \
+            or k.shape[3] != d:
+        raise ValueError(
+            f"k/v must be [b, kv_heads, S, d] = [{b}, *, *, {d}] and "
+            f"equal-shaped; got k {tuple(k.shape)} v {tuple(v.shape)}")
+    kvh, s_cache = k.shape[1], k.shape[2]
+    if kvh == 0 or h % kvh:
+        raise ValueError(
+            f"kv_heads ({kvh}) must divide query heads ({h})")
+    if lengths.shape != (b,):
+        raise ValueError(
+            f"lengths must be [{b}], got {tuple(lengths.shape)}")
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    lengths = lengths.astype(jnp.int32)
+
+    if use_kernel is None:
+        use_kernel = s_cache > decode_xla_max_seq(xla_max_seq)
+
+    if use_kernel:
+        group = h // kvh
+        if group > 1:
+            kb, vb = (jnp.broadcast_to(
+                t[:, :, None], (b, kvh, group, s_cache, d)
+            ).reshape(b, h, s_cache, d) for t in (k, v))
+        else:
+            kb, vb = k, v
+        mask = (jnp.arange(s_cache, dtype=jnp.int32)[None, None, None, :]
+                >= lengths[:, None, None, None])
+        out = flash_attention(q, kb, vb, mask=mask, sm_scale=scale,
+                              use_kernel=True)
+        return out[:, :, 0] if squeezed else out
+
+    # XLA path: grouped-query einsum chain, no kv broadcast materialized
+    group = h // kvh
+    qg = q.reshape(b, kvh, group, d)
+    s = jax.lax.dot_general(
+        qg, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32) * scale     # [b, kvh, group, S]
+    live = (jnp.arange(s_cache, dtype=jnp.int32)[None, None, None, :]
+            < lengths[:, None, None, None])
+    s = jnp.where(live, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    # length-0 slots: every score is _NEG_INF — emit 0, not uniform
+    p = jnp.where(m <= _MASKED_ROW_THRESH, 0.0, p)
+    out = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)             # [b, kvh, group, d]
+    out = out.reshape(b, h, 1, d).astype(q.dtype)
+    return out[:, :, 0] if squeezed else out

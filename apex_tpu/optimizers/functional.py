@@ -59,6 +59,7 @@ __all__ = [
     "fused_novograd",
     "fused_adagrad",
     "shard_flat_grads",
+    "export_params",
 ]
 
 
@@ -145,18 +146,53 @@ class FlatState:
         n = self.global_numel
         return flat[:n] if flat.shape[0] != n else flat
 
-    def params(self):
+    def params(self, dtype=None):
         """Materialize the params pytree (construction dtypes).
 
         This is the checkpoint/eval boundary — inside a jitted train
         step the unravel slices fuse into the consumer instead.  A
         sharded state all-gathers its master (in the construction
-        dtype, so bf16 params cost bf16 comm bytes)."""
+        dtype, so bf16 params cost bf16 comm bytes).
+
+        ``dtype`` is the inference-export knob: floating leaves are cast
+        to it after the unravel (``dtype=jnp.bfloat16`` is the serving
+        regime — the engine consumes bf16 weights regardless of how the
+        fp32 master was trained); integer leaves pass through."""
         if self.unravel is None:
             raise ValueError(
                 "FlatState was initialized from a flat buffer (no "
                 "unravel); call .master directly or init from a pytree")
-        return self.unravel(self._full_master(self.flat_dtype))
+        tree = self.unravel(self._full_master(self.flat_dtype))
+        return tree if dtype is None else _cast_floating(tree, dtype)
+
+
+def _cast_floating(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(jnp.result_type(x), jnp.floating) else x, tree)
+
+
+def export_params(flat, params_template, *, dtype=None):
+    """Inference weight export from a FULL flat master buffer.
+
+    ``flat`` is the reassembled fp32 master — ``FlatState.master`` for a
+    dense state, or the ``"master"`` entry of a contrib
+    ``DistributedFused*`` shard-aware ``state_dict()`` (written at ANY
+    dp; trailing ZeRO padding is sliced off here).  ``params_template``
+    supplies the leaf layout/dtypes (the model's ``init`` tree — shapes
+    only are read, values untouched); ``dtype`` optionally casts the
+    floating leaves for serving (bf16).
+    """
+    tmpl_flat, unravel = tree_ravel(params_template)
+    n = int(tmpl_flat.size)
+    flat = jnp.asarray(flat)
+    if flat.shape[0] < n:
+        raise ValueError(
+            f"flat master has {flat.shape[0]} elements < the template's "
+            f"{n} — wrong template, or a single SHARD was passed instead "
+            "of the reassembled full master")
+    tree = unravel(flat[:n].astype(tmpl_flat.dtype))
+    return tree if dtype is None else _cast_floating(tree, dtype)
 
 
 def shard_flat_grads(flat_grads: jax.Array, state: FlatState, *,

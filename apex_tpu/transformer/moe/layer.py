@@ -201,19 +201,26 @@ def compute_dispatch_indices(gates, expert_index, num_experts: int,
         num_experts, capacity), token_slot, token_gate)
 
 
-#: provisional auto-dispatch crossover (``dispatch_mode="auto"``):
-#: gather from this many experts, one-hot below.  Seeded from the two
-#: data points available (documented PROVISIONAL until a clean on-chip
-#: gather crossover lands — the r5 capture's gather timings collapsed
-#: into the tunnel RTT, ``us_gather: 0.0``):
+#: auto-dispatch crossover (``dispatch_mode="auto"``): gather from this
+#: many experts, one-hot below.  Pinned at 64, cross-checked against the
+#: r5/r6 capture record (PERF.md "MoE auto-dispatch policy" has the full
+#: numbers; the policy is also pinned literally in
+#: ``tests/L0/run_transformer/test_moe.py``):
+#:  * r5 on-chip ONE-HOT E-sweep ([8192 tok, h 1024, ffn 4096], top-2;
+#:    ``r5_watch_capture_001.json :: moe_dispatch_sweep``): 7722 us at
+#:    E=8, 3567 us at E=32, 7155 us at E=64 — total expert GEMM work is
+#:    E-independent at fixed top-k, so the ~2x jump from 32 to 64 is
+#:    the dispatch side degrading: the measured one-hot inflection
+#:    lands the crossover in (32, 64];
 #:  * the CPU-mesh sweep (E in {4..128}, tokens=256, h=64): gather won
 #:    at EVERY E (1.1-2.3x) — an upper bound on where gather can win,
 #:    since interpret-mode lacks the MXU advantage that makes the dense
-#:    [S,E,C] one-hot einsums cheap at small E on TPU;
-#:  * the r5 on-chip ONE-HOT E-sweep ([8192,1024,4096], top-2): step
-#:    time roughly doubled from E=32 (3567 us) to E=64 (7155 us) — the
-#:    O(S*E*C*h) dispatch/combine volume overtaking the E-independent
-#:    expert GEMM work right around Switch-scale expert counts.
+#:    [S,E,C] one-hot einsums cheap at small E on TPU, so it cannot
+#:    justify dropping the threshold below the measured inflection;
+#:  * r6 added no on-chip gather timings (the r5 gather legs collapsed
+#:    into tunnel RTT, ``us_gather: 0.0``, and were scrubbed; r6 chip
+#:    time went to the ZeRO captures) — a clean gather sweep could
+#:    still tighten 64 toward 33, but cannot move it above 64.
 _AUTO_GATHER_MIN_E = 64
 
 
@@ -290,10 +297,11 @@ class MoELayer(nn.Module):
     # dispatch (same routing, same drops) moving only O(E*C*h) rows —
     # wins at Switch-scale E; measured crossover in PERF.md /
     # moe_dispatch_sweep.  "auto" (the default) picks from the shape
-    # via :func:`resolve_dispatch_mode` — a PROVISIONAL expert-count
-    # threshold until the on-chip crossover lands (see
-    # ``_AUTO_GATHER_MIN_E``); both modes share one slot-assignment
-    # rule, so the choice changes data movement only, not routing.
+    # via :func:`resolve_dispatch_mode` — an expert-count threshold
+    # pinned at the r5-measured one-hot inflection (see
+    # ``_AUTO_GATHER_MIN_E``'s provenance note); both modes share one
+    # slot-assignment rule, so the choice changes data movement only,
+    # not routing.
     dispatch_mode: str = "auto"               # | "onehot" | "gather"
 
     def _expert_init(self, init: Callable) -> Callable:

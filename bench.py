@@ -692,6 +692,100 @@ def _microbench_llama(rtt: float, on_tpu: bool):
     return out
 
 
+def _microbench_infer(rtt: float, on_tpu: bool):
+    """Inference engine leg (ISSUE 4): prefill throughput + per-token
+    decode latency of the prefill/decode engine over the flagship GPT
+    shape.
+
+    Both phases time the REAL engine step functions (the same donated
+    executables ``InferenceEngine`` jits) iterated inside one scan:
+    prefill re-admits a full prompt into slot 0 each iteration; decode
+    carries (cache, tokens, step) so every iteration extends the
+    sequences exactly as serving does.  ``infer_decode_token_us`` is the
+    step latency — the time to hand every active slot its next token —
+    and ``infer_decode_tokens_per_s`` counts all ``slots`` streams."""
+    import numpy as np
+
+    from apex_tpu.inference import InferenceEngine
+    from apex_tpu.inference.engine import make_decode_fn, make_prefill_fn
+    from apex_tpu.inference.sampling import SamplingConfig
+    from apex_tpu.ops.attention import decode_xla_max_seq
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_attention_heads=16,
+                        max_seq_length=_ov("seq", 1024),
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        params_dtype=jnp.bfloat16)
+        slots, iters = _ov("slots", 8), _ov("iters", 16)
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_attention_heads=4, max_seq_length=128,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        slots, iters = 2, 2
+    max_seq = cfg.max_seq_length
+    prefill_len = max_seq // 2          # leaves decode headroom
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    model = gpt_model_provider(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jax.random.randint(jax.random.PRNGKey(0), (1, 8),
+                                           0, cfg.vocab_size))
+    engine = InferenceEngine("gpt", cfg, params, slots=slots,
+                             max_seq=max_seq)
+    sampling = SamplingConfig()                      # greedy
+    prefill_fn = make_prefill_fn("gpt", cfg, sampling)
+    decode_fn = make_decode_fn("gpt", cfg, sampling)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (prefill_len,),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    key = jax.random.PRNGKey(3)
+
+    # prefill: re-admit the prompt into slot 0 every iteration (cache
+    # carried, so the insert is a live donated update, not DCE'd)
+    def prefill_step(cache, batch):
+        tokens, key_ = batch
+        cache, _, _ = prefill_fn(cache, engine.params, tokens,
+                                 jnp.int32(0), jnp.int32(prefill_len),
+                                 key_, jnp.int32(0))
+        return cache
+
+    t_pre = _bench_loop(prefill_step, engine.init_cache(), (prompt, key),
+                        iters, rtt)
+
+    # decode: warm cache (every slot mid-sequence), then scan steps
+    cache = engine.init_cache()
+    for slot in range(slots):
+        cache, _, _ = engine.prefill(cache, np.asarray(prompt), slot)
+
+    def decode_step(state, batch):
+        cache, toks, step = state
+        active, key_ = batch
+        cache, toks, _ = decode_fn(cache, engine.params, toks, active,
+                                   key_, step)
+        return (cache, toks, step + 1)
+
+    state = (cache, jnp.zeros((slots,), jnp.int32), jnp.int32(0))
+    decode_iters = min(iters, max_seq - prefill_len - 1)
+    t_dec = _bench_loop(decode_step, state,
+                        (jnp.ones((slots,), bool), key),
+                        decode_iters, rtt)
+
+    return {"infer_prefill_tokens_per_s": round(prefill_len / t_pre.best,
+                                                1),
+            "infer_prefill_us": round(t_pre.best * 1e6, 1),
+            "infer_prefill_us_median": round(t_pre.median * 1e6, 1),
+            "infer_decode_token_us": round(t_dec.best * 1e6, 1),
+            "infer_decode_token_us_median": round(t_dec.median * 1e6, 1),
+            "infer_decode_tokens_per_s": round(slots / t_dec.best, 1),
+            "infer_shape": [slots, prefill_len, cfg.num_layers,
+                            cfg.hidden_size],
+            # crossover knob stamp (same contract as attn_xla_max_seq)
+            "infer_decode_xla_max_seq": decode_xla_max_seq()}
+
+
 MICRO_LEGS = {
     "adam": _microbench_adam,
     "ln": _microbench_layernorm,
@@ -700,6 +794,7 @@ MICRO_LEGS = {
     "moe": _microbench_moe,
     "bert": _microbench_bert,
     "llama": _microbench_llama,
+    "infer": _microbench_infer,
 }
 
 
@@ -942,7 +1037,7 @@ def _run_leg(mode: str, leg: str, timeout: float, key=None):
 # tunnel; each micro leg pays 1-2 smaller ones
 LEG_TIMEOUTS = [("main", 1500), ("bert", 1200), ("llama", 1200),
                 ("adam", 700), ("ln", 600), ("attn", 700), ("xent", 600),
-                ("moe", 900)]
+                ("moe", 900), ("infer", 900)]
 
 
 def _run_all_legs(mode: str, errors: list):
@@ -980,17 +1075,31 @@ def _run_all_legs(mode: str, errors: list):
 #: and must never be republished by the capture-history loader.
 _MAX_PLAUSIBLE_SPEEDUP = 100.0
 
+#: throughput sanity ceiling for ``*tokens_per_s`` capture fields.  The
+#: same RTT-collapse that produced ``flash_attn_us: 0.0`` turns a
+#: throughput field into tokens/(~0 s): a v5e streaming a transformer
+#: at > 1e8 tokens/s is not physics (the flagship GPT measures ~1.1e5;
+#: even the cheap MoE layer pass peaks ~2.3e6).  0 and negatives are
+#: the us==0.0 artifact's other face (tokens / garbage-negative time).
+_MAX_PLAUSIBLE_TOKENS_PER_S = 1e8
+
 
 def _is_us_key(key: str) -> bool:
     return key == "us" or key.endswith("_us") or key.startswith("us_")
 
 
+def _is_tokens_per_s_key(key: str) -> bool:
+    return key == "tokens_per_s" or key.endswith("_tokens_per_s")
+
+
 def _scrub_capture_values(obj):
     """Drop physically impossible values from a capture payload
-    (recursively): ``*_us``/``us_*`` fields that read exactly 0.0 and
-    ``*_speedup`` fields above ``_MAX_PLAUSIBLE_SPEEDUP``.  Returns a
-    scrubbed copy; containers are preserved, only the corrupt scalar
-    fields vanish."""
+    (recursively): ``*_us``/``us_*`` fields that read exactly 0.0 (the
+    RTT-collapse artifact — covers the decode-latency fields too),
+    ``*_speedup`` fields above ``_MAX_PLAUSIBLE_SPEEDUP``, and
+    ``*tokens_per_s`` throughputs that are non-positive or beyond
+    ``_MAX_PLAUSIBLE_TOKENS_PER_S``.  Returns a scrubbed copy;
+    containers are preserved, only the corrupt scalar fields vanish."""
     if isinstance(obj, dict):
         out = {}
         for k, v in obj.items():
@@ -1002,6 +1111,9 @@ def _scrub_capture_values(obj):
                     continue
                 if (k == "speedup" or k.endswith("_speedup")) \
                         and v > _MAX_PLAUSIBLE_SPEEDUP:
+                    continue
+                if _is_tokens_per_s_key(k) \
+                        and not 0.0 < v <= _MAX_PLAUSIBLE_TOKENS_PER_S:
                     continue
             out[k] = v
         return out
@@ -1022,7 +1134,9 @@ def _summarize_capture(name, payload):
     for k in ("mfu", "chip", "flash_attn_us", "adam_gbps",
               "layernorm_gbps", "xentropy_gbps", "moe_tokens_per_s",
               "bert_mfu", "bert_tokens_per_s",
-              "llama_mfu", "llama_tokens_per_s"):
+              "llama_mfu", "llama_tokens_per_s",
+              "infer_prefill_tokens_per_s", "infer_decode_tokens_per_s",
+              "infer_decode_token_us"):
         # falsy values are broken measurements (e.g. the pre-fix
         # flash_attn_us 0.0 RTT-collapse artifact) — don't republish
         if extras.get(k):

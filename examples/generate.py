@@ -1,0 +1,151 @@
+"""Text generation demo: the inference engine end to end.
+
+Builds a small standalone GPT or LLaMA (optionally trained for a few
+quick steps on cyclic synthetic data so greedy decoding has structure to
+reproduce), then serves a batch of prompts through the full stack —
+prefill into cache slots, continuous-batching decode, greedy or
+temperature/top-k sampling — and prints the generated token streams plus
+prefill/decode throughput.
+
+Runs anywhere::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/generate.py --model llama --kv-heads 2
+
+With ``--train-steps N`` the demo first trains next-token prediction on
+cyclic sequences (tok[i+1] = (tok[i] + 1) % vocab), so the generated
+continuations visibly count upward — a one-glance correctness check.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))                # repo root on sys.path
+
+from apex_tpu.inference import InferenceEngine, SamplingConfig
+from apex_tpu.optimizers import functional
+from apex_tpu import train_step
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import (
+    GPTConfig,
+    LlamaConfig,
+    gpt_model_provider,
+    llama_model_provider,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="apex_tpu generation demo")
+    p.add_argument("--model", choices=("gpt", "llama"), default="gpt")
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="llama only: < heads for GQA, 1 for MQA")
+    p.add_argument("--max-seq", type=int, default=128)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--prompts", type=int, default=6)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy")
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--train-steps", type=int, default=150,
+                   help="0 = serve random weights")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def build_model(args):
+    if args.model == "gpt":
+        cfg = GPTConfig(
+            vocab_size=args.vocab, hidden_size=args.hidden,
+            num_layers=args.layers, num_attention_heads=args.heads,
+            max_seq_length=args.max_seq, hidden_dropout=0.0,
+            attention_dropout=0.0)
+        return cfg, gpt_model_provider(cfg)
+    cfg = LlamaConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_attention_heads=args.heads,
+        num_kv_heads=args.kv_heads, max_seq_length=args.max_seq)
+    return cfg, llama_model_provider(cfg)
+
+
+def quick_train(model, params, args):
+    """A few flat-native fused-Adam steps on cyclic next-token data."""
+    rng = np.random.RandomState(args.seed)
+    seq = 32
+
+    def loss_fn(p, batch):
+        return model.apply(p, batch["tokens"], batch["labels"])
+
+    tx = functional.fused_adam(lr=1e-2)
+    state = train_step.init_train_state(tx, params)
+    run = train_step.train_loop(loss_fn, tx)
+    starts = rng.randint(0, args.vocab, size=(args.train_steps, 8, 1))
+    tokens = (starts + np.arange(seq)[None, None, :]) % args.vocab
+    batches = {"tokens": jnp.asarray(tokens, jnp.int32),
+               "labels": jnp.asarray(np.roll(tokens, -1, axis=2),
+                                     jnp.int32)}
+    state, losses = run(state, batches)
+    print(f"trained {args.train_steps} steps: loss "
+          f"{float(losses[0]):.3f} -> {float(losses[-1]):.3f}")
+    # the checkpoint boundary the engine consumes: bf16 export off the
+    # fp32 flat master
+    return state
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cfg, model = build_model(args)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        jnp.zeros((1, 8), jnp.int32))
+
+    sampling = SamplingConfig(temperature=args.temperature,
+                              top_k=args.top_k)
+    if args.train_steps:
+        state = quick_train(model, params, args)
+        engine = InferenceEngine.from_train_state(
+            args.model, cfg, state, slots=args.slots,
+            max_seq=args.max_seq, sampling=sampling, seed=args.seed)
+    else:
+        engine = InferenceEngine(args.model, cfg, params,
+                                 slots=args.slots, max_seq=args.max_seq,
+                                 dtype=jnp.bfloat16, sampling=sampling,
+                                 seed=args.seed)
+
+    rng = np.random.RandomState(args.seed + 1)
+    prompts = []
+    for _ in range(args.prompts):
+        start = rng.randint(0, args.vocab)
+        n = rng.randint(4, 12)
+        prompts.append([(start + i) % args.vocab for i in range(n)])
+
+    t0 = time.perf_counter()
+    outs = engine.generate(prompts, max_new_tokens=args.max_new_tokens)
+    dt = time.perf_counter() - t0
+    n_new = sum(len(o) for o in outs)
+    for p, o in zip(prompts, outs):
+        print(f"  prompt {p} -> {o}")
+    print(f"{n_new} tokens in {dt:.2f}s "
+          f"({n_new / dt:.1f} tok/s incl. compile)")
+    if args.train_steps and args.temperature == 0.0:
+        want = [[(p[-1] + 1 + i) % args.vocab
+                 for i in range(len(o))] for p, o in zip(prompts, outs)]
+        hits = sum(o == w for o, w in zip(outs, want))
+        print(f"cyclic continuation reproduced on {hits}/{len(outs)} "
+              f"prompts")
+
+
+if __name__ == "__main__":
+    main()

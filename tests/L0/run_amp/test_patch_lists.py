@@ -182,6 +182,91 @@ def test_wrap_optimizer_clears_cache(handle):
     assert not torch.equal(cast_after, cast_before)
 
 
+# ---- reference-table parity sweep (einsum / RNN family / promote) ---------
+# Each category asserted END TO END through public torch surfaces; the
+# remaining intentional-only deltas are documented in
+# apex_tpu/amp/lists/__init__.py.
+
+def test_einsum_runs_half(handle):
+    a = torch.randn(4, 5)
+    b = torch.randn(5, 6)
+    out = torch.einsum("ij,jk->ik", a, b)     # equation string untouched
+    assert out.dtype == torch.bfloat16
+    expect = (a.to(torch.bfloat16) @ b.to(torch.bfloat16))
+    assert torch.equal(out, expect)
+
+
+def test_einsum_weight_cast_is_cached(handle):
+    w = torch.randn(4, 4, requires_grad=True)
+    x = torch.randn(4, 4)
+    torch.einsum("ij,jk->ik", w, x)
+    assert len(handle.cache) == 1             # leaf param memoized
+    torch.einsum("ij,jk->ik", w, x)
+    assert len(handle.cache) == 1
+
+
+def test_mean_std_var_run_float(handle):
+    x = torch.randn(16).to(torch.bfloat16)
+    assert torch.mean(x).dtype == torch.float32
+    assert torch.std(x).dtype == torch.float32
+    assert torch.var(x).dtype == torch.float32
+    assert x.mean().dtype == torch.float32    # tensor-method list too
+    assert x.std().dtype == torch.float32
+
+
+def test_lstm_module_runs_half(handle):
+    """nn.LSTM dispatches through the patched _VF entry: fp32 module +
+    fp32 input run the fused RNN in bf16 end to end."""
+    torch.manual_seed(0)
+    lstm = torch.nn.LSTM(8, 16, batch_first=True)
+    x = torch.randn(2, 5, 8)
+    out, (h, c) = lstm(x)
+    assert out.dtype == torch.bfloat16
+    assert h.dtype == torch.bfloat16 and c.dtype == torch.bfloat16
+    # weights are leaf params: the casts are memoized in the handle
+    assert len(handle.cache) == len(lstm._flat_weights)
+
+
+def test_gru_and_rnn_cells_run_half(handle):
+    cell = torch.nn.GRUCell(8, 16)
+    h = cell(torch.randn(3, 8))
+    assert h.dtype == torch.bfloat16
+    rnn_cell = torch.nn.RNNCell(8, 16)
+    assert rnn_cell(torch.randn(3, 8)).dtype == torch.bfloat16
+
+
+def test_rnn_patch_restored_on_deactivate():
+    import torch.nn.modules.rnn as rnn_mod
+
+    h = amp_mod.init()
+    try:
+        lstm = torch.nn.LSTM(4, 4, batch_first=True)
+        assert lstm(torch.randn(1, 3, 4))[0].dtype == torch.bfloat16
+    finally:
+        h._deactivate()
+    assert not hasattr(rnn_mod._VF.lstm, "_amp_original")
+    lstm = torch.nn.LSTM(4, 4, batch_first=True)
+    assert lstm(torch.randn(1, 3, 4))[0].dtype == torch.float32
+
+
+def test_named_inplace_promote_matches_arg0(handle):
+    """The as_inplace expansion of the promote list: x.add_(fp32) on a
+    bf16 tensor keeps x's dtype and storage (match-arg0, not widest)."""
+    x = torch.zeros(8, dtype=torch.bfloat16)
+    alias = x
+    x.add_(torch.ones(8))                     # fp32 operand cast DOWN
+    assert x.dtype == torch.bfloat16
+    assert x is alias and torch.all(alias == 1.0)
+    y = torch.full((4,), 2.0)                 # fp32 self wins upward too
+    y.mul_(torch.full((4,), 3.0, dtype=torch.bfloat16))
+    assert y.dtype == torch.float32
+    assert torch.all(y == 6.0)
+    z = torch.ones(4, dtype=torch.bfloat16)
+    z.addcmul_(torch.ones(4), torch.full((4,), 2.0), value=2.0)
+    assert z.dtype == torch.bfloat16
+    assert torch.all(z == 5.0)
+
+
 # ---- user decorators / registration (torch + jax) --------------------------
 
 def test_half_function_decorator_torch(handle):

@@ -1,0 +1,132 @@
+"""Slot KV cache semantics: insert/append/advance/evict as pure donated
+updates over one statically shaped buffer pair."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.inference import kv_cache
+
+SLOTS, LAYERS, KVH, MAXSEQ, D = 3, 2, 2, 16, 8
+
+
+def _cache(dtype=jnp.float32):
+    return kv_cache.init_cache(SLOTS, LAYERS, KVH, MAXSEQ, D, dtype=dtype)
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape),
+                       jnp.float32)
+
+
+def test_init_shape_and_dtype():
+    c = _cache(jnp.bfloat16)
+    assert c.k.shape == (SLOTS, LAYERS, KVH, MAXSEQ, D)
+    assert c.k.dtype == jnp.bfloat16 and c.v.dtype == jnp.bfloat16
+    assert c.lengths.dtype == jnp.int32
+    assert (c.slots, c.layers, c.kv_heads, c.max_seq, c.head_dim) == \
+        (SLOTS, LAYERS, KVH, MAXSEQ, D)
+    assert np.all(np.asarray(c.lengths) == 0)
+
+
+def test_insert_places_slab_and_sets_length():
+    c = _cache()
+    k = _rand((LAYERS, KVH, 5, D), 1)
+    v = _rand((LAYERS, KVH, 5, D), 2)
+    c = kv_cache.insert(c, 1, k, v, 4)          # padded to 5, 4 real
+    np.testing.assert_array_equal(np.asarray(c.k[1, :, :, :5]),
+                                  np.asarray(k))
+    np.testing.assert_array_equal(np.asarray(c.v[1, :, :, :5]),
+                                  np.asarray(v))
+    assert np.asarray(c.lengths).tolist() == [0, 4, 0]
+    # other slots untouched
+    assert np.all(np.asarray(c.k[0]) == 0) and np.all(
+        np.asarray(c.k[2]) == 0)
+
+
+def test_insert_validates():
+    c = _cache()
+    with pytest.raises(ValueError, match="prefill k/v"):
+        kv_cache.insert(c, 0, _rand((LAYERS, KVH + 1, 4, D)),
+                        _rand((LAYERS, KVH + 1, 4, D)), 4)
+    with pytest.raises(ValueError, match="max_seq"):
+        kv_cache.insert(c, 0, _rand((LAYERS, KVH, MAXSEQ + 1, D)),
+                        _rand((LAYERS, KVH, MAXSEQ + 1, D)), 4)
+
+
+def test_append_writes_at_each_slots_own_length():
+    c = _cache()
+    c = kv_cache.insert(c, 0, _rand((LAYERS, KVH, 3, D), 1),
+                        _rand((LAYERS, KVH, 3, D), 2), 3)
+    c = kv_cache.insert(c, 2, _rand((LAYERS, KVH, 6, D), 3),
+                        _rand((LAYERS, KVH, 6, D), 4), 6)
+    k_tok = _rand((SLOTS, KVH, D), 5)
+    v_tok = _rand((SLOTS, KVH, D), 6)
+    for layer in range(LAYERS):
+        c = kv_cache.append_layer(c, layer, k_tok, v_tok)
+    # token rows landed at positions (3, 0, 6) per slot, in EVERY layer
+    for slot, pos in ((0, 3), (1, 0), (2, 6)):
+        want_k = np.broadcast_to(np.asarray(k_tok[slot]),
+                                 (LAYERS, KVH, D))
+        want_v = np.broadcast_to(np.asarray(v_tok[slot]),
+                                 (LAYERS, KVH, D))
+        np.testing.assert_array_equal(np.asarray(c.k[slot, :, :, pos]),
+                                      want_k)
+        np.testing.assert_array_equal(np.asarray(c.v[slot, :, :, pos]),
+                                      want_v)
+    # lengths only move via advance, and only for active slots
+    c = kv_cache.advance(c, jnp.asarray([True, False, True]))
+    assert np.asarray(c.lengths).tolist() == [4, 0, 7]
+
+
+def test_append_validates():
+    c = _cache()
+    with pytest.raises(ValueError, match="token k/v"):
+        kv_cache.append_layer(c, 0, _rand((SLOTS, KVH, D + 1)),
+                              _rand((SLOTS, KVH, D + 1)))
+
+
+def test_evict_zeroes_length_only():
+    c = _cache()
+    k = _rand((LAYERS, KVH, 4, D), 1)
+    c = kv_cache.insert(c, 1, k, k, 4)
+    c = kv_cache.evict(c, 1)
+    assert np.asarray(c.lengths).tolist() == [0, 0, 0]
+    # data untouched (masked by length; next insert overwrites)
+    np.testing.assert_array_equal(np.asarray(c.k[1, :, :, :4]),
+                                  np.asarray(k))
+
+
+def test_updates_are_donation_safe():
+    """The whole insert+append+advance chain jits with the cache donated
+    — the serving property: one allocation for the engine's lifetime."""
+
+    def step(c, k_slab, k_tok):
+        c = kv_cache.insert(c, 0, k_slab, k_slab, 4)
+        for layer in range(LAYERS):
+            c = kv_cache.append_layer(c, layer, k_tok, k_tok)
+        return kv_cache.advance(c, jnp.ones((SLOTS,), bool))
+
+    c = _cache()
+    kbuf = c.k
+    slab = _rand((LAYERS, KVH, 4, D), 1)
+    tok = _rand((SLOTS, KVH, D), 2)
+    c2 = jax.jit(step, donate_argnums=(0,))(c, slab, tok)
+    jax.block_until_ready(c2)
+    assert kbuf.is_deleted()                 # buffer actually reused
+    assert np.asarray(c2.lengths).tolist() == [5, 1, 1]
+
+
+def test_cache_is_scan_carryable():
+    """Treedef stable across updates: a KVCache is a valid lax.scan
+    carry (the bench/decode-loop shape)."""
+
+    def body(c, tok):
+        for layer in range(LAYERS):
+            c = kv_cache.append_layer(c, layer, tok, tok)
+        return kv_cache.advance(c, jnp.ones((SLOTS,), bool)), c.lengths
+
+    toks = _rand((4, SLOTS, KVH, D), 7)
+    c, hist = jax.lax.scan(body, _cache(), toks)
+    assert np.asarray(c.lengths).tolist() == [4, 4, 4]
+    assert hist.shape == (4, SLOTS)

@@ -1,0 +1,77 @@
+"""Sampling: greedy/temperature/top-k semantics + key discipline."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.inference.sampling import (
+    SamplingConfig,
+    greedy,
+    sample_token,
+)
+
+
+def _logits(seed=0, rows=4, vocab=32):
+    return jnp.asarray(np.random.RandomState(seed).randn(rows, vocab),
+                       jnp.float32)
+
+
+def test_greedy_is_argmax():
+    lg = _logits()
+    toks = greedy(lg)
+    assert toks.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.argmax(np.asarray(lg), axis=-1))
+
+
+def test_default_config_is_greedy_and_ignores_key():
+    cfg = SamplingConfig()
+    assert cfg.is_greedy
+    lg = _logits()
+    a = sample_token(lg, jax.random.PRNGKey(0), cfg)
+    b = sample_token(lg, jax.random.PRNGKey(99), cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(greedy(lg)))
+
+
+def test_sampled_deterministic_per_key_and_key_sensitive():
+    cfg = SamplingConfig(temperature=1.0)
+    lg = _logits(rows=64)
+    k = jax.random.PRNGKey(1)
+    a = sample_token(lg, k, cfg)
+    b = sample_token(lg, k, cfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = sample_token(lg, jax.random.PRNGKey(2), cfg)
+    assert np.any(np.asarray(a) != np.asarray(c))
+
+
+def test_top_k_restricts_support():
+    cfg = SamplingConfig(temperature=1.0, top_k=3)
+    lg = _logits(rows=16, vocab=32)
+    top3 = np.argsort(np.asarray(lg), axis=-1)[:, -3:]
+    for i in range(50):
+        toks = np.asarray(sample_token(
+            lg, jax.random.PRNGKey(i), cfg))
+        for row, t in enumerate(toks):
+            assert t in top3[row], (row, t)
+
+
+def test_low_temperature_approaches_greedy():
+    cfg = SamplingConfig(temperature=1e-4)
+    lg = _logits()
+    toks = sample_token(lg, jax.random.PRNGKey(0), cfg)
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.asarray(greedy(lg)))
+
+
+def test_config_is_static_and_hashable():
+    # jit closure requirement: the config must hash (frozen dataclass)
+    assert hash(SamplingConfig(temperature=0.7, top_k=5)) is not None
+    assert SamplingConfig() == SamplingConfig(temperature=0.0, top_k=0)
+
+
+def test_config_rejects_nonsense():
+    import pytest
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingConfig(temperature=-0.7)   # would invert the dist
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingConfig(temperature=1.0, top_k=-1)

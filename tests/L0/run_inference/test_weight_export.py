@@ -1,0 +1,152 @@
+"""Checkpoint -> inference round-trip (ISSUE 4 satellite): a contrib
+``state_dict`` written at dp=4 loads into engine weights identical to a
+dense (dp=1) export, and a ZeRO-sharded FlatState exports the same
+params as its dense twin."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import DistributedFusedAdam
+from apex_tpu.inference import InferenceEngine
+from apex_tpu.optimizers import functional as fopt
+from apex_tpu.optimizers.functional import export_params
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+DP = 4
+
+
+def _gpt():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_attention_heads=2, max_seq_length=32,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = gpt_model_provider(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return cfg, model, params
+
+
+def _grads_like(params, seed=1):
+    flat, unravel = jax.flatten_util.ravel_pytree(params)
+    g = jax.random.normal(jax.random.PRNGKey(seed), flat.shape,
+                          flat.dtype) * 1e-2
+    return unravel(g)
+
+
+def _train_contrib(params, grads, dp, n_steps=2):
+    """n_steps of DistributedFusedAdam at the given dp; returns the
+    optimizer and the GLOBAL-view state (state_dict-ready)."""
+    opt = DistributedFusedAdam(dp, lr=1e-2, weight_decay=0.01)
+    if dp == 1:
+        state = opt.init_state(params)
+        for _ in range(n_steps):
+            _, state = opt.step(state, grads)
+        return opt, state
+    mesh = Mesh(np.array(jax.devices()[:dp]), ("data",))
+
+    def body():
+        state = opt.init_state(params)
+        for _ in range(n_steps):
+            _, state = opt.step(state, grads)
+        return state
+
+    specs = {"step": P(), "master": P("data"), "exp_avg": P("data"),
+             "exp_avg_sq": P("data")}
+    state = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(), out_specs=specs))()
+    return opt, state
+
+
+def test_contrib_dp4_state_dict_equals_dense_export():
+    """The satellite's literal claim: dp=4 checkpoint -> engine weights
+    identical (bitwise) to the dp=1 export."""
+    cfg, model, params = _gpt()
+    grads = _grads_like(params)
+    opt4, state4 = _train_contrib(params, grads, DP)
+    opt1, state1 = _train_contrib(params, grads, 1)
+    sd4, sd1 = opt4.state_dict(state4), opt1.state_dict(state1)
+    # same training trajectory: masters agree to fp tolerance...
+    np.testing.assert_allclose(sd4["master"], sd1["master"],
+                               rtol=1e-6, atol=1e-7)
+    # ...and the EXPORT path is bitwise-identical given equal masters:
+    # run both state_dicts through the engine weight boundary
+    e4 = export_params(sd4["master"], params, dtype=jnp.bfloat16)
+    e1 = export_params(sd1["master"], params, dtype=jnp.bfloat16)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a, np.float32), np.asarray(b, np.float32)), e4, e1)
+
+    # and both restore straight into a working engine with equal output
+    eng4 = InferenceEngine.from_state_dict("gpt", cfg, sd4, params,
+                                           slots=1, max_seq=32)
+    eng1 = InferenceEngine.from_state_dict("gpt", cfg, sd1, params,
+                                           slots=1, max_seq=32)
+    prompt = [3, 1, 4, 1, 5]
+    assert eng4.generate([prompt], max_new_tokens=4) == \
+        eng1.generate([prompt], max_new_tokens=4)
+
+
+def test_export_params_layout_and_padding():
+    _, _, params = _gpt()
+    flat, _ = jax.flatten_util.ravel_pytree(params)
+    # ZeRO padding on the tail must be sliced off
+    padded = jnp.concatenate([flat, jnp.zeros((13,), flat.dtype)])
+    tree = export_params(padded, params)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), tree, params)
+    # bf16 export casts floating leaves only
+    tree16 = export_params(padded, params, dtype=jnp.bfloat16)
+    for leaf in jax.tree.leaves(tree16):
+        assert leaf.dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="SHARD"):
+        export_params(flat[:100], params)
+
+
+def test_flat_state_params_dtype_export():
+    """``FlatState.params(dtype=...)`` — the TrainState -> engine
+    boundary — casts floating leaves and leaves values = master."""
+    cfg, model, params = _gpt()
+    tx = fopt.fused_adam(lr=1e-2)
+    state = tx.init(params)
+    out = state.params(dtype=jnp.bfloat16)
+    for leaf in jax.tree.leaves(out):
+        assert leaf.dtype == jnp.bfloat16
+    ref = state.params()
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        rtol=1e-2, atol=1e-2), out, ref)
+    # the engine classmethod accepts the TrainState shape end to end
+    from apex_tpu import train_step
+    ts = train_step.init_train_state(tx, params)
+    eng = InferenceEngine.from_train_state("gpt", cfg, ts, slots=1,
+                                           max_seq=32)
+    toks = eng.generate([[1, 2, 3]], max_new_tokens=3)[0]
+    assert len(toks) == 3
+
+
+def test_zero_sharded_flat_state_exports_like_dense():
+    """A dp-sharded FlatState (ZeRO) all-gathers into the same exported
+    params as the dense state — the 'checkpoint at any dp' property at
+    the FlatState level."""
+    _, _, params = _gpt()
+    tx = fopt.fused_adam(lr=1e-2)
+    dense = tx.init(params)
+    mesh = Mesh(np.array(jax.devices()[:DP]), ("data",))
+
+    def body():
+        st = tx.init(params, shard=("data", DP))
+        return st.master
+
+    shards = jax.jit(functools.partial(jax.shard_map, check_vma=False)(
+        body, mesh=mesh, in_specs=(), out_specs=P("data")))()
+    sharded = tx.init(params, shard=("data", DP, 0)).replace(
+        master=shards)          # global view, shard layout stamped
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a, np.float32), np.asarray(b, np.float32)),
+        sharded.params(dtype=jnp.bfloat16),
+        dense.params(dtype=jnp.bfloat16))

@@ -863,17 +863,23 @@ def test_aux_losses_uniform_routing():
 
 def test_dispatch_mode_auto_policy():
     """``dispatch_mode="auto"`` resolves from the shape: one-hot below
-    the provisional Switch-scale threshold, gather at/above it;
-    explicit modes pass through untouched (the policy is documented
-    provisional until the on-chip crossover lands)."""
+    the pinned Switch-scale threshold, gather at/above it; explicit
+    modes pass through untouched.
+
+    The threshold is pinned LITERALLY (not symbolically): 64 is the
+    r5-measured on-hot inflection (one-hot step time 3567 us at E=32 ->
+    7155 us at E=64 on E-independent expert GEMM work; PERF.md "MoE
+    auto-dispatch policy").  Moving it is a policy change that must
+    come with new capture data, so this test fails on a silent edit."""
     from apex_tpu.transformer.moe import resolve_dispatch_mode
     from apex_tpu.transformer.moe.layer import _AUTO_GATHER_MIN_E
 
+    assert _AUTO_GATHER_MIN_E == 64        # provenance: r5 one-hot sweep
     assert resolve_dispatch_mode("auto", 8, 256, 64, 64) == "onehot"
-    assert resolve_dispatch_mode(
-        "auto", _AUTO_GATHER_MIN_E, 256, 64, 64) == "gather"
-    assert resolve_dispatch_mode(
-        "auto", 4 * _AUTO_GATHER_MIN_E, 256, 64, 64) == "gather"
+    assert resolve_dispatch_mode("auto", 32, 8192, 640, 1024) == "onehot"
+    assert resolve_dispatch_mode("auto", 63, 256, 64, 64) == "onehot"
+    assert resolve_dispatch_mode("auto", 64, 8192, 320, 1024) == "gather"
+    assert resolve_dispatch_mode("auto", 256, 256, 64, 64) == "gather"
     # explicit modes are never second-guessed by the policy
     assert resolve_dispatch_mode("onehot", 512, 256, 64, 64) == "onehot"
     assert resolve_dispatch_mode("gather", 2, 256, 64, 64) == "gather"

@@ -138,4 +138,17 @@ class TestAutocastUtils:
 def test_rnn_compat_probe():
     from apex_tpu.amp import rnn_compat
     assert rnn_compat.has_old_rnns() is False
-    rnn_compat.whitelist_rnn_cells(None)
+    # since the O1 list-parity sweep the modern _VF dispatch point IS
+    # patched (no longer a no-op): probe it, and exercise the patch
+    # through a real handle (end-to-end cast coverage lives in
+    # tests/L0/run_amp/test_patch_lists.py)
+    assert rnn_compat.has_vf_rnns() is True
+    import torch.nn.modules.rnn as rnn_mod
+
+    from apex_tpu.amp import amp as amp_mod
+    h = amp_mod.init()
+    try:
+        assert hasattr(rnn_mod._VF.lstm, "_amp_original")
+    finally:
+        h._deactivate()
+    assert not hasattr(rnn_mod._VF.lstm, "_amp_original")

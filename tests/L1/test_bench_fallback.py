@@ -95,6 +95,35 @@ def test_capture_scrubber_rejects_impossible_values():
     assert "flash_attn_us" not in hist
 
 
+def test_capture_scrubber_covers_inference_fields():
+    """ISSUE 4 satellite: the tokens/sec and decode-latency fields the
+    infer leg emits get the same hygiene — 0.0 µs latencies and
+    non-physical throughputs (<= 0 or beyond the 1e8 ceiling) vanish;
+    plausible values survive untouched."""
+    payload = {
+        "infer_decode_token_us": 0.0,              # RTT collapse
+        "infer_decode_token_us_median": 812.5,     # plausible
+        "infer_decode_tokens_per_s": 9.8e9,        # tokens / ~0 s
+        "infer_prefill_tokens_per_s": -3.0,        # tokens / negative
+        "infer_prefill_us": 4402.1,
+        "nested": [{"tokens_per_s": 0.0, "us": 11.0},
+                   {"tokens_per_s": 123456.0}],
+        "bert_tokens_per_s": 36353.9,              # existing field OK
+        "infer_shape": [8, 512, 8, 1024],          # not a measurement
+    }
+    out = bench._scrub_capture_values(payload)
+    assert "infer_decode_token_us" not in out
+    assert "infer_decode_tokens_per_s" not in out
+    assert "infer_prefill_tokens_per_s" not in out
+    assert out["infer_decode_token_us_median"] == 812.5
+    assert out["infer_prefill_us"] == 4402.1
+    assert "tokens_per_s" not in out["nested"][0]
+    assert out["nested"][0]["us"] == 11.0
+    assert out["nested"][1]["tokens_per_s"] == 123456.0
+    assert out["bert_tokens_per_s"] == 36353.9
+    assert out["infer_shape"] == [8, 512, 8, 1024]
+
+
 def test_degraded_capture_carries_value_tpu_best_top_level():
     """The recorded on-chip throughput must surface as a first-class
     top-level sibling of `value` on the degraded path — and never on the
