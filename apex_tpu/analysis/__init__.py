@@ -1,26 +1,42 @@
 """apex_tpu.analysis — JAX-aware static analysis.
 
-Two engines (see README "Static analysis"):
+Three engines (see README "Static analysis"):
 
 * :mod:`~apex_tpu.analysis.lint` — AST rules over the whole package
   (host syncs under jit, PRNG key reuse, traced Python branching,
-  missing donation, fp32-defaulting factories, prints under trace).
+  missing donation, fp32-defaulting factories, prints under trace,
+  hardcoded axis names, unregistered env knobs, collectives in
+  per-process branches).
 * :mod:`~apex_tpu.analysis.jaxpr_audit` — traces each public fused op
   under a declared bf16 precision policy and asserts jaxpr invariants
   (no unexplained bf16→fp32 upcasts, no host callbacks / transfers in
   kernel bodies, output dtypes match the policy).
+* :mod:`~apex_tpu.analysis.spmd_audit` — walks the registered
+  multi-device executables (train steps, DDP, TP, pipeline,
+  ring/Ulysses, MoE, inference) checking collective/axis soundness,
+  cond-branch collective parity, replica-uniform control values,
+  donation against the lowered executables, and the comm/HBM budget
+  ledger (:mod:`~apex_tpu.analysis.comm_model`) ratcheted by
+  ``.analysis_budget.json``.
 
 CLI: ``python -m apex_tpu.analysis`` or the ``apex-tpu-analyze`` entry
-point; findings are gated by ``.analysis_baseline.json`` so only NEW
-violations fail the run.
+point (``--spmd`` adds the third engine); findings are gated by
+``.analysis_baseline.json`` so only NEW violations fail the run.
 """
 from apex_tpu.analysis.finding import Finding
 from apex_tpu.analysis.lint import lint_paths, lint_source
 
-__all__ = ["Finding", "lint_paths", "lint_source", "run_jaxpr_audit"]
+__all__ = ["Finding", "lint_paths", "lint_source", "run_jaxpr_audit",
+           "run_spmd_audit"]
 
 
 def run_jaxpr_audit(*args, **kwargs):
     """Lazy proxy — the auditor imports jax, the linter doesn't need to."""
     from apex_tpu.analysis.jaxpr_audit import run_jaxpr_audit as _run
+    return _run(*args, **kwargs)
+
+
+def run_spmd_audit(*args, **kwargs):
+    """Lazy proxy — the SPMD auditor imports jax and binds meshes."""
+    from apex_tpu.analysis.spmd_audit import run_spmd_audit as _run
     return _run(*args, **kwargs)

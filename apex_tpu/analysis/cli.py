@@ -1,12 +1,19 @@
 """``python -m apex_tpu.analysis`` / ``apex-tpu-analyze`` entry point.
 
-Runs both engines over the package, subtracts the committed baseline
+Runs the engines over the package, subtracts the committed baseline
 (``.analysis_baseline.json``), and exits nonzero only on NEW findings —
 the ratchet pattern: pre-existing debt is pinned, regressions fail CI.
+``--spmd`` adds the SPMD soundness auditor + the comm/HBM budget
+ledger, ratcheted against the committed ``.analysis_budget.json``
+(exit nonzero only when a registered executable's collective bytes or
+peak-live estimate GROWS).
 
     apex-tpu-analyze                       # lint + jaxpr audit, baseline-gated
+    apex-tpu-analyze --spmd                # + SPMD audit, budget-gated
+    apex-tpu-analyze --spmd --json         # machine-readable (schema: README)
     apex-tpu-analyze path/ other.py        # restrict lint to paths
     apex-tpu-analyze --write-baseline      # re-pin current findings
+    apex-tpu-analyze --spmd --write-budget # re-pin the comm/HBM ledger
     apex-tpu-analyze --no-baseline         # show everything, exit 1 if any
     apex-tpu-analyze --list-rules
 """
@@ -79,6 +86,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the jaxpr precision/transfer audit")
     p.add_argument("--ops", default=None,
                    help="comma-separated op names for the jaxpr audit")
+    p.add_argument("--spmd", action="store_true",
+                   help="run the SPMD soundness auditor + comm/HBM "
+                        "budget ledger over the registered multi-device "
+                        "executables")
+    p.add_argument("--execs", default=None,
+                   help="comma-separated executable names for the SPMD "
+                        "audit (default: all registered)")
+    p.add_argument("--budget", type=Path, default=None,
+                   help="comm/HBM ledger file (default: "
+                        "<root>/.analysis_budget.json)")
+    p.add_argument("--write-budget", action="store_true",
+                   help="pin the current comm/HBM ledger as the new "
+                        "budget (implies --spmd)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true")
@@ -102,7 +122,29 @@ def main(argv: Optional[list] = None) -> int:
               "device_put in a fused op body")
         print("APX203 output-dtype-policy         jaxpr audit: op output "
               "dtype violates the declared policy")
+        print("APX210 spmd-trace-failure          spmd audit: executable "
+              "failed to trace/lower")
+        print("APX211 unsound-collective-axis     spmd audit: collective "
+              "axis not in parallel_state's mesh / not bound")
+        print("APX212 branch-collective-mismatch  spmd audit: cond/switch "
+              "branches carry different collective multisets")
+        print("APX213 non-uniform-control-value   spmd audit: rank-varying "
+              "cond predicate / update-kernel noop_flag")
+        print("APX214 donation-violation          spmd audit: declared "
+              "donation not lowered, unaliasable, or missing")
+        print("APX215 budget-growth               spmd audit: comm bytes / "
+              "peak-live estimate grew past .analysis_budget.json")
+        print("APX216 comm-identity-violation     spmd audit: ZeRO "
+              "RS+AG==AR accounting broken (PERF.md round-6)")
         return 0
+
+    if args.write_budget:
+        args.spmd = True
+    if args.spmd:
+        # must run before ANY engine touches the backend: the audit
+        # binds 2-device meshes, which need the forced host devices
+        from apex_tpu.analysis.spmd_audit import ensure_devices
+        ensure_devices()
 
     root = repo_root()
     findings: list = []
@@ -119,6 +161,37 @@ def main(argv: Optional[list] = None) -> int:
         from apex_tpu.analysis.jaxpr_audit import run_jaxpr_audit
         ops = args.ops.split(",") if args.ops else None
         findings.extend(run_jaxpr_audit(ops))
+
+    spmd_report = None
+    if args.spmd:
+        from apex_tpu.analysis.spmd_audit import (BUDGET_NAME,
+                                                  compare_budget,
+                                                  run_spmd_audit)
+        execs = args.execs.split(",") if args.execs else None
+        spmd_findings, spmd_report = run_spmd_audit(execs)
+        findings.extend(spmd_findings)
+        budget_path = args.budget or (root / BUDGET_NAME)
+        if args.write_budget:
+            # a filtered run must not replace the shared full ledger —
+            # same protection as --write-baseline below
+            if execs and args.budget is None:
+                print("apex-tpu-analyze: refusing --write-budget for a "
+                      "restricted --execs run targeting the shared "
+                      f"{BUDGET_NAME}; pass --budget <file> or run all "
+                      "executables", file=sys.stderr)
+                return 2
+            budget_path.write_text(
+                json.dumps(spmd_report, indent=1) + "\n",
+                encoding="utf-8")
+            # stderr under --json: stdout must stay one parseable object
+            print(f"budget written: {budget_path} "
+                  f"({len(spmd_report['executables'])} executable(s) "
+                  f"pinned)",
+                  file=sys.stderr if args.as_json else sys.stdout)
+        else:
+            committed = (json.loads(budget_path.read_text(
+                encoding="utf-8")) if budget_path.is_file() else None)
+            findings.extend(compare_budget(spmd_report, committed))
 
     baseline_path = args.baseline or (root / BASELINE_NAME)
     if args.write_baseline:
@@ -146,11 +219,14 @@ def main(argv: Optional[list] = None) -> int:
     suppressed = len(findings) - len(new)
 
     if args.as_json:
-        print(json.dumps({
+        out = {
             "new": [f.__dict__ for f in new],
             "suppressed": suppressed,
             "total": len(findings),
-        }, indent=1))
+        }
+        if spmd_report is not None:
+            out["budget"] = spmd_report
+        print(json.dumps(out, indent=1))
     else:
         if not args.quiet:
             for f in new:

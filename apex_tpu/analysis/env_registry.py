@@ -1,0 +1,60 @@
+"""The single registry of ``APEX_TPU_*`` environment knobs.
+
+Every environment variable the package reads MUST have an entry here:
+the APX108 lint rule flags any ``os.environ``/``os.getenv`` read of an
+``APEX_TPU_``-prefixed name that is not registered, and the README
+"Environment knobs" table is validated against this dict by
+``tests/L0/run_analysis/test_env_registry.py`` — so the docs cannot
+drift from the code, and a new knob cannot ship undocumented.
+
+To add a knob: read it in code, add an :class:`EnvKnob` entry here,
+and add the matching row to README.md; the lint + the doc test enforce
+both halves.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+__all__ = ["EnvKnob", "KNOBS", "is_registered"]
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    name: str
+    default: str
+    effect: str
+    read_by: str          # module that consumes it
+
+
+KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
+    EnvKnob(
+        name="APEX_TPU_CPP_EXT",
+        default="0",
+        effect="build-time: compile the optional C++ parity extension "
+               "(csrc/) during `pip install`; everything degrades "
+               "gracefully without it",
+        read_by="setup.py"),
+    EnvKnob(
+        name="APEX_TPU_ATTN_XLA_MAX_SEQ",
+        default="256",
+        effect="flash_attention auto-dispatches padded sequences at or "
+               "below this length to the fused-XLA path (measured "
+               "kernel/XLA crossover, bench r5; 0 disables the XLA "
+               "path); per-call override: flash_attention("
+               "xla_max_seq=...)",
+        read_by="apex_tpu/ops/attention.py"),
+    EnvKnob(
+        name="APEX_TPU_DECODE_XLA_MAX_SEQ",
+        default="4096",
+        effect="decode_attention uses the grouped-query XLA einsum "
+               "chain at or below this cache length and the flash "
+               "kernel above it (PROVISIONAL crossover, stamped into "
+               "infer bench captures); per-call override: "
+               "decode_attention(xla_max_seq=...)",
+        read_by="apex_tpu/ops/attention.py"),
+]}
+
+
+def is_registered(name: str) -> bool:
+    return name in KNOBS

@@ -96,6 +96,16 @@ def _builders():
         return (lambda q, k, v: op(q, k, v, causal=True, axis_name=None),
                 (qkv, qkv, qkv))
 
+    def ulysses_attention():
+        from apex_tpu.ops import ulysses_attention as op
+        qkv = s((1, 2, 128, 64), bf16)
+        # axis_name=None exercises the single-shard entry path without a
+        # mesh (same contract as the ring entry); the cp>1 all_to_all
+        # path is audited with a bound mesh by the SPMD auditor's
+        # ulysses_attention_cp executable
+        return (lambda q, k, v: op(q, k, v, causal=True, axis_name=None),
+                (qkv, qkv, qkv))
+
     def xentropy():
         from apex_tpu.ops import softmax_cross_entropy_loss as op
         return (lambda l, y: op(l, y),
@@ -179,6 +189,9 @@ def _builders():
                             ("bfloat16",), 0),
         "ring_attention": (ring_attention, "apex_tpu/ops/ring_attention.py",
                            ("bfloat16",), 0),
+        "ulysses_attention": (ulysses_attention,
+                              "apex_tpu/ops/ulysses_attention.py",
+                              ("bfloat16",), 0),
         "xentropy": (xentropy, "apex_tpu/ops/xentropy.py",
                      ("float32",), 0),
         "fused_adam": (fused_adam, "apex_tpu/ops/fused_update.py",

@@ -44,8 +44,11 @@ def all_rules() -> list[Rule]:
 
 # Import order defines nothing semantic; ids keep the report ordering.
 from apex_tpu.analysis.rules import (  # noqa: E402,F401
+    axis_names,
+    branch_collectives,
     control_flow,
     donation,
+    env_knobs,
     host_sync,
     precision,
     prng,

@@ -1,0 +1,104 @@
+"""APX107 — hardcoded mesh-axis-name string literals in package code.
+
+``jax.lax.psum(x, "data")`` works until someone renames or re-carves
+the mesh; ``parallel_state`` exports the axis names as constants
+(``DATA_AXIS``/``TENSOR_AXIS``/...) precisely so call sites and the
+topology cannot drift apart.  The rule fires on a canonical axis-name
+string literal used as a collective's axis argument (positional or
+``axis_name=``) or as an ``axis_name`` parameter default, inside
+``apex_tpu/`` package code only — tests and examples build their own
+meshes and legitimately name their own axes.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+_CANONICAL = {"data", "tensor", "pipe", "context", "expert"}
+
+_CONSTANT_OF = {"data": "DATA_AXIS", "tensor": "TENSOR_AXIS",
+                "pipe": "PIPE_AXIS", "context": "CONTEXT_AXIS",
+                "expert": "EXPERT_AXIS"}
+
+# collectives / axis queries whose axis argument is positional arg 1
+_AXIS_ARG1_FNS = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.all_gather", "jax.lax.psum_scatter", "jax.lax.ppermute",
+    "jax.lax.all_to_all", "jax.lax.axis_index", "jax.lax.axis_size",
+    "jax.lax.pswapaxes",
+}
+
+# tests/examples/bench build their OWN meshes and may name their own
+# axes; the constants module defining the names is exempt
+_OUT_OF_SCOPE = ("tests/", "examples/", "bench")
+_EXEMPT = "apex_tpu/transformer/parallel_state.py"
+
+
+def _axis_literal(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value in _CANONICAL:
+        return node.value
+    return ""
+
+
+@register
+class HardcodedAxisName(Rule):
+    id = "APX107"
+    name = "hardcoded-axis-name"
+    description = ("mesh axis name as a string literal in package code — "
+                   "use the parallel_state constants (DATA_AXIS, "
+                   "TENSOR_AXIS, ...) so call sites can't drift from the "
+                   "topology")
+
+    def _in_scope(self, path: str) -> bool:
+        # package code + fixture sources ("<string>") are in scope
+        return path != _EXEMPT and not path.startswith(_OUT_OF_SCOPE)
+
+    def check_module(self, ctx):
+        if not self._in_scope(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_defaults(ctx, node)
+            elif isinstance(node, ast.AnnAssign):
+                # dataclass/flax-module field defaults:
+                #     axis_name: Optional[str] = "data"
+                if isinstance(node.target, ast.Name) and \
+                        "axis" in node.target.id and node.value is not None:
+                    ax = _axis_literal(node.value)
+                    if ax:
+                        yield self._finding(ctx, node.value, ax)
+
+    def _check_call(self, ctx, call: ast.Call):
+        resolved = ctx.resolve(call.func) or ""
+        if resolved in _AXIS_ARG1_FNS and len(call.args) >= 2:
+            ax = _axis_literal(call.args[1])
+            if ax:
+                yield self._finding(ctx, call.args[1], ax)
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "expert_axis", "tensor_axis"):
+                ax = _axis_literal(kw.value)
+                if ax:
+                    yield self._finding(ctx, kw.value, ax)
+
+    def _check_defaults(self, ctx, fn):
+        a = fn.args
+        params = a.posonlyargs + a.args + a.kwonlyargs
+        defaults = ([None] * (len(a.posonlyargs + a.args)
+                              - len(a.defaults)) + list(a.defaults)
+                    + list(a.kw_defaults))
+        for p, d in zip(params, defaults):
+            if d is not None and "axis" in p.arg:
+                ax = _axis_literal(d)
+                if ax:
+                    yield self._finding(ctx, d, ax)
+
+    def _finding(self, ctx, node, ax: str):
+        return ctx.finding(
+            self.id, node,
+            f"axis name {ax!r} hardcoded as a string literal — use "
+            f"parallel_state.{_CONSTANT_OF[ax]} so the call site tracks "
+            f"the mesh topology")

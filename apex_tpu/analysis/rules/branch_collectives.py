@@ -1,0 +1,82 @@
+"""APX109 — collective call inside only one branch of a per-process
+Python ``if``.
+
+SPMD programs are the SAME program on every rank; a collective guarded
+by a Python condition that can DIFFER across processes/ranks —
+``jax.process_index()``, a ``parallel_state`` rank getter,
+``is_pipeline_first/last_stage()`` — compiles different programs on
+different hosts, and the ranks that skipped the branch deadlock the
+ones inside the collective.  The sanctioned shape is the masked
+collective every rank enters (``psum(where(member, x, 0))`` — see
+``pipeline_parallel.embedding_grads_all_reduce``).
+
+Static *topology* branches (``if cp == 1: ...``, ``if t < cp - 1``)
+are identical on every rank and stay quiet.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from apex_tpu.analysis.rules import Rule, register
+
+_COLLECTIVE_FNS = re.compile(
+    r"jax\.lax\.(psum|pmean|pmax|pmin|all_gather|psum_scatter|ppermute|"
+    r"all_to_all|pswapaxes)$")
+
+# condition names that differ per process/rank
+_PER_PROCESS = re.compile(
+    r"(process_index|process_count|host_id|axis_index|"
+    r"get_\w*rank|is_pipeline_(first|last)_stage)")
+
+
+@register
+class CollectiveInDivergentBranch(Rule):
+    id = "APX109"
+    name = "collective-in-divergent-branch"
+    description = ("collective inside one branch of a Python if on a "
+                   "per-process/rank condition — ranks that skip the "
+                   "branch deadlock the ones inside; use a masked "
+                   "collective every rank enters")
+
+    def check_module(self, ctx):
+        seen: set = set()
+        for node in ctx.iter_traced(ast.If):
+            if id(node) in seen:
+                continue
+            if not self._per_process_test(ctx, node.test):
+                continue
+            body_c = self._collectives(ctx, node.body)
+            else_c = self._collectives(ctx, node.orelse)
+            if body_c == else_c:
+                continue
+            seen.add(id(node))
+            only = sorted((body_c or else_c))
+            yield ctx.finding(
+                self.id, node,
+                f"collective {only} appears in only one branch of an if "
+                f"on a per-process condition — ranks taking the other "
+                f"branch deadlock it; restructure as a masked "
+                f"collective (psum(where(member, x, 0))) every rank "
+                f"executes")
+
+    def _per_process_test(self, ctx, test: ast.expr) -> bool:
+        for sub in ast.walk(test):
+            name = None
+            if isinstance(sub, ast.Call):
+                name = ctx.resolve(sub.func)
+            elif isinstance(sub, (ast.Name, ast.Attribute)):
+                name = ctx.resolve(sub)
+            if name and _PER_PROCESS.search(name):
+                return True
+        return False
+
+    def _collectives(self, ctx, stmts) -> frozenset:
+        out = set()
+        for stmt in stmts or []:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    name = ctx.resolve(sub.func) or ""
+                    if _COLLECTIVE_FNS.search(name):
+                        out.add(name.rsplit(".", 1)[-1])
+        return frozenset(out)

@@ -1,0 +1,78 @@
+"""APX108 — unregistered ``APEX_TPU_*`` environment-knob reads.
+
+Every env knob the package consumes must be declared in
+:mod:`apex_tpu.analysis.env_registry` (one place), which the README
+knob table is validated against — an ``os.environ.get("APEX_TPU_FOO")``
+without a registry entry is a knob users can set but never discover.
+The rule resolves simple module-level string constants
+(``_ENV = "APEX_TPU_X"; os.environ.get(_ENV)`` — the package idiom), so
+indirection doesn't launder a read past the registry.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.rules import Rule, register
+
+_PREFIX = "APEX_TPU_"
+
+_READ_FNS = {"os.environ.get", "os.getenv", "environ.get"}
+
+
+@register
+class UnregisteredEnvKnob(Rule):
+    id = "APX108"
+    name = "unregistered-env-knob"
+    description = ("APEX_TPU_* environment variable read without an "
+                   "apex_tpu.analysis.env_registry entry — register it "
+                   "(and its README table row) so the knob is "
+                   "discoverable")
+
+    def check_module(self, ctx):
+        from apex_tpu.analysis.env_registry import is_registered
+
+        consts = self._module_str_consts(ctx.tree)
+
+        def knob_name(node) -> str:
+            """The APEX_TPU_* name an expression denotes, or ''."""
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                val = node.value
+            elif isinstance(node, ast.Name):
+                val = consts.get(node.id, "")
+            else:
+                return ""
+            return val if val.startswith(_PREFIX) else ""
+
+        for node in ast.walk(ctx.tree):
+            name = ""
+            if isinstance(node, ast.Call) and node.args:
+                resolved = ctx.resolve(node.func) or ""
+                if resolved in _READ_FNS or \
+                        resolved.endswith(".environ.get"):
+                    name = knob_name(node.args[0])
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                base = ctx.resolve(node.value) or ""
+                if base.endswith("environ"):
+                    sl = node.slice
+                    sl = sl.value if isinstance(sl, ast.Index) else sl
+                    name = knob_name(sl)
+            if name and not is_registered(name):
+                yield ctx.finding(
+                    self.id, node,
+                    f"env knob {name!r} is read here but has no "
+                    f"apex_tpu.analysis.env_registry entry — register "
+                    f"it (default + effect) and add the README table "
+                    f"row")
+
+    @staticmethod
+    def _module_str_consts(tree) -> dict:
+        out: dict = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[node.targets[0].id] = node.value.value
+        return out

@@ -1,0 +1,881 @@
+"""SPMD soundness auditor over the registered multi-device executables.
+
+The jaxpr precision auditor (:mod:`apex_tpu.analysis.jaxpr_audit`)
+checks single-device properties — dtype policy, host-transfer
+discipline.  This engine walks the *distributed* executables the repo
+actually ships — dense and ZeRO train steps, DDP bucketed allreduce,
+TP column/row layers, pipeline 1F1B, ring/Ulysses attention, MoE
+expert dispatch, inference prefill/decode — and machine-checks the
+invariants PRs 3–4 proved by hand, per registered executable:
+
+* **APX211 — collective axis soundness.**  Every ``psum`` /
+  ``all_gather`` / ``psum_scatter`` / ``ppermute`` / ``all_to_all`` /
+  ``pmax`` names an axis the executable's mesh binds AND that belongs
+  to ``parallel_state``'s canonical topology (``pipe/data/expert/
+  context/tensor``).  A collective over a foreign axis is dead comm at
+  best, a shape bug at worst.
+* **APX212 — branch collective parity.**  All branches of a
+  ``lax.cond``/``switch`` carry the SAME multiset of (collective,
+  axes).  A collective in only one branch is the classic SPMD
+  deadlock/divergence shape: ranks disagreeing on the predicate stall
+  each other inside the collective.
+* **APX213 — replica-uniform control values.**  A dataflow pass tracks
+  which values VARY across mesh axes (sharded inputs, ``axis_index``,
+  ``psum_scatter``/``ppermute``/``all_to_all`` outputs) and which are
+  replica-uniform (replicated inputs, constants, reducing-collective
+  outputs).  Predicates of conds whose branches contain collectives
+  must be uniform, and so must the small hyperparameter/flag operands
+  of the fused update kernels (``noop_flag`` — the exact invariant
+  ZeRO's overflow skip rests on: drop the ``pmax`` on ``found_inf``
+  and this fires).
+* **APX214 — donation verification.**  The lowered executable's
+  ``tf.aliasing_output`` attributes actually cover every large leaf of
+  the declared donated arguments (FlatState slots, KV cache buffers);
+  for step-shaped executables, a large UNdonated input whose aval
+  exactly matches an output is flagged — XLA could have reused the
+  buffer and silently is not.
+* **APX215/APX216 — comm/HBM budget ledger.**  Per-executable
+  analytic collective bytes + peak-live-buffer estimate
+  (:mod:`~apex_tpu.analysis.comm_model`), ratcheted against the
+  committed ``.analysis_budget.json``: growth (or an unbudgeted
+  executable) exits nonzero, shrinkage is silent until re-pinned.
+  APX216 machine-checks PERF.md round-6's ZeRO accounting on the zero
+  step's own jaxpr: all-gather bytes == reduce-scatter bytes, i.e.
+  RS + AG == the ring all-reduce of the same flat buffer.
+
+Everything is trace-only (``jax.make_jaxpr`` + ``jit(...).lower``) —
+zero FLOPs, runs on the 8 forced host devices in seconds.
+"""
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence
+
+from apex_tpu.analysis.comm_model import (COLLECTIVE_PRIMS, collective_axes,
+                                          comm_report, peak_live_bytes)
+from apex_tpu.analysis.finding import Finding
+
+__all__ = ["ExecSpec", "exec_specs", "run_spmd_audit", "compare_budget",
+           "ensure_devices", "CANONICAL_AXES", "DONATION_FLOOR_BYTES",
+           "BUDGET_NAME"]
+
+BUDGET_NAME = ".analysis_budget.json"
+
+#: parallel_state's mesh axis names — the only axes a registered
+#: executable's collectives may ride (APX211).
+CANONICAL_AXES = frozenset({"pipe", "data", "expert", "context", "tensor"})
+
+#: donated/aliasable leaves smaller than this are noise (scalar step
+#: counters, PRNG keys) — the donation checks ignore them.
+DONATION_FLOOR_BYTES = 1024
+
+# Fused optimizer/scaler kernel names whose small (<=16-element 1-D)
+# operands — lr/beta/noop_flag hyperparameter vectors — must be
+# replica-uniform: a rank-varying noop_flag silently diverges the
+# masters (PR 3's hand-proved invariant, now enforced).
+_UPDATE_KERNEL_MARKS = ("_adam_kernel", "_adagrad_kernel", "_sgd_kernel",
+                        "_lamb1_kernel", "_scale_kernel",
+                        "_l2norm_scale_kernel")
+_UPDATE_OPERAND_MAX_ELEMS = 16
+
+# Collectives that make their output replica-uniform over the reduced/
+# gathered axes (every rank holds the identical result)...
+_UNIFORMING = {"psum", "pmax", "pmin", "all_gather"}
+# ...and collectives whose output stays (or becomes) rank-varying.
+_VARYING = {"reduce_scatter", "psum_scatter", "ppermute", "all_to_all"}
+
+
+def ensure_devices(n: int = 8) -> int:
+    """Force ``n`` host devices BEFORE the backend initializes (the
+    same ``xla_force_host_platform_device_count`` route the test
+    conftest uses); returns the live device count.  A backend already
+    pinned to fewer devices is left alone — callers decide whether
+    that is fatal."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}").strip()
+    import jax
+    return len(jax.devices())
+
+
+# ---------------------------------------------------------------------------
+# executable registry
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecSpec:
+    """One registered multi-device executable and its declared contract."""
+    name: str
+    path: str                        # module findings anchor to
+    build: Callable[[], tuple]       # () -> (fn, args, axis_sizes)
+    donate_argnums: tuple = ()       # declared donated args (jit-level)
+    flag_undonated: bool = False     # step-shaped: flag alias-able args
+    check_update_uniformity: bool = False
+    rs_ag_identity: bool = False     # machine-check RS+AG==AR (PERF r6)
+
+
+def _builders():
+    """Lazy spec builders (importing this module stays jax-free).
+
+    Each builder OWNS its ``parallel_state`` topology —
+    :func:`run_spmd_audit` snapshots and restores the global mesh
+    around the whole run so the audit composes with test harnesses.
+    """
+    import functools
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state as ps
+
+    shard_map = functools.partial(jax.shard_map, check_vma=False)
+
+    def _mlp_params(n_layers=8, d=8):
+        out = {}
+        for i in range(n_layers):
+            base = np.linspace(-0.3, 0.3, d * d, dtype=np.float32)
+            out[f"w{i}"] = jnp.asarray(np.roll(base, i).reshape(d, d))
+            out[f"b{i}"] = jnp.asarray(
+                np.linspace(-0.01, 0.01, d, dtype=np.float32))
+        return out
+
+    def _mlp_loss(params, batch):
+        h = batch["x"]
+        for i in range(sum(1 for k in params if k.startswith("w"))):
+            h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    def _mlp_batch(n=16, d=8):
+        x = np.linspace(-1.0, 1.0, n * d, dtype=np.float32).reshape(n, d)
+        return {"x": jnp.asarray(x), "y": jnp.asarray(np.tanh(x @ np.full(
+            (d, d), 0.1, np.float32)))}
+
+    def train_step_dense():
+        from apex_tpu import train_step
+        from apex_tpu.optimizers import functional
+        tx = functional.fused_adam(lr=1e-2)
+        state = train_step.init_train_state(tx, _mlp_params(),
+                                            loss_scale="dynamic")
+        step = train_step.make_train_step(_mlp_loss, tx)
+        return step, (state, _mlp_batch()), {}
+
+    def train_step_zero():
+        from apex_tpu import train_step
+        from apex_tpu.optimizers import functional
+        tx = functional.fused_adam(lr=1e-2)
+        mesh = Mesh(np.array(jax.devices()[:2]), (ps.DATA_AXIS,))
+        state, specs = train_step.init_zero_train_state(
+            tx, _mlp_params(), ps.DATA_AXIS, 2, loss_scale="dynamic")
+        step = train_step.make_train_step(_mlp_loss, tx, zero=True)
+        fn = shard_map(step, mesh=mesh, in_specs=(specs, P()),
+                       out_specs=(specs, P()))
+        return fn, (state, _mlp_batch()), dict(mesh.shape)
+
+    def ddp_bucketed_allreduce():
+        from apex_tpu.parallel.distributed import DistributedDataParallel
+        mesh = Mesh(np.array(jax.devices()[:2]), (ps.DATA_AXIS,))
+        # small message_size forces the bucketed multi-psum path
+        ddp = DistributedDataParallel(axis_name=ps.DATA_AXIS,
+                                      message_size=4096)
+        grads = _mlp_params(n_layers=6, d=16)
+
+        def body(grads):
+            return ddp.reduce_gradients(grads)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
+        return fn, (grads,), dict(mesh.shape)
+
+    def tp_column_row():
+        from apex_tpu.transformer import tensor_parallel
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(tensor_model_parallel_size_=2)
+        mesh = ps.get_mesh()
+        col = tensor_parallel.ColumnParallelLinear(8, 16,
+                                                   gather_output=False,
+                                                   bias=False)
+        row = tensor_parallel.RowParallelLinear(16, 8,
+                                                input_is_parallel=True,
+                                                bias=False)
+
+        def body(x):
+            pc = col.init(jax.random.key(0), x)
+            h, _ = col.apply(pc, x)
+            pr = row.init(jax.random.key(1), h)
+
+            def loss(x):
+                h, _ = col.apply(pc, x)
+                y, _ = row.apply(pr, h)
+                return jnp.mean(y ** 2)
+
+            return jax.value_and_grad(loss)(x)
+
+        fn = shard_map(body, mesh=mesh, in_specs=(P(),),
+                       out_specs=(P(), P()))
+        x = jnp.asarray(np.linspace(-1, 1, 3 * 8,
+                                    dtype=np.float32).reshape(3, 8))
+        return fn, (x,), dict(mesh.shape)
+
+    def pipeline_1f1b():
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            forward_backward_pipelining_without_interleaving)
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(pipeline_model_parallel_size_=2)
+        mesh = ps.get_mesh()
+        HID, N_MICRO, MB = 8, 2, 2
+        params = {"w": jnp.stack([jnp.eye(HID) * 0.5] * 2),
+                  "b": jnp.zeros((2, HID))}
+        batch = {"x": jnp.asarray(np.linspace(
+                     -1, 1, N_MICRO * MB * HID,
+                     dtype=np.float32).reshape(N_MICRO, MB, HID)),
+                 "target": jnp.full((N_MICRO, MB, HID), 0.1)}
+
+        def stage_fn(p, x, mb):
+            return jax.nn.gelu(x @ p["w"] + p["b"])
+
+        def loss_fn(y, mb):
+            return jnp.mean((y - mb["target"]) ** 2)
+
+        def body(params, batch):
+            local = jax.tree.map(lambda p: p[0], params)
+            loss, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, local, batch,
+                num_microbatches=N_MICRO, input_fn=lambda mb: mb["x"])
+            return loss, jax.tree.map(lambda g: g[None], grads)
+
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(ps.PIPE_AXIS), P()),
+                       out_specs=(P(), P(ps.PIPE_AXIS)))
+        return fn, (params, batch), dict(mesh.shape)
+
+    def _cp_qkv():
+        s = jax.ShapeDtypeStruct
+        q = s((1, 2, 256, 64), jnp.bfloat16)
+        return q, q, q
+
+    def ring_attention_cp():
+        from apex_tpu.ops import ring_attention as op
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(context_parallel_size_=2)
+        mesh = ps.get_mesh()
+        fn = shard_map(lambda q, k, v: op(q, k, v, causal=True),
+                       mesh=mesh,
+                       in_specs=(P(None, None, ps.CONTEXT_AXIS, None),) * 3,
+                       out_specs=P(None, None, ps.CONTEXT_AXIS, None))
+        return fn, _cp_qkv(), dict(mesh.shape)
+
+    def ulysses_attention_cp():
+        from apex_tpu.ops import ulysses_attention as op
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(context_parallel_size_=2)
+        mesh = ps.get_mesh()
+        fn = shard_map(lambda q, k, v: op(q, k, v, causal=True),
+                       mesh=mesh,
+                       in_specs=(P(None, None, ps.CONTEXT_AXIS, None),) * 3,
+                       out_specs=P(None, None, ps.CONTEXT_AXIS, None))
+        return fn, _cp_qkv(), dict(mesh.shape)
+
+    def moe_dispatch():
+        import flax  # noqa: F401 — optional dep; ImportError skips
+        from apex_tpu.transformer.moe.layer import MoELayer
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(expert_model_parallel_size_=2)
+        mesh = ps.get_mesh()
+        layer = MoELayer(num_experts=4, hidden_size=16, ffn_hidden_size=32,
+                         top_k=1, capacity=4, expert_parallel_size=2)
+
+        def body(x):
+            params = layer.init(jax.random.key(3), x)
+            y, _ = layer.apply(params, x)
+            return y
+
+        dp = mesh.shape[ps.DATA_AXIS]
+        spec = P((ps.DATA_AXIS, ps.EXPERT_AXIS))
+        fn = shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+        x = jax.ShapeDtypeStruct((dp * 2 * 4, 16), jnp.float32)
+        return fn, (x,), dict(mesh.shape)
+
+    def _inference(which):
+        from apex_tpu.analysis import jaxpr_audit
+        ps.destroy_model_parallel()
+        fn, args = jaxpr_audit._builders()[which][0]()
+        return fn, args, {}
+
+    return {
+        # name: (builder, path, donate, flag_undonated, update_unif, rs_ag)
+        "train_step_dense": (train_step_dense, "apex_tpu/train_step.py",
+                             (0,), True, True, False),
+        "train_step_zero": (train_step_zero, "apex_tpu/train_step.py",
+                            (0,), True, True, True),
+        "ddp_allreduce": (ddp_bucketed_allreduce,
+                          "apex_tpu/parallel/distributed.py",
+                          (), False, False, False),
+        "tp_column_row": (tp_column_row,
+                          "apex_tpu/transformer/tensor_parallel/layers.py",
+                          (), False, False, False),
+        "pipeline_1f1b": (pipeline_1f1b,
+                          "apex_tpu/transformer/pipeline_parallel/"
+                          "schedules.py",
+                          (), False, False, False),
+        "ring_attention_cp": (ring_attention_cp,
+                              "apex_tpu/ops/ring_attention.py",
+                              (), False, False, False),
+        "ulysses_attention_cp": (ulysses_attention_cp,
+                                 "apex_tpu/ops/ulysses_attention.py",
+                                 (), False, False, False),
+        "moe_dispatch": (moe_dispatch,
+                         "apex_tpu/transformer/moe/layer.py",
+                         (), False, False, False),
+        "inference_prefill": (lambda: _inference("inference_prefill"),
+                              "apex_tpu/inference/engine.py",
+                              (0,), True, False, False),
+        "inference_decode": (lambda: _inference("inference_decode"),
+                             "apex_tpu/inference/engine.py",
+                             (0,), True, False, False),
+    }
+
+
+def exec_specs() -> List[ExecSpec]:
+    return [ExecSpec(name, path, build, donate, undon, unif, rs_ag)
+            for name, (build, path, donate, undon, unif, rs_ag)
+            in _builders().items()]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _iter_jaxprs(jaxpr):
+    from apex_tpu.analysis.jaxpr_audit import _iter_jaxprs as it
+    return it(jaxpr)
+
+
+def _collective_multiset(jaxpr) -> dict:
+    """{(prim, axes): count} over a jaxpr INCLUDING nested jaxprs; scan
+    bodies multiply by length (two psums == one psum scanned twice)."""
+    import jax
+
+    out: Dict[tuple, int] = {}
+
+    def walk(j, mult):
+        j = getattr(j, "jaxpr", j)
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                key = (name, collective_axes(eqn))
+                out[key] = out.get(key, 0) + mult
+            m = mult
+            if name == "scan":
+                m = mult * int(eqn.params.get("length", 1))
+            for v in eqn.params.values():
+                items = v if isinstance(v, (list, tuple)) else [v]
+                for item in items:
+                    if isinstance(item, (jax.core.Jaxpr,
+                                         jax.core.ClosedJaxpr)):
+                        walk(item, m)
+
+    walk(jaxpr, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# replica-uniformity dataflow
+# ---------------------------------------------------------------------------
+
+class _Uniformity:
+    """Per-executable varying-axes dataflow + the checks riding on it.
+
+    ``vary[var]`` is the frozenset of mesh axes the value differs
+    across; empty/absent means replica-uniform.  Conservative: unknown
+    primitives union their inputs; unmappable subjaxprs seed every
+    inner input with the union of the outer inputs.
+    """
+
+    def __init__(self, spec: ExecSpec, emit):
+        self.spec = spec
+        self.emit = emit            # (rule, message) -> None
+        self._reported: set = set()
+
+    # -- eqn transfer functions -----------------------------------------
+
+    def run(self, jaxpr, seed: List[FrozenSet], checks: bool) -> list:
+        import jax
+
+        vary: dict = {}
+        open_j = getattr(jaxpr, "jaxpr", jaxpr)
+        for v, s in zip(open_j.invars, seed):
+            vary[v] = s
+        for v in open_j.constvars:
+            vary[v] = frozenset()
+
+        def vof(v):
+            if isinstance(v, jax.core.Literal):
+                return frozenset()
+            return vary.get(v, frozenset())
+
+        for eqn in open_j.eqns:
+            name = eqn.primitive.name
+            invary = frozenset().union(*[vof(v) for v in eqn.invars]) \
+                if eqn.invars else frozenset()
+            axes = set(collective_axes(eqn))
+            if name in _UNIFORMING and \
+                    eqn.params.get("axis_index_groups") is None:
+                out = [invary - axes] * len(eqn.outvars)
+            elif name in _VARYING:
+                out = [invary | axes] * len(eqn.outvars)
+            elif name == "axis_index":
+                out = [frozenset(axes)] * len(eqn.outvars)
+            elif name == "cond":
+                out = self._cond(eqn, vof, checks)
+            elif name == "scan":
+                out = self._scan(eqn, vof, checks)
+            elif name == "while":
+                out = self._while(eqn, vof, checks)
+            elif name == "pjit":
+                sub = eqn.params["jaxpr"]
+                out = self.run(sub, [vof(v) for v in eqn.invars], checks)
+            elif name == "pallas_call":
+                if checks:
+                    self._pallas(eqn, vof)
+                out = [invary] * len(eqn.outvars)
+            else:
+                out = [invary] * len(eqn.outvars)
+                out = self._generic_subjaxprs(eqn, invary, out, checks)
+            for v, s in zip(eqn.outvars, out):
+                vary[v] = s
+        return [vof(v) for v in open_j.outvars]
+
+    def _cond(self, eqn, vof, checks) -> list:
+        pred = vof(eqn.invars[0])
+        branches = eqn.params.get("branches", ())
+        seed = [vof(v) for v in eqn.invars[1:]]
+        outs = None
+        multisets = []
+        for br in branches:
+            sub_out = self.run(br, seed, checks)
+            multisets.append(_collective_multiset(br))
+            outs = sub_out if outs is None else [
+                a | b for a, b in zip(outs, sub_out)]
+        if checks and multisets:
+            base = multisets[0]
+            if any(m != base for m in multisets[1:]):
+                self._emit_once(
+                    "APX212",
+                    "lax.cond/switch branches carry different collective "
+                    f"multisets {[sorted(f'{p}@{a}' for (p, a) in m) for m in multisets]}"
+                    " — ranks disagreeing on the predicate deadlock or "
+                    "diverge inside the missing collective")
+            if pred and any(multisets):
+                self._emit_once(
+                    "APX213",
+                    f"cond predicate varies over mesh axes "
+                    f"{sorted(pred)} while its branches contain "
+                    f"collectives — rank-divergent collective entry is "
+                    f"the SPMD deadlock shape; derive the predicate "
+                    f"through a reducing collective (psum/pmax) or a "
+                    f"constant")
+        outs = outs or []
+        return [o | pred for o in outs]
+
+    def _scan(self, eqn, vof, checks) -> list:
+        num_consts = eqn.params["num_consts"]
+        num_carry = eqn.params["num_carry"]
+        sub = eqn.params["jaxpr"]
+        consts = [vof(v) for v in eqn.invars[:num_consts]]
+        carry = [vof(v) for v in
+                 eqn.invars[num_consts:num_consts + num_carry]]
+        xs = [vof(v) for v in eqn.invars[num_consts + num_carry:]]
+        for _ in range(8):  # fixpoint over the carried varying sets
+            out = self.run(sub, consts + carry + xs, False)
+            new_carry = [a | b for a, b in zip(carry, out[:num_carry])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        out = self.run(sub, consts + carry + xs, checks)
+        return [a | b for a, b in zip(carry, out[:num_carry])] \
+            + out[num_carry:]
+
+    def _while(self, eqn, vof, checks) -> list:
+        cn = eqn.params["cond_nconsts"]
+        bn = eqn.params["body_nconsts"]
+        cond_j = eqn.params["cond_jaxpr"]
+        body_j = eqn.params["body_jaxpr"]
+        cconsts = [vof(v) for v in eqn.invars[:cn]]
+        bconsts = [vof(v) for v in eqn.invars[cn:cn + bn]]
+        carry = [vof(v) for v in eqn.invars[cn + bn:]]
+        for _ in range(8):
+            out = self.run(body_j, bconsts + carry, False)
+            new_carry = [a | b for a, b in zip(carry, out)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        out = self.run(body_j, bconsts + carry, checks)
+        pred = self.run(cond_j, cconsts + carry, False)
+        if checks and pred and pred[0] and _collective_multiset(body_j):
+            self._emit_once(
+                "APX213",
+                f"while_loop predicate varies over mesh axes "
+                f"{sorted(pred[0])} while the body contains collectives "
+                f"— rank-divergent trip counts deadlock the collective")
+        return [a | b for a, b in zip(carry, out)]
+
+    def _pallas(self, eqn, vof) -> None:
+        label = str(eqn.params.get("name_and_src_info")
+                    or eqn.params.get("name") or "")
+        if not any(mark in label for mark in _UPDATE_KERNEL_MARKS):
+            return
+        if not self.spec.check_update_uniformity:
+            return
+        for v in eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is None or aval.ndim > 1:
+                continue
+            size = 1
+            for d in aval.shape:
+                size *= int(d)
+            if size > _UPDATE_OPERAND_MAX_ELEMS:
+                continue
+            axes = vof(v)
+            if axes:
+                self._emit_once(
+                    "APX213",
+                    f"update kernel {label.split(' at ')[0]!r} consumes a "
+                    f"hyperparameter/flag operand (shape "
+                    f"{tuple(aval.shape)}) that varies over mesh axes "
+                    f"{sorted(axes)} — a rank-local noop_flag/lr silently "
+                    f"diverges the sharded masters; reduce it "
+                    f"replica-uniform first (pmax/psum over the axis)")
+
+    def _generic_subjaxprs(self, eqn, invary, out, checks) -> list:
+        """custom_vjp/jvp, remat, closed_call, ...: recurse for the
+        CHECKS with conservative seeding; outputs stay the input
+        union (already set by the caller)."""
+        import jax
+
+        for v in eqn.params.values():
+            items = v if isinstance(v, (list, tuple)) else [v]
+            for item in items:
+                if isinstance(item, (jax.core.Jaxpr, jax.core.ClosedJaxpr)):
+                    open_j = getattr(item, "jaxpr", item)
+                    self.run(item, [invary] * len(open_j.invars), checks)
+        return out
+
+    def _emit_once(self, rule: str, message: str) -> None:
+        key = (rule, message)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.emit(rule, message)
+
+
+# ---------------------------------------------------------------------------
+# donation verification
+# ---------------------------------------------------------------------------
+
+_MLIR_DT = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
+            "float64": "f64", "int8": "i8", "int16": "i16",
+            "int32": "i32", "int64": "i64", "uint8": "ui8",
+            "uint16": "ui16", "uint32": "ui32", "uint64": "ui64",
+            "bool": "i1"}
+
+# the attr dict may carry quoted values containing '}' (e.g.
+# mhlo.sharding = "{devices=[2]<=[2]}") — match quoted spans atomically
+_ARG_RE = re.compile(
+    r"%arg\d+:\s*(tensor<[^>]*>)\s*(\{(?:[^{}\"]|\"[^\"]*\")*\})?")
+
+
+def _mlir_type(aval) -> str:
+    dims = "x".join(str(int(d)) for d in aval.shape)
+    dt = _MLIR_DT.get(str(aval.dtype), str(aval.dtype))
+    return f"tensor<{dims}x{dt}>" if dims else f"tensor<{dt}>"
+
+
+def _aval_bytes(aval) -> int:
+    size = 1
+    for d in aval.shape:
+        size *= int(d)
+    return size * aval.dtype.itemsize
+
+
+def _parse_main_args(text: str) -> list:
+    """[(mlir type, donated?)] for @main's arguments, from the lowered
+    StableHLO text.  Single-device lowerings mark donated-and-usable
+    inputs ``tf.aliasing_output``; multi-device (mesh) lowerings defer
+    the alias decision to XLA and mark ``jax.buffer_donor`` — either
+    attribute proves the declared donation reached the executable."""
+    start = text.index("@main(")
+    depth, i = 0, start + len("@main")
+    for i in range(start + len("@main"), len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+    sig = text[start:i + 1]
+    return [(m.group(1),
+             any(mark in (m.group(2) or "")
+                 for mark in ("tf.aliasing_output", "jax.buffer_donor")))
+            for m in _ARG_RE.finditer(sig)]
+
+
+def _check_donation(spec: ExecSpec, fn, args, emit) -> None:
+    import jax
+
+    jitted = jax.jit(fn, donate_argnums=spec.donate_argnums or ())
+    try:
+        text = jitted.lower(*args).as_text()
+    except Exception as e:  # noqa: BLE001 — surfaced as a finding
+        emit("APX210", f"lowering {spec.name} for donation verification "
+                       f"failed: {type(e).__name__}: {e}")
+        return
+    sig = _parse_main_args(text)
+
+    donated, undonated = [], []
+    for i, a in enumerate(args):
+        leaves = jax.tree.leaves(a)
+        (donated if i in (spec.donate_argnums or ()) else
+         undonated).extend(leaves)
+
+    def aval_of(x):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    out_types: Dict[str, int] = {}
+    for o in jax.tree.leaves(jax.eval_shape(fn, *args)):
+        t = _mlir_type(o)
+        out_types[t] = out_types.get(t, 0) + 1
+
+    # (a) every large declared-donated leaf (1) reached the lowered
+    # executable as a donor/alias and (2) has a matching output XLA can
+    # actually alias it to
+    donor_pool: Dict[str, int] = {}
+    for t, al in sig:
+        if al:
+            donor_pool[t] = donor_pool.get(t, 0) + 1
+    alias_pool = dict(out_types)
+    for leaf in donated:
+        aval = aval_of(leaf)
+        if _aval_bytes(aval) < DONATION_FLOOR_BYTES:
+            continue
+        t = _mlir_type(aval)
+        has_donor = donor_pool.get(t, 0) > 0
+        if has_donor:
+            donor_pool[t] -= 1
+        has_target = alias_pool.get(t, 0) > 0
+        if has_target:
+            alias_pool[t] -= 1
+        if not has_target:
+            emit("APX214",
+                 f"{spec.name}: donated input {t} matches NO output aval "
+                 f"— XLA cannot alias it, so the old buffer stays live "
+                 f"across the step (a dtype/shape change between the "
+                 f"donated input and its updated output defeats "
+                 f"donation)")
+        elif not has_donor:
+            emit("APX214",
+                 f"{spec.name}: declared-donated input {t} carries no "
+                 f"donor/alias attribute in the lowered executable — the "
+                 f"donation never reached XLA (wrong donate_argnums, or "
+                 f"the arg was pruned)")
+
+    # (b) step-shaped executables: a large undonated input whose aval
+    # matches an output could have been reused and is not
+    if spec.flag_undonated:
+        spare = dict(out_types)
+        for leaf in donated:
+            t = _mlir_type(aval_of(leaf))
+            if spare.get(t, 0) > 0:
+                spare[t] -= 1
+        for leaf in undonated:
+            aval = aval_of(leaf)
+            if _aval_bytes(aval) < DONATION_FLOOR_BYTES:
+                continue
+            t = _mlir_type(aval)
+            if spare.get(t, 0) > 0:
+                spare[t] -= 1
+                emit("APX214",
+                     f"{spec.name}: large undonated input {t} exactly "
+                     f"matches an output — donate it so XLA reuses the "
+                     f"buffer in place instead of holding both copies "
+                     f"live")
+
+
+# ---------------------------------------------------------------------------
+# audit driver
+# ---------------------------------------------------------------------------
+
+def _audit_exec(spec: ExecSpec) -> tuple:
+    """-> (findings, budget_entry or None)"""
+    import jax
+
+    findings: list = []
+
+    def emit(rule, msg):
+        findings.append(Finding(rule, spec.path, 0, 0, msg,
+                                line_text=f"{spec.name}:{rule}"))
+
+    try:
+        fn, args, axis_sizes = spec.build()
+    except ImportError:
+        return [], None  # optional dependency absent
+    except Exception as e:  # noqa: BLE001 — a broken builder is a finding
+        emit("APX210", f"building {spec.name} failed: "
+                       f"{type(e).__name__}: {e}")
+        return findings, None
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — any trace failure is a finding
+        emit("APX210", f"tracing {spec.name} failed: "
+                       f"{type(e).__name__}: {e}")
+        return findings, None
+
+    # APX211 — axis soundness over the whole program
+    bound = set(axis_sizes)
+    for j in _iter_jaxprs(closed.jaxpr):
+        for eqn in j.eqns:
+            if eqn.primitive.name in COLLECTIVE_PRIMS or \
+                    eqn.primitive.name == "axis_index":
+                for ax in collective_axes(eqn):
+                    if ax not in CANONICAL_AXES:
+                        emit("APX211",
+                             f"{spec.name}: {eqn.primitive.name} rides "
+                             f"axis {ax!r}, which is not one of "
+                             f"parallel_state's mesh axes "
+                             f"{sorted(CANONICAL_AXES)}")
+                    elif bound and ax not in bound:
+                        emit("APX211",
+                             f"{spec.name}: {eqn.primitive.name} names "
+                             f"axis {ax!r} but the executable's mesh "
+                             f"binds only {sorted(bound)}")
+
+    # APX212/APX213 — branch parity + replica-uniformity dataflow,
+    # seeded from each shard_map eqn's in_names
+    uni = _Uniformity(spec, emit)
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name != "shard_map":
+            continue
+        seed = []
+        for names in eqn.params["in_names"]:
+            seed.append(frozenset(
+                ax for axes in names.values() for ax in axes))
+        uni.run(eqn.params["jaxpr"], seed, checks=True)
+
+    # APX214 — donation verification on the lowered executable
+    if spec.donate_argnums or spec.flag_undonated:
+        _check_donation(spec, fn, args, emit)
+
+    # comm/HBM ledger entry
+    sizes = dict(axis_sizes)
+    report = comm_report(closed, sizes)
+    entry = {
+        "comm_bytes": int(report["total_bytes"]),
+        "by_collective": {k: int(v)
+                          for k, v in sorted(report["by_collective"].items())},
+        "collective_counts": {k: int(v)
+                              for k, v in sorted(report["counts"].items())},
+        "peak_live_bytes": int(peak_live_bytes(closed.jaxpr)),
+        "axes": {k: int(v) for k, v in sorted(sizes.items())},
+    }
+
+    # APX216 — the PERF.md round-6 identity on the zero step's own
+    # jaxpr: params all-gather bytes == grad reduce-scatter bytes
+    # (i.e. RS + AG == ring all-reduce of the same flat buffer)
+    if spec.rs_ag_identity:
+        by = entry["by_collective"]
+        ag = sum(v for k, v in by.items() if k.startswith("all_gather@"))
+        rs = sum(v for k, v in by.items()
+                 if k.startswith(("reduce_scatter@", "psum_scatter@")))
+        entry["rs_ag_equals_ar"] = bool(ag > 0 and ag == rs)
+        if not entry["rs_ag_equals_ar"]:
+            emit("APX216",
+                 f"{spec.name}: ZeRO comm identity broken — all_gather "
+                 f"moves {ag} B/chip vs reduce_scatter {rs} B/chip; "
+                 f"RS+AG must equal the dense all-reduce (PERF.md "
+                 f"round-6 accounting, machine-checked)")
+    return findings, entry
+
+
+def run_spmd_audit(execs: Optional[Sequence[str]] = None) -> tuple:
+    """Audit every (or the named) registered multi-device executable.
+
+    Returns ``(findings, report)`` where ``report`` is the budget
+    ledger shape committed as ``.analysis_budget.json``:
+    ``{"version": 1, "executables": {name: {comm_bytes, by_collective,
+    collective_counts, peak_live_bytes, axes[, rs_ag_equals_ar]}}}``.
+    """
+    n = ensure_devices()
+    if n < 2:
+        raise RuntimeError(
+            f"the SPMD audit needs >=2 host devices to bind mesh axes "
+            f"(got {n}); the jax backend initialized before the audit "
+            f"could request them — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=8")
+
+    specs = exec_specs()
+    if execs:
+        wanted = set(execs)
+        missing = wanted - {s.name for s in specs}
+        if missing:
+            raise ValueError(f"unknown executable(s): {sorted(missing)}")
+        specs = [s for s in specs if s.name in wanted]
+
+    from apex_tpu.transformer import parallel_state as ps
+    saved_mesh = ps._MESH
+    saved_vpp_rank = ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    saved_vpp_world = ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    findings: list = []
+    executables: dict = {}
+    try:
+        for spec in specs:
+            f, entry = _audit_exec(spec)
+            findings.extend(f)
+            if entry is not None:
+                executables[spec.name] = entry
+    finally:
+        # the builders destroy/reinit topology freely; hand the caller
+        # back EVERYTHING parallel_state tracks, not just the mesh
+        ps._MESH = saved_mesh
+        ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = saved_vpp_rank
+        ps._VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = saved_vpp_world
+    return findings, {"version": 1, "executables": executables}
+
+
+def compare_budget(report: dict, committed: Optional[dict]) -> list:
+    """Ratchet: findings for every executable whose comm bytes or peak
+    estimate GREW vs the committed budget (or that the budget has never
+    seen).  Shrinkage is silent — re-pin with ``--write-budget``."""
+    findings: list = []
+
+    def emit(name, path, msg):
+        findings.append(Finding("APX215", path, 0, 0, msg,
+                                line_text=f"{name}:APX215"))
+
+    paths = {s.name: s.path for s in exec_specs()}
+    base = (committed or {}).get("executables", {})
+    for name, entry in report.get("executables", {}).items():
+        path = paths.get(name, "<spmd_audit>")
+        pinned = base.get(name)
+        if pinned is None:
+            emit(name, path,
+                 f"{name}: executable has no committed budget entry — "
+                 f"run apex-tpu-analyze --spmd --write-budget to pin "
+                 f"its comm/HBM ledger")
+            continue
+        if entry["comm_bytes"] > pinned.get("comm_bytes", 0):
+            emit(name, path,
+                 f"{name}: collective bytes grew "
+                 f"{pinned.get('comm_bytes', 0)} -> "
+                 f"{entry['comm_bytes']} B/chip/step "
+                 f"({entry['by_collective']}) — justify and re-pin with "
+                 f"--write-budget, or remove the new collective")
+        if entry["peak_live_bytes"] > pinned.get("peak_live_bytes", 0):
+            emit(name, path,
+                 f"{name}: peak-live-buffer estimate grew "
+                 f"{pinned.get('peak_live_bytes', 0)} -> "
+                 f"{entry['peak_live_bytes']} B — a new full-size "
+                 f"temporary entered the executable")
+    return findings

@@ -19,6 +19,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
 __all__ = ["Bottleneck", "SpatialBottleneck", "halo_exchange"]
 
 
@@ -117,7 +119,7 @@ class SpatialBottleneck(nn.Module):
     in_channels: int
     bottleneck_channels: int
     out_channels: int
-    axis_name: str = "data"
+    axis_name: str = DATA_AXIS
     params_dtype: Any = jnp.float32
     use_running_average: bool = False
     sync_bn: bool = True      # psum BN stats over axis_name in training

@@ -12,6 +12,7 @@ from typing import Any, Optional
 import flax.linen as nn
 
 from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.transformer.parallel_state import DATA_AXIS
 
 __all__ = ["GroupBatchNorm2d"]
 
@@ -22,7 +23,7 @@ class GroupBatchNorm2d(nn.Module):
     group_size: int = 1
     eps: float = 1e-5
     momentum: float = 0.1
-    axis_name: Optional[str] = "data"
+    axis_name: Optional[str] = DATA_AXIS
     params_dtype: Any = None
 
     @nn.compact

@@ -14,6 +14,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 __all__ = ["BatchNorm2d_NHWC"]
@@ -28,7 +30,7 @@ class BatchNorm2d_NHWC(nn.Module):
     planes: int
     fuse_relu: bool = False
     bn_group: int = 1
-    axis_name: Optional[str] = "data"
+    axis_name: Optional[str] = DATA_AXIS
     eps: float = 1e-5
     momentum: float = 0.1
     params_dtype: Any = jnp.float32

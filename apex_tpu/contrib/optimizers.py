@@ -36,6 +36,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
 import numpy as np
 
 from apex_tpu.optimizers import functional as _functional
@@ -52,7 +54,7 @@ class _DistributedOptimizerBase:
 
     _state_keys: tuple = ()
 
-    def __init__(self, shard_size_divisor: int, axis_name: str = "data",
+    def __init__(self, shard_size_divisor: int, axis_name: str = DATA_AXIS,
                  grad_average: bool = True):
         self.axis_name = axis_name
         self.dp = int(shard_size_divisor)
@@ -216,7 +218,7 @@ class DistributedFusedAdam(_DistributedOptimizerBase):
     def __init__(self, shard_size_divisor: int, lr: float = 1e-3,
                  bias_correction: bool = True, betas=(0.9, 0.999),
                  eps: float = 1e-8, adam_w_mode: bool = True,
-                 weight_decay: float = 0.0, axis_name: str = "data",
+                 weight_decay: float = 0.0, axis_name: str = DATA_AXIS,
                  grad_average: bool = True, **_parity_kwargs):
         super().__init__(shard_size_divisor, axis_name, grad_average)
         self.lr = lr
@@ -253,7 +255,7 @@ class DistributedFusedLAMB(_DistributedOptimizerBase):
     def __init__(self, shard_size_divisor: int, lr: float = 1e-3,
                  bias_correction: bool = True, betas=(0.9, 0.999),
                  eps: float = 1e-6, weight_decay: float = 0.01,
-                 max_grad_norm: float = 1.0, axis_name: str = "data",
+                 max_grad_norm: float = 1.0, axis_name: str = DATA_AXIS,
                  grad_average: bool = True, use_nvlamb: bool = False,
                  **_parity_kwargs):
         super().__init__(shard_size_divisor, axis_name, grad_average)

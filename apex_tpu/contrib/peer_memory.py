@@ -11,6 +11,7 @@ for API shape; the halo exchange maps to
 from __future__ import annotations
 
 from apex_tpu.contrib.bottleneck import halo_exchange
+from apex_tpu.transformer.parallel_state import DATA_AXIS
 
 __all__ = ["PeerMemoryPool", "PeerHaloExchanger1d", "halo_exchange"]
 
@@ -38,7 +39,7 @@ class PeerHaloExchanger1d:
     half_halo)``; call performs the neighbor exchange over the mesh axis."""
 
     def __init__(self, ranks=None, rank_in_group=None, peer_pool=None,
-                 half_halo: int = 1, axis_name: str = "data"):
+                 half_halo: int = 1, axis_name: str = DATA_AXIS):
         self.half_halo = half_halo
         self.axis_name = axis_name
 

@@ -49,7 +49,7 @@ def _resolve_data_axes(axis_name):
         return axis_name
     from apex_tpu.transformer import parallel_state as ps
     if not ps.model_parallel_is_initialized():
-        return "data"
+        return ps.DATA_AXIS
     return ps.get_dense_param_grad_axes()
 
 

@@ -19,6 +19,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
 __all__ = ["SyncBatchNorm", "convert_syncbn_model"]
 
 
@@ -36,7 +38,7 @@ class SyncBatchNorm(nn.Module):
     momentum: float = 0.1
     affine: bool = True
     track_running_stats: bool = True
-    axis_name: Optional[str] = "data"
+    axis_name: Optional[str] = DATA_AXIS
     axis_index_groups: Any = None
     channel_last: bool = True  # NHWC; TPU-native layout
 

@@ -134,6 +134,68 @@ def step(x):
     return x + acc
 ''',
     ),
+    "APX107": (
+        '''
+import jax
+
+@jax.jit
+def reduce_grads(g):
+    return jax.lax.psum(g, "data")
+''',
+        '''
+import jax
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+@jax.jit
+def reduce_grads(g):
+    return jax.lax.psum(g, DATA_AXIS)
+''',
+    ),
+    "APX108": (
+        '''
+import os
+
+_ENV = "APEX_TPU_SECRET_TUNING_KNOB"
+
+def crossover():
+    return int(os.environ.get(_ENV, "4096"))
+''',
+        '''
+import os
+
+# registered in apex_tpu.analysis.env_registry (and the README table)
+_ENV = "APEX_TPU_ATTN_XLA_MAX_SEQ"
+
+def crossover():
+    return int(os.environ.get(_ENV, "256"))
+''',
+    ),
+    "APX109": (
+        '''
+import jax
+
+from apex_tpu.transformer.parallel_state import PIPE_AXIS
+
+@jax.jit
+def sync_embedding_grads(g):
+    if jax.process_index() == 0:
+        g = jax.lax.psum(g, PIPE_AXIS)
+    return g
+''',
+        '''
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPE_AXIS
+
+@jax.jit
+def sync_embedding_grads(g, member):
+    # masked collective EVERY rank enters — no divergent branch
+    return jax.lax.psum(jnp.where(member, g, jnp.zeros_like(g)),
+                        PIPE_AXIS)
+''',
+    ),
 }
 
 

@@ -1,0 +1,316 @@
+"""Seeded-violation tests for the SPMD soundness auditor: every check
+class must actually FIRE on a known-bad executable and stay quiet on
+the corrected twin — the auditor equivalent of the lint fixture pairs.
+"""
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from apex_tpu.analysis.spmd_audit import (ExecSpec, _audit_exec,
+                                          compare_budget, run_spmd_audit)
+from apex_tpu.analysis.comm_model import (comm_report, peak_live_bytes,
+                                          ring_allreduce_bytes)
+
+shard_map = functools.partial(jax.shard_map, check_vma=False)
+
+
+def _mesh(n=2, axis="data"):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def _spec(name, fn, args, axes, **kw):
+    return ExecSpec(name, "<seeded>", lambda: (fn, args, axes), **kw)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# --- APX211: collective on a non-canonical axis -----------------------------
+
+def test_axis_mismatch_fires():
+    mesh = _mesh(axis="datum")  # not a parallel_state axis
+    fn = shard_map(lambda x: jax.lax.psum(x, "datum"), mesh=mesh,
+                   in_specs=(P("datum"),), out_specs=P())
+    f, _ = _audit_exec(_spec("seeded_axis", fn,
+                             (jnp.ones((8, 4)),), {"datum": 2}))
+    assert "APX211" in _rules(f), _rules(f)
+
+
+def test_canonical_axis_is_clean():
+    mesh = _mesh()
+    fn = shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                   in_specs=(P("data"),), out_specs=P())
+    f, _ = _audit_exec(_spec("clean_axis", fn,
+                             (jnp.ones((8, 4)),), {"data": 2}))
+    assert f == [], _rules(f)
+
+
+# --- APX212: cond branches with mismatched collective multisets -------------
+
+def test_branch_collective_mismatch_fires():
+    mesh = _mesh()
+
+    def body(x, flag):
+        return jax.lax.cond(flag > 0,
+                            lambda: jax.lax.psum(x, "data"),
+                            lambda: x * 2.0)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("data"), P()),
+                   out_specs=P("data"))
+    f, _ = _audit_exec(_spec("seeded_branch", fn,
+                             (jnp.ones((8, 4)), jnp.float32(1.0)),
+                             {"data": 2}))
+    assert "APX212" in _rules(f), _rules(f)
+
+
+def test_matching_branch_collectives_clean():
+    mesh = _mesh()
+
+    def body(x, flag):
+        return jax.lax.cond(flag > 0,
+                            lambda: jax.lax.psum(x * 2.0, "data"),
+                            lambda: jax.lax.psum(x, "data"))
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("data"), P()),
+                   out_specs=P())
+    f, _ = _audit_exec(_spec("clean_branch", fn,
+                             (jnp.ones((8, 4)), jnp.float32(1.0)),
+                             {"data": 2}))
+    assert f == [], _rules(f)
+
+
+# --- APX213: rank-varying control values ------------------------------------
+
+def test_varying_cond_predicate_over_collective_branches_fires():
+    mesh = _mesh()
+
+    def body(x):
+        # predicate derives from the rank-local shard, branches carry a
+        # collective: the classic divergent-entry deadlock
+        return jax.lax.cond(jnp.sum(x) > 0,
+                            lambda: jax.lax.psum(x, "data"),
+                            lambda: jax.lax.psum(x * 2.0, "data"))
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    f, _ = _audit_exec(_spec("seeded_pred", fn,
+                             (jnp.ones((8, 4)),), {"data": 2}))
+    assert "APX213" in _rules(f), _rules(f)
+
+
+def test_pmaxed_predicate_is_clean():
+    mesh = _mesh()
+
+    def body(x):
+        uniform = jax.lax.pmax(jnp.sum(x), "data")
+        return jax.lax.cond(uniform > 0,
+                            lambda: jax.lax.psum(x, "data"),
+                            lambda: jax.lax.psum(x * 2.0, "data"))
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    f, _ = _audit_exec(_spec("clean_pred", fn,
+                             (jnp.ones((8, 4)),), {"data": 2}))
+    assert f == [], _rules(f)
+
+
+def test_non_uniform_noop_flag_fires():
+    """The PR 3 invariant, seeded broken: found_inf from the LOCAL grad
+    shard feeds the fused update kernel without the pmax — each rank
+    would skip (or not) alone and the masters diverge."""
+    from apex_tpu.ops.fused_update import fused_adam_flat, fused_scale
+    mesh = _mesh()
+    n = 512
+
+    def body(p, g, m, v):
+        g, flag = fused_scale(g, 1.0 / 65536.0)   # rank-local overflow flag
+        return fused_adam_flat(p, g, m, v, lr=1e-3, beta1=0.9,
+                               beta2=0.999, eps=1e-8, weight_decay=0.0,
+                               step=1, noop_flag=flag)
+
+    args = tuple(jnp.ones((n,), jnp.float32) for _ in range(4))
+    fn = shard_map(body, mesh=mesh, in_specs=(P(), P("data"), P(), P()),
+                   out_specs=(P(), P(), P()))
+    f, _ = _audit_exec(_spec("seeded_noop", fn, args, {"data": 2},
+                             check_update_uniformity=True))
+    assert "APX213" in _rules(f), _rules(f)
+    assert any("noop_flag" in x.message or "update kernel" in x.message
+               for x in f if x.rule == "APX213")
+
+
+def test_pmaxed_noop_flag_is_clean():
+    from apex_tpu.ops.fused_update import fused_adam_flat, fused_scale
+    mesh = _mesh()
+    n = 512
+
+    def body(p, g, m, v):
+        g, flag = fused_scale(g, 1.0 / 65536.0)
+        flag = jax.lax.pmax(flag, "data")          # replica-uniform
+        return fused_adam_flat(p, g, m, v, lr=1e-3, beta1=0.9,
+                               beta2=0.999, eps=1e-8, weight_decay=0.0,
+                               step=1, noop_flag=flag)
+
+    args = tuple(jnp.ones((n,), jnp.float32) for _ in range(4))
+    fn = shard_map(body, mesh=mesh, in_specs=(P(), P("data"), P(), P()),
+                   out_specs=(P(), P(), P()))
+    f, _ = _audit_exec(_spec("clean_noop", fn, args, {"data": 2},
+                             check_update_uniformity=True))
+    assert f == [], [(x.rule, x.message) for x in f]
+
+
+# --- APX214: donation verification ------------------------------------------
+
+def test_unaliasable_donation_fires():
+    # the donated fp32 buffer comes back bf16: XLA cannot alias it and
+    # the old buffer stays live — donation silently defeated
+    def step(state, batch):
+        return (state * 2.0).astype(jnp.bfloat16), jnp.sum(batch)
+
+    f, _ = _audit_exec(_spec("seeded_alias",
+                             step, (jnp.ones((1024,), jnp.float32),
+                                    jnp.ones((4,))), {},
+                             donate_argnums=(0,)))
+    assert "APX214" in _rules(f), _rules(f)
+    assert any("matches NO output" in x.message for x in f)
+
+
+def test_missing_donation_on_matching_buffer_fires():
+    def step(state, batch):
+        return state * 2.0, jnp.sum(batch)
+
+    f, _ = _audit_exec(_spec("seeded_undonated",
+                             step, (jnp.ones((1024,), jnp.float32),
+                                    jnp.ones((4,))), {},
+                             donate_argnums=(), flag_undonated=True))
+    assert "APX214" in _rules(f), _rules(f)
+    assert any("undonated" in x.message for x in f)
+
+
+def test_donated_step_is_clean():
+    def step(state, batch):
+        return state * 2.0, jnp.sum(batch)
+
+    f, _ = _audit_exec(_spec("clean_donated",
+                             step, (jnp.ones((1024,), jnp.float32),
+                                    jnp.ones((4,))), {},
+                             donate_argnums=(0,), flag_undonated=True))
+    assert f == [], [(x.rule, x.message) for x in f]
+
+
+# --- APX215: budget ratchet --------------------------------------------------
+
+def _entry(comm, peak):
+    return {"comm_bytes": comm, "by_collective": {"psum@data": comm},
+            "collective_counts": {"psum@data": 1},
+            "peak_live_bytes": peak, "axes": {"data": 2}}
+
+
+def test_budget_growth_fires():
+    report = {"version": 1, "executables": {"ddp_allreduce":
+                                            _entry(2048, 9000)}}
+    committed = {"version": 1, "executables": {"ddp_allreduce":
+                                               _entry(1024, 9000)}}
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX215"] and "grew" in f[0].message
+
+
+def test_peak_growth_fires_and_equal_is_clean():
+    committed = {"version": 1, "executables": {"ddp_allreduce":
+                                               _entry(1024, 9000)}}
+    grown = {"version": 1, "executables": {"ddp_allreduce":
+                                           _entry(1024, 9001)}}
+    assert _rules(compare_budget(grown, committed)) == ["APX215"]
+    same = {"version": 1, "executables": {"ddp_allreduce":
+                                          _entry(1024, 9000)}}
+    assert compare_budget(same, committed) == []
+    # shrinkage is silent (re-pin at leisure)
+    small = {"version": 1, "executables": {"ddp_allreduce":
+                                           _entry(512, 8000)}}
+    assert compare_budget(small, committed) == []
+
+
+def test_unbudgeted_executable_fires():
+    report = {"version": 1, "executables": {"ddp_allreduce":
+                                            _entry(1024, 9000)}}
+    f = compare_budget(report, {"version": 1, "executables": {}})
+    assert _rules(f) == ["APX215"] and "no committed budget" in f[0].message
+
+
+# --- APX216: the ZeRO RS+AG==AR machine check -------------------------------
+
+def test_rs_ag_identity_violation_fires():
+    # all-gather with NO reduce-scatter half: the PERF.md round-6
+    # regression shape (split-instead-of-reduce-scatter)
+    mesh = _mesh()
+    fn = shard_map(
+        lambda x: jax.lax.all_gather(x, "data", axis=0, tiled=True),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P())
+    f, entry = _audit_exec(_spec("seeded_identity", fn,
+                                 (jnp.ones((512,), jnp.float32),),
+                                 {"data": 2}, rs_ag_identity=True))
+    assert "APX216" in _rules(f), _rules(f)
+    assert entry["rs_ag_equals_ar"] is False
+
+
+def test_zero_step_satisfies_identity():
+    findings, report = run_spmd_audit(execs=["train_step_zero"])
+    assert findings == [], [(f.rule, f.message) for f in findings]
+    entry = report["executables"]["train_step_zero"]
+    assert entry["rs_ag_equals_ar"] is True
+    by = entry["by_collective"]
+    ag = sum(v for k, v in by.items() if k.startswith("all_gather@"))
+    rs = sum(v for k, v in by.items() if k.startswith("reduce_scatter@"))
+    # RS + AG == the ring all-reduce of the same flat buffer
+    dp = entry["axes"]["data"]
+    full_bytes = rs * dp // (dp - 1)
+    assert ag + rs == ring_allreduce_bytes(dp, full_bytes)
+
+
+# --- comm model arithmetic ---------------------------------------------------
+
+def test_comm_report_prices_ring_formulas():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    n = 4
+    payload = 1024 * 4  # [1024] f32
+
+    def body(x):
+        a = jax.lax.psum(x, "data")
+        b = jax.lax.all_gather(x, "data", axis=0, tiled=True)
+        c = jax.lax.psum_scatter(a, "data", scatter_dimension=0,
+                                 tiled=True)
+        return a, b, c
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P(),),
+                   out_specs=(P(), P(None), P("data")))
+    closed = jax.make_jaxpr(fn)(jnp.ones((1024,), jnp.float32))
+    rep = comm_report(closed, {"data": n})
+    by = rep["by_collective"]
+    assert by["psum@data"] == 2 * (n - 1) * payload // n
+    assert by["all_gather@data"] == (n - 1) * payload
+    # psum_scatter traces as reduce_scatter
+    rs = by.get("reduce_scatter@data", by.get("psum_scatter@data"))
+    assert rs == (n - 1) * payload // n
+    assert rep["total_bytes"] == sum(by.values())
+
+
+def test_peak_live_bytes_tracks_temporaries():
+    def small(x):
+        return x + 1.0
+
+    def big(x):
+        t = jnp.concatenate([x, x, x, x])   # 4x temporary
+        return t[: x.shape[0]] + 1.0
+
+    n = 1024
+    x = jnp.ones((n,), jnp.float32)
+    p_small = peak_live_bytes(jax.make_jaxpr(small)(x).jaxpr)
+    p_big = peak_live_bytes(jax.make_jaxpr(big)(x).jaxpr)
+    assert p_big >= p_small + 3 * n * 4

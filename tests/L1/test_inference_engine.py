@@ -1,15 +1,18 @@
-"""Inference engine: structural regression tests (ISSUE 4 acceptance).
+"""Inference engine: structural regression tests (ISSUE 4 acceptance;
+structural checks delegated to the analysis auditors in ISSUE 5).
 
 Pins the performance-shape properties the engine buys:
 
 1. decode is ONE donated executable — N steps after warmup trigger zero
    new compiles, and the donated cache buffers are actually reused
-   (old buffers invalidated), so no per-step cache reallocation exists;
-2. no host-transfer/callback primitive appears anywhere in the prefill
-   or decode jaxprs;
-3. prefill compiles once per prompt bucket, not once per prompt;
-4. the analysis auditor's inference entries trace clean (the subsystem
-   is under the precision/transfer audit from day one).
+   (old buffers invalidated), so no per-step cache reallocation exists
+   — the auditor-INDEPENDENT cross-check, measured from compile events
+   and live buffers rather than from any jaxpr walk;
+2. prefill compiles once per prompt bucket, not once per prompt;
+3. the jaxpr auditor's inference entries trace clean (bf16/transfer/
+   output-dtype policy, including no host prims in either executable)
+   and the SPMD auditor verifies the donation declarations against the
+   lowered executables + keeps prefill/decode in the comm/HBM budget.
 """
 import os
 import sys
@@ -21,10 +24,8 @@ import numpy as np
 sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..")))
 
-from apex_tpu.analysis.jaxpr_audit import FORBIDDEN_PRIMS, run_jaxpr_audit
+from apex_tpu.analysis.jaxpr_audit import run_jaxpr_audit
 from apex_tpu.inference import InferenceEngine
-from apex_tpu.inference.engine import make_decode_fn, make_prefill_fn
-from apex_tpu.inference.sampling import SamplingConfig
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
 
@@ -44,31 +45,24 @@ def _engine(slots=2, max_seq=64):
                                 max_seq=max_seq)
 
 
-def _iter_eqns(jaxpr):
-    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            for sub in (v if isinstance(v, (list, tuple)) else [v]):
-                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
-                    yield from _iter_eqns(sub)
+def test_spmd_audit_verifies_engine_donation_and_budget():
+    """The SPMD auditor owns the donation/structure assertions the
+    old hand-rolled jaxpr scans duplicated: both engine executables
+    audit clean (donated cache verified against the lowered
+    executables, no undonated alias-able buffers) and sit in the
+    committed comm/HBM budget ledger."""
+    from apex_tpu.analysis.spmd_audit import run_spmd_audit
 
-
-def test_no_host_transfer_prims_in_prefill_or_decode():
-    cfg, eng = _engine()
-    cache = eng.init_cache()
-    key = jax.random.PRNGKey(0)
-    decode = jax.make_jaxpr(make_decode_fn("gpt", cfg, SamplingConfig()))(
-        cache, eng.params, jnp.zeros((2,), jnp.int32),
-        jnp.ones((2,), bool), key, jnp.int32(0))
-    prefill = jax.make_jaxpr(make_prefill_fn("gpt", cfg,
-                                             SamplingConfig()))(
-        cache, eng.params, jnp.zeros((16,), jnp.int32), jnp.int32(0),
-        jnp.int32(8), key, jnp.int32(0))
-    for name, jaxpr in (("decode", decode), ("prefill", prefill)):
-        prims = {e.primitive.name for e in _iter_eqns(jaxpr)}
-        bad = prims & FORBIDDEN_PRIMS
-        assert not bad, f"{name} jaxpr contains host prims {bad}"
+    findings, report = run_spmd_audit(execs=["inference_prefill",
+                                             "inference_decode"])
+    assert findings == [], [(f.rule, f.message) for f in findings]
+    for name in ("inference_prefill", "inference_decode"):
+        entry = report["executables"][name]
+        # single-chip serving: NO collective appears in either program
+        # (count the primitives, not the bytes — these specs bind no
+        # mesh axes, so bytes would be 0 even with a stray collective)
+        assert entry["collective_counts"] == {}, entry["collective_counts"]
+        assert entry["peak_live_bytes"] > 0
 
 
 def test_decode_is_one_executable_and_donates():

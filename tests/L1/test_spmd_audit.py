@@ -1,0 +1,103 @@
+"""Tier-1 gate: ``apex-tpu-analyze --spmd --json`` runs the SPMD
+soundness auditor over ALL registered multi-device executables clean
+against the committed ``.analysis_budget.json``, the ``--json`` schema
+is stable, and the budget ratchet actually ratchets."""
+import json
+
+import pytest
+
+from apex_tpu.analysis.cli import main, repo_root
+from apex_tpu.analysis.spmd_audit import BUDGET_NAME
+
+REPO = repo_root()
+
+# the executables the auditor must cover (ISSUE 5 acceptance: >= 8)
+REQUIRED_EXECS = {
+    "train_step_dense", "train_step_zero", "ddp_allreduce",
+    "tp_column_row", "pipeline_1f1b", "ring_attention_cp",
+    "ulysses_attention_cp", "moe_dispatch", "inference_prefill",
+    "inference_decode",
+}
+
+
+def test_spmd_cli_clean_json_schema(capsys):
+    """One in-process run gates the whole SPMD engine: zero NEW
+    findings vs the committed baseline+budget, and the documented
+    --json schema.  (--no-lint/--no-jaxpr: those engines have their own
+    tier-1 gate in test_static_analysis.py — re-running them here would
+    double the fast lane's bill for identical coverage.)"""
+    rc = main(["--spmd", "--no-lint", "--no-jaxpr", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["new"]
+
+    # schema (documented in README "Static analysis"): stable top-level
+    # keys + per-executable budget fields
+    assert set(out) == {"new", "suppressed", "total", "budget"}
+    assert out["new"] == []
+    budget = out["budget"]
+    assert budget["version"] == 1
+    execs = budget["executables"]
+    assert REQUIRED_EXECS <= set(execs), sorted(execs)
+    for name, entry in execs.items():
+        assert {"comm_bytes", "by_collective", "collective_counts",
+                "peak_live_bytes", "axes"} <= set(entry), name
+        assert entry["comm_bytes"] == sum(entry["by_collective"].values())
+
+    # the distributed executables actually exercise their collectives
+    zero = execs["train_step_zero"]["by_collective"]
+    assert any(k.startswith("all_gather@") for k in zero)
+    assert any(k.startswith(("reduce_scatter@", "psum_scatter@"))
+               for k in zero)
+    assert any(k.startswith("pmax@") for k in zero)
+    assert execs["train_step_zero"]["rs_ag_equals_ar"] is True
+    assert any(k.startswith("ppermute@") for k in
+               execs["pipeline_1f1b"]["by_collective"])
+    assert any(k.startswith("all_to_all@") for k in
+               execs["ulysses_attention_cp"]["by_collective"])
+    assert any(k.startswith("all_to_all@") for k in
+               execs["moe_dispatch"]["by_collective"])
+
+
+def test_committed_budget_is_current():
+    """The committed ledger matches a fresh audit bit-for-bit — a PR
+    that changes a registered executable's comm/memory shape must
+    re-pin the budget consciously."""
+    committed = json.loads((REPO / BUDGET_NAME).read_text())
+    from apex_tpu.analysis.spmd_audit import run_spmd_audit
+    findings, report = run_spmd_audit(execs=["ddp_allreduce",
+                                             "tp_column_row"])
+    assert findings == []
+    for name in ("ddp_allreduce", "tp_column_row"):
+        assert report["executables"][name] == \
+            committed["executables"][name], name
+
+
+def test_budget_ratchet_fires_on_growth(tmp_path, capsys):
+    """A budget pinned BELOW the current ledger fails the run (comm
+    growth detected); re-pinning with --write-budget clears it."""
+    budget = tmp_path / "budget.json"
+    args = ["--spmd", "--execs", "ddp_allreduce", "--no-lint",
+            "--no-jaxpr", "--budget", str(budget)]
+    assert main(args + ["--write-budget"]) == 0
+    capsys.readouterr()
+
+    pinned = json.loads(budget.read_text())
+    entry = pinned["executables"]["ddp_allreduce"]
+    assert entry["comm_bytes"] > 0
+    entry["comm_bytes"] -= 1          # yesterday's executable was leaner
+    budget.write_text(json.dumps(pinned))
+    rc = main(args)
+    out = capsys.readouterr().out
+    assert rc == 1 and "APX215" in out and "grew" in out
+
+    # re-pin -> clean
+    assert main(args + ["--write-budget"]) == 0
+    capsys.readouterr()
+    assert main(args) == 0
+
+
+def test_write_budget_refuses_restricted_scan(tmp_path):
+    # an --execs-restricted run must not replace the shared repo budget
+    rc = main(["--spmd", "--execs", "ddp_allreduce", "--no-lint",
+               "--no-jaxpr", "--write-budget"])
+    assert rc == 2

@@ -1,14 +1,18 @@
 """ZeRO-sharded train step: structural + equivalence regressions
-(ISSUE 3 acceptance).
+(ISSUE 3 acceptance; structural checks delegated to the SPMD auditor
+in ISSUE 5).
 
-1. the jaxpr of the zero step shows the fused computation-collective
-   shape — ``all_gather`` (params into the forward) and
-   ``reduce_scatter`` (autodiff's transpose of that gather IS the grad
-   reduce-scatter) — with NO param-leaf re-ravel concatenate and no
-   host-transfer primitive;
-2. the whole zero step (forward, backward, reduce-scatter, fused
-   unscale + overflow flag, sharded update, all-gather) compiles to
-   ONE donated executable;
+1. the SPMD auditor audits the registered ``train_step_zero``
+   executable clean and its ledger shows the fused
+   computation-collective shape — ``all_gather`` (params into the
+   forward), ``reduce_scatter`` (autodiff's transpose of that gather
+   IS the grad reduce-scatter), the replica-uniform ``pmax``'d
+   overflow flag, verified donation, and the RS+AG==AR byte identity —
+   plus the one property the auditor does not own: NO param-leaf
+   re-ravel concatenate;
+2. independent cross-check: the whole zero step compiles to ONE
+   donated executable, measured by compile-event counting (not derived
+   from the jaxpr the auditor already walked);
 3. a dp=2 zero run matches the dense single-device replay on loss and
    post-update master, including an overflow-skip step where the
    poison hits only ONE rank's shard (the pmax'd found_inf must stop
@@ -30,7 +34,6 @@ sys.path.insert(0, os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..")))
 
 from apex_tpu import train_step
-from apex_tpu.analysis.jaxpr_audit import FORBIDDEN_PRIMS
 from apex_tpu.optimizers import functional
 from apex_tpu.utils import tree_ravel
 
@@ -92,20 +95,30 @@ def _zero_setup(loss_scale=None, placed=False):
     return params, tx, state, sharded
 
 
-def test_zero_jaxpr_scatter_gather_no_reravel_no_host_transfer():
+def test_zero_spmd_audit_clean_and_ledger():
+    """The SPMD auditor owns the collective/donation/uniformity
+    assertions: the registered zero executable audits clean, and its
+    comm ledger carries exactly the fused computation-collective shape
+    PR 3 built (AG + RS + pmax, RS+AG==AR).  The one structural
+    property outside the auditor's scope — no param-leaf re-ravel
+    concatenate — stays a direct jaxpr scan."""
+    from apex_tpu.analysis.spmd_audit import run_spmd_audit
+
+    findings, report = run_spmd_audit(execs=["train_step_zero"])
+    assert findings == [], [(f.rule, f.message) for f in findings]
+    entry = report["executables"]["train_step_zero"]
+    by = entry["by_collective"]
+    assert any(k.startswith("all_gather@data") for k in by), by
+    assert any(k.startswith(("reduce_scatter@data", "psum_scatter@data"))
+               for k in by), by
+    assert any(k.startswith("pmax@data") for k in by), by
+    # the PERF.md round-6 accounting, machine-checked on the jaxpr
+    assert entry["rs_ag_equals_ar"] is True
+
+    # auditor-independent: no grad re-ravel concatenate (PR 2's
+    # flat-native property; the auditor does not model it)
     params, tx, state, sharded = _zero_setup(loss_scale="dynamic")
     jaxpr = jax.make_jaxpr(sharded)(state, _batch())
-    names = {e.primitive.name for e in _iter_eqns(jaxpr)}
-
-    # the fused computation-collective pair: params all-gather + the
-    # grad reduce-scatter produced BY autodiff (psum_scatter lowers to
-    # the reduce_scatter primitive; accept either name)
-    assert "all_gather" in names, sorted(names)
-    assert names & {"reduce_scatter", "psum_scatter"}, sorted(names)
-    # replica-uniform overflow flag
-    assert "pmax" in names, sorted(names)
-
-    # no grad re-ravel concatenate over the parameter leaves
     n_leaves = len(jax.tree.leaves(params))
     n_params = int(tree_ravel(params)[0].size)
     reravel = [
@@ -115,11 +128,10 @@ def test_zero_jaxpr_scatter_gather_no_reravel_no_host_transfer():
         and len(e.invars) >= n_leaves // 2]
     assert not reravel, "zero step rebuilt flat grads by concatenation"
 
-    # no host transfer anywhere in the program
-    assert not (names & FORBIDDEN_PRIMS), names & FORBIDDEN_PRIMS
-
 
 def test_zero_step_compiles_one_donated_executable():
+    # the auditor-INDEPENDENT cross-check: compile-event counting sees
+    # the actual executable count, not the jaxpr the auditor walks
     _, _, state, sharded = _zero_setup(loss_scale="dynamic", placed=True)
     step = jax.jit(sharded, donate_argnums=(0,))
     batch = jax.device_put(_batch())
