@@ -53,6 +53,24 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "infer bench captures); per-call override: "
                "decode_attention(xla_max_seq=...)",
         read_by="apex_tpu/ops/attention.py"),
+    EnvKnob(
+        name="APEX_TPU_PAGE_SIZE",
+        default="64",
+        effect="default KV page size (tokens per page, power of two) "
+               "for paged inference engines that don't pass "
+               "page_size= explicitly; stamped into paged infer bench "
+               "captures",
+        read_by="apex_tpu/inference/kv_cache.py"),
+    EnvKnob(
+        name="APEX_TPU_PAGED_XLA_MAX_PAGES",
+        default="64",
+        effect="paged_decode_attention gathers slot windows through "
+               "the XLA einsum chain at or below this many pages per "
+               "slot and streams pages with the Pallas kernel above "
+               "it (PROVISIONAL crossover, stamped into paged infer "
+               "bench captures); per-call override: "
+               "paged_decode_attention(xla_max_pages=...)",
+        read_by="apex_tpu/ops/paged_attention.py"),
 ]}
 
 

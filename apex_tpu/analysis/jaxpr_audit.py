@@ -178,6 +178,53 @@ def _builders():
         return (fn, (cache, params, s((2,), jnp.int32), s((2,), bool),
                      key, s((), jnp.int32)))
 
+    def _paged_engine_audit_pieces():
+        """Straggler-shaped paged fixture (ISSUE 6): slots x max_seq
+        would be 4 x 256 = 1024 cached tokens dense, but the pool holds
+        only 20 pages x 16 = 320 (mean_seq << max_seq sizing) — the
+        geometry the APX215 peak-live comparison test measures the
+        paged win on.  attn_max_pages=0 pins the Pallas kernel path so
+        the registered executable is the one with NO materialized
+        gather window."""
+        import flax  # noqa: F401 — optional dep; ImportError skips
+        from apex_tpu.inference import kv_cache
+        from apex_tpu.inference.sampling import SamplingConfig
+        from apex_tpu.transformer import parallel_state
+        from apex_tpu.transformer.testing import (GPTConfig,
+                                                  gpt_model_provider)
+        if not parallel_state.model_parallel_is_initialized():
+            parallel_state.initialize_model_parallel(1)
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_attention_heads=4, max_seq_length=256,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        params_dtype=bf16)
+        model = gpt_model_provider(cfg)
+        params = jax.eval_shape(model.init, jax.random.PRNGKey(0),
+                                s((1, 8), jnp.int32))
+        cache = jax.eval_shape(
+            lambda: kv_cache.init_paged_cache(
+                20, cfg.num_layers, cfg.num_attention_heads, 16,
+                64 // cfg.num_attention_heads, slots=4,
+                max_pages_per_slot=16))
+        cache = cache.replace(attn_max_pages=0)
+        key = s((2,), jnp.uint32)
+        return cfg, SamplingConfig(), params, cache, key
+
+    def inference_prefill_paged():
+        from apex_tpu.inference.engine import make_prefill_fn
+        cfg, sampling, params, cache, key = _paged_engine_audit_pieces()
+        fn = make_prefill_fn("gpt", cfg, sampling, paged=True)
+        return (fn, (cache, params, s((64,), jnp.int32),
+                     s((), jnp.int32), s((), jnp.int32),
+                     s((16,), jnp.int32), key, s((), jnp.int32)))
+
+    def inference_decode_paged():
+        from apex_tpu.inference.engine import make_decode_fn
+        cfg, sampling, params, cache, key = _paged_engine_audit_pieces()
+        fn = make_decode_fn("gpt", cfg, sampling)
+        return (fn, (cache, params, s((4,), jnp.int32), s((4,), bool),
+                     key, s((), jnp.int32)))
+
     return {
         # budgets are the measured entry upcasts (γ/β applied in fp32 by
         # design — see the kernel docstrings); any increase fails
@@ -200,10 +247,11 @@ def _builders():
         # transfer discipline only
         "moe_layer": (moe_layer, "apex_tpu/transformer/moe/layer.py",
                       None, None),
-        # the inference subsystem's device programs (ISSUE 4): the
+        # the inference subsystem's device programs (ISSUE 4/6): the
         # decode core holds the full bf16 policy; the whole prefill/
-        # decode executables pin output dtypes (cache bf16 / sampled
-        # tokens int32 / logits fp32) and transfer discipline — a host
+        # decode executables pin output dtypes (cache bf16 / page
+        # table + lengths + capacity + sampled tokens int32 / logits
+        # fp32 / truncated flags bool) and transfer discipline — a host
         # callback sneaking into the serving hot loop fails the audit.
         # Per-layer LN entry upcasts make a whole-model upcast budget
         # churn with depth, so the engine entries skip that one check
@@ -218,7 +266,17 @@ def _builders():
         "inference_decode": (inference_decode,
                              "apex_tpu/inference/engine.py",
                              ("bfloat16", "bfloat16", "int32", "int32",
-                              "float32"), None),
+                              "float32", "bool"), None),
+        "inference_prefill_paged": (inference_prefill_paged,
+                                    "apex_tpu/inference/engine.py",
+                                    ("bfloat16", "bfloat16", "int32",
+                                     "int32", "int32", "int32",
+                                     "float32"), None),
+        "inference_decode_paged": (inference_decode_paged,
+                                   "apex_tpu/inference/engine.py",
+                                   ("bfloat16", "bfloat16", "int32",
+                                    "int32", "int32", "int32",
+                                    "float32", "bool"), None),
     }
 
 

@@ -337,6 +337,16 @@ def _builders():
         "inference_decode": (lambda: _inference("inference_decode"),
                              "apex_tpu/inference/engine.py",
                              (0,), True, False, False),
+        # the paged serving memory model (ISSUE 6), registered at a
+        # straggler-shaped fixture: the pool (+page table) is donated
+        # like the dense cache, and its APX215 peak-live entry is the
+        # number the paged-vs-dense HBM comparison test ratchets
+        "inference_prefill_paged": (
+            lambda: _inference("inference_prefill_paged"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False),
+        "inference_decode_paged": (
+            lambda: _inference("inference_decode_paged"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False),
     }
 
 

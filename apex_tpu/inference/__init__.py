@@ -2,19 +2,29 @@
 
 The inference workload as a first-class subsystem (ISSUE 4): a
 prefill/decode engine whose decode step is ONE donated XLA executable
-over a statically shaped slot KV cache, fed by a host-side
-continuous-batching scheduler.
+over a statically shaped KV cache, fed by a host-side
+continuous-batching scheduler.  Two cache layouts (ISSUE 6):
+
+    dense  [slots, layers, kv_heads, max_seq, d] — one contiguous
+           window per slot; HBM scales with the WORST-case sequence
+    paged  [pages, layers, kv_heads, page_size, d] + a [slots,
+           max_pages_per_slot] page table — HBM bounded by the pool;
+           the scheduler admits by free PAGES, so concurrency scales
+           with the mean sequence, not the straggler
 
     engine     prefill/decode executables, weight export boundaries
-    kv_cache   [slots, layers, kv_heads, max_seq, d] donated cache
+    kv_cache   donated slot cache + paged pool / host PageAllocator
     models     pure cache-aware forwards over the flax param trees
     sampling   greedy / temperature / top-k with explicit key threading
-    scheduler  static-bucket continuous batching (host-side slots)
+    scheduler  static-bucket continuous batching (host-side slots+pages)
 
 Quick start (see README "Inference")::
 
     from apex_tpu.inference import InferenceEngine
     engine = InferenceEngine("gpt", cfg, params, slots=8)
+    # paged: bound KV HBM by a page pool instead of slots * max_seq
+    engine = InferenceEngine("gpt", cfg, params, slots=32,
+                             page_size=64, num_pages=256)
     outputs = engine.generate(prompts, max_new_tokens=32)
 """
 from apex_tpu.inference.engine import (
@@ -23,7 +33,14 @@ from apex_tpu.inference.engine import (
     make_prefill_fn,
     prefill_bucket,
 )
-from apex_tpu.inference.kv_cache import KVCache, init_cache
+from apex_tpu.inference.kv_cache import (
+    KVCache,
+    PageAllocator,
+    PagedKVCache,
+    default_page_size,
+    init_cache,
+    init_paged_cache,
+)
 from apex_tpu.inference.sampling import SamplingConfig, greedy, sample_token
 from apex_tpu.inference.scheduler import Request, SlotScheduler, generate
 
@@ -31,6 +48,10 @@ __all__ = [
     "InferenceEngine",
     "KVCache",
     "init_cache",
+    "PagedKVCache",
+    "init_paged_cache",
+    "PageAllocator",
+    "default_page_size",
     "SamplingConfig",
     "greedy",
     "sample_token",

@@ -16,6 +16,14 @@ Workload split (the flash-attention/Megatron serving shape):
   derived in-program (``fold_in(key, step)``), so sampled decoding adds
   no second executable.
 
+Cache layouts (ISSUE 6): the dense slot cache provisions ``max_seq``
+per slot; ``page_size=``/``num_pages=`` switch to the ragged paged
+pool — k/v in fixed-size pages threaded through a traced per-slot page
+table (``paged_decode_attention`` per layer), the host-side
+``PageAllocator`` handing out reservations.  Same two executables,
+same donation discipline; only the memory model (and the scheduler's
+admission unit — pages, not slots) changes.
+
 No host transfer appears anywhere in either jaxpr (audited by
 ``analysis/jaxpr_audit.py`` — the inference entries trace these exact
 step builders); the only device<->host traffic is the scheduler reading
@@ -48,10 +56,14 @@ __all__ = ["InferenceEngine", "make_prefill_fn", "make_decode_fn",
            "prefill_bucket"]
 
 
-def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig):
-    """Pure prefill step: ``(cache, params, tokens [s], slot, length,
-    key, step) -> (cache, next_token, last_logits)``.  ``length`` is the
-    real prompt length inside the bucket-padded ``tokens``."""
+def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
+                    paged: bool = False):
+    """Pure prefill step.  Dense: ``(cache, params, tokens [s], slot,
+    length, key, step) -> (cache, next_token, last_logits)``; paged
+    takes an extra ``row`` operand (the slot's ``[max_pages_per_slot]``
+    page-table row) after ``length``, parking the prompt's pages
+    instead of a contiguous slab.  ``length`` is the real prompt length
+    inside the bucket-padded ``tokens``."""
 
     def prefill_fn(cache, params, tokens, slot, length, key, step):
         # length threads into the forward so the lm head projects ONLY
@@ -63,13 +75,26 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig):
         tok = sample_token(last, jax.random.fold_in(key, step), sampling)
         return cache, tok, last
 
-    return prefill_fn
+    def prefill_paged_fn(cache, params, tokens, slot, length, row, key,
+                         step):
+        logits, ks, vs = models.prefill_forward(kind, cfg, params,
+                                                tokens[None], length)
+        cache = kv_cache.insert_pages(cache, slot, ks, vs, length, row)
+        last = logits[0].astype(jnp.float32)                # [vocab]
+        tok = sample_token(last, jax.random.fold_in(key, step), sampling)
+        return cache, tok, last
+
+    return prefill_paged_fn if paged else prefill_fn
 
 
 def make_decode_fn(kind: str, cfg, sampling: SamplingConfig):
     """Pure decode step: ``(cache, params, tokens [slots], active
-    [slots], key, step) -> (cache, next_tokens, logits)``.  Every slot
-    computes (static shape); only active slots advance their length."""
+    [slots], key, step) -> (cache, next_tokens, logits, truncated)``.
+    Every slot computes (static shape); only active slots advance their
+    length, and ``truncated`` flags active slots already at capacity
+    whose emitted token could NOT be appended (the caller must retire
+    them — nothing is clamped silently).  Serves both cache layouts:
+    the paged pool threads its page table through the same signature."""
 
     def decode_fn(cache, params, tokens, active, key, step):
         logits, cache = models.decode_forward(kind, cfg, params, cache,
@@ -77,8 +102,8 @@ def make_decode_fn(kind: str, cfg, sampling: SamplingConfig):
         logits = logits.astype(jnp.float32)
         toks = sample_token(logits, jax.random.fold_in(key, step),
                             sampling)
-        cache = kv_cache.advance(cache, active)
-        return cache, toks, logits
+        cache, truncated = kv_cache.advance(cache, active)
+        return cache, toks, logits, truncated
 
     return decode_fn
 
@@ -108,7 +133,10 @@ class InferenceEngine:
                  max_seq: Optional[int] = None, dtype=None,
                  cache_dtype=jnp.bfloat16,
                  sampling: SamplingConfig = SamplingConfig(),
-                 seed: int = 0):
+                 seed: int = 0, paged: bool = False,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 paged_attn_max_pages: Optional[int] = None):
         if kind not in ("gpt", "llama", "bert"):
             raise ValueError(f"unknown model kind {kind!r}")
         if kind != "bert":
@@ -119,6 +147,40 @@ class InferenceEngine:
                            cfg.max_seq_length)
         self.cache_dtype = cache_dtype
         self.sampling = sampling
+        # paged mode (ISSUE 6): HBM bounded by the page POOL, not by
+        # slots * max_seq — any paged kwarg opts in
+        self.paged = bool(paged or page_size is not None
+                          or num_pages is not None)
+        if kind == "bert" and self.paged:
+            raise ValueError("BERT is the encode-only path (no KV "
+                             "cache); paged kwargs do not apply")
+        if self.paged:
+            self.page_size = int(page_size if page_size is not None
+                                 else kv_cache.default_page_size())
+            if self.page_size < 1 or (self.page_size &
+                                      (self.page_size - 1)):
+                raise ValueError(
+                    f"page_size must be a positive power of two (so "
+                    f"prefill buckets tile into whole pages), got "
+                    f"{self.page_size}")
+            if self.max_seq % self.page_size:
+                raise ValueError(
+                    f"max_seq ({self.max_seq}) must be a multiple of "
+                    f"page_size ({self.page_size})")
+            self.max_pages_per_slot = self.max_seq // self.page_size
+            # default pool = dense-equivalent capacity; size it SMALLER
+            # (the point of paging) to bound HBM by expected load
+            self.num_pages = int(
+                num_pages if num_pages is not None
+                else self.slots * self.max_pages_per_slot)
+            if self.num_pages < 1:
+                raise ValueError(
+                    f"num_pages must be >= 1, got {self.num_pages}")
+            self.paged_attn_max_pages = paged_attn_max_pages
+        else:
+            self.page_size = self.num_pages = None
+            self.max_pages_per_slot = None
+            self.paged_attn_max_pages = None
         if dtype is not None:
             from apex_tpu.optimizers.functional import _cast_floating
             params = _cast_floating(params, dtype)
@@ -130,19 +192,48 @@ class InferenceEngine:
         else:
             self.dims = models.model_dims(kind, cfg)
             self._prefill = jax.jit(
-                make_prefill_fn(kind, cfg, sampling), donate_argnums=(0,))
+                make_prefill_fn(kind, cfg, sampling, paged=self.paged),
+                donate_argnums=(0,))
             self._decode = jax.jit(
                 make_decode_fn(kind, cfg, sampling), donate_argnums=(0,))
 
     # -- cache ---------------------------------------------------------------
-    def init_cache(self) -> kv_cache.KVCache:
+    def init_cache(self):
         if self.kind == "bert":
             raise ValueError("BERT is the encode-only path (no KV "
                              "cache); use encode()")
         d = self.dims
+        if self.paged:
+            return kv_cache.init_paged_cache(
+                self.num_pages, d["layers"], d["kv_heads"],
+                self.page_size, d["head_dim"], slots=self.slots,
+                max_pages_per_slot=self.max_pages_per_slot,
+                dtype=self.cache_dtype,
+                attn_max_pages=self.paged_attn_max_pages)
         return kv_cache.init_cache(
             self.slots, d["layers"], d["kv_heads"], self.max_seq,
             d["head_dim"], dtype=self.cache_dtype)
+
+    def new_allocator(self) -> kv_cache.PageAllocator:
+        """Fresh host-side page allocator matching the engine's pool
+        geometry (paged mode only) — one per cache lifetime; the
+        scheduler owns it alongside its slot bookkeeping."""
+        if not self.paged:
+            raise ValueError("new_allocator() is the paged-mode page "
+                             "bookkeeping; this engine runs the dense "
+                             "slot cache")
+        return kv_cache.PageAllocator(self.num_pages, self.page_size,
+                                      self.max_pages_per_slot)
+
+    def cache_hbm_bytes(self) -> int:
+        """Bytes the KV cache pins in HBM: pool pages (paged, incl. the
+        trash page) or slots x max_seq (dense)."""
+        d = self.dims
+        itemsize = jnp.dtype(self.cache_dtype).itemsize
+        per_tok = 2 * d["layers"] * d["kv_heads"] * d["head_dim"] * itemsize
+        if self.paged:
+            return (self.num_pages + 1) * self.page_size * per_tok
+        return self.slots * self.max_seq * per_tok
 
     # -- generative path -----------------------------------------------------
     def _next_step(self):
@@ -153,29 +244,57 @@ class InferenceEngine:
         self._step += 1
         return np.int32(s)
 
-    def prefill(self, cache, tokens, slot):
+    def prefill(self, cache, tokens, slot, pages=None):
         """Admit one prompt into ``slot``: returns ``(cache, next_token,
         last_logits)``.  ``tokens`` is the UNPADDED prompt (list/array of
-        ints); padding to the executable bucket happens here."""
+        ints); padding to the executable bucket happens here.
+
+        Paged mode additionally takes ``pages`` — the page-ID list the
+        :class:`~apex_tpu.inference.kv_cache.PageAllocator` reserved
+        for this request (prompt + decode headroom); the bucket rounds
+        up to whole pages, and bucket pages beyond the reservation spill
+        into the pool's trash page by construction."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = tokens.shape[0]
-        bucket = prefill_bucket(n, self.max_seq)
+        min_bucket = max(64, self.page_size) if self.paged else 64
+        bucket = prefill_bucket(n, self.max_seq, min_bucket=min_bucket)
         padded = np.zeros((bucket,), np.int32)
         padded[:n] = tokens
+        if self.paged:
+            if pages is None:
+                raise ValueError(
+                    "paged prefill needs the slot's reserved page IDs "
+                    "(engine.new_allocator().alloc(...)); the scheduler "
+                    "threads them automatically")
+            if len(pages) * self.page_size < n:
+                raise ValueError(
+                    f"reservation of {len(pages)} page(s) x "
+                    f"{self.page_size} covers {len(pages) * self.page_size}"
+                    f" tokens < the {n}-token prompt — the prompt tail "
+                    f"would silently land in the trash page; reserve "
+                    f"ceil((prompt + max_new_tokens) / page_size) pages")
+            row = kv_cache.page_row(pages, self.max_pages_per_slot,
+                                    self.num_pages)
+            return self._prefill(cache, self.params, padded,
+                                 np.int32(slot), np.int32(n), row,
+                                 self._key, self._next_step())
         return self._prefill(cache, self.params, padded,
                              np.int32(slot), np.int32(n),
                              self._key, self._next_step())
 
     def decode(self, cache, last_tokens, active=None):
         """One token for every slot: returns ``(cache, next_tokens,
-        logits)``; only ``active`` slots advance their cache length.
+        logits, truncated)``; only ``active`` slots advance their cache
+        length.
 
-        Capacity contract: a slot whose length has reached ``max_seq``
-        must be retired (deactivated) by the caller before further
-        steps — the scheduler tracks this host-side from prompt/output
-        lengths.  Past capacity the cache clamps (see
-        :func:`kv_cache.advance`) rather than corrupting earlier rows,
-        but the emitted tokens for that slot are no longer meaningful.
+        Capacity contract: a slot whose length has reached its capacity
+        (``max_seq`` dense; its page reservation paged) must be retired
+        (deactivated) by the caller before further steps — the
+        scheduler tracks this host-side from prompt/output lengths.
+        Past capacity the cache clamps (see :func:`kv_cache.advance`)
+        rather than corrupting earlier rows, and the returned
+        ``truncated`` vector flags every active slot whose token was
+        dropped by that clamp so no caller can miss it.
         """
         if active is None:
             active = np.ones((self.slots,), bool)

@@ -1,45 +1,91 @@
-"""Slot-based KV cache: statically shaped, donated pure updates.
+"""KV caches: statically shaped, donated pure updates.
 
-The serving-side analog of the flat optimizer master (ISSUE 2/3): ONE
-statically shaped buffer pair
+Two cache layouts share one mutation API (``insert*`` / ``append_layer``
+/ ``advance`` / ``evict``), both the serving-side analog of the flat
+optimizer master (ISSUE 2/3) — allocated once at engine construction,
+carried through the jitted prefill/decode executables, donated every
+step:
 
-    k, v : [slots, layers, kv_heads, max_seq, head_dim]
+* :class:`KVCache` — the dense slot cache (ISSUE 4)::
 
-plus a ``[slots]`` length vector, carried through the jitted
-prefill/decode executables and donated every step — the cache is
-allocated once at engine construction and never reallocated, the same
-way the train step's FlatState master is.
+      k, v : [slots, layers, kv_heads, max_seq, head_dim]
 
-Design positions:
+  One contiguous ``max_seq`` window per slot: simple, but a single
+  128K-context straggler pins ``max_seq`` worth of HBM for EVERY slot.
 
-* **Slots, not sequences.**  A slot is a fixed-capacity cache line; the
-  host-side scheduler (``inference/scheduler.py``) maps live requests
-  onto slots between device steps, so admitting/retiring requests never
+* :class:`PagedKVCache` — the ragged paged pool (ISSUE 6, after
+  PAPERS.md "Ragged Paged Attention")::
+
+      k, v       : [pages, layers, kv_heads, page_size, head_dim]
+      page_table : [slots, max_pages_per_slot]  int32
+      lengths    : [slots]  int32   live tokens per slot
+      capacity   : [slots]  int32   page_size * pages owned by the slot
+
+  A slot's tokens live in whichever fixed-size pages the host-side
+  :class:`PageAllocator` handed it; the page table (a small int32
+  array, a *traced operand* like the lengths) maps virtual position
+  ``t`` to physical page ``page_table[slot, t // page_size]``.  HBM is
+  bounded by the POOL, not by ``slots * max_seq`` — concurrency scales
+  with the mean sequence, not the straggler.
+
+Shared design positions:
+
+* **Slots, not sequences.**  A slot is a fixed request lane; the
+  host-side scheduler maps live requests onto slots (and, paged, onto
+  pages) between device steps, so admitting/retiring requests never
   changes a device shape — the decode executable compiles once.
-* **GQA/MQA-aware.**  The cache stores ``kv_heads`` (the model's
-  ``cfg.kv_heads``), not query heads: k/v are cached at their
-  pre-broadcast width, so LLaMA's grouped/replicated-kv layout is
-  cached once per kv head and the group broadcast happens (implicitly)
-  inside :func:`apex_tpu.ops.attention.decode_attention`'s grouped
-  einsum — ``h // kv_heads``× less cache HBM, the whole point of GQA at
-  serving time.
+* **GQA/MQA-aware.**  Both caches store ``kv_heads`` (not query
+  heads): k/v are cached pre-broadcast, the group broadcast happens
+  inside the grouped attention ops.
 * **Pure donated updates.**  Every mutation is a
-  ``lax.dynamic_update_slice`` (prefill insert: one static-shape slab;
-  decode append: a vmap over slots, each writing one token row at its
-  own length) returning ``cache.replace(...)`` — donation-safe and
-  scan-carryable exactly like ``FlatState``.
+  ``lax.dynamic_update_slice`` returning ``cache.replace(...)`` —
+  donation-safe and scan-carryable exactly like ``FlatState``.  Page
+  indices come from the traced page table, so one compiled
+  insert/append serves every page assignment.
 * **Eviction is metadata.**  Retiring a request zeroes the slot's
-  length; the stale k/v rows are dead weight masked out by the length
-  and overwritten by the next insert.  No data movement on the retire
-  path.
+  length (and, paged, its capacity); the stale k/v rows are dead
+  weight masked out by the length.  No data movement on the retire
+  path — the host allocator reclaims the page IDs.
+* **The trash page.**  The paged pool carries ONE sacrificial page at
+  index ``pages - 1`` that the allocator never hands out; page-table
+  entries beyond a slot's reservation point there, so the statically
+  shaped prefill/append writes that overrun a reservation land
+  harmlessly instead of corrupting another slot's pages.
 """
 from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
 
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["KVCache", "init_cache"]
+__all__ = ["KVCache", "init_cache", "PagedKVCache", "init_paged_cache",
+           "PageAllocator", "default_page_size"]
+
+_PAGE_SIZE_ENV = "APEX_TPU_PAGE_SIZE"
+_DEFAULT_PAGE_SIZE = 64
+
+
+def default_page_size() -> int:
+    """Engine-default KV page size: ``APEX_TPU_PAGE_SIZE`` env var >
+    the built-in 64 (a power of two <= the smallest prefill bucket, so
+    buckets always tile exactly into pages)."""
+    env = os.environ.get(_PAGE_SIZE_ENV)
+    if env:
+        try:
+            val = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_PAGE_SIZE_ENV} must be an int, got {env!r}") from e
+        if val < 1 or (val & (val - 1)):
+            raise ValueError(
+                f"{_PAGE_SIZE_ENV} must be a positive power of two, "
+                f"got {val}")
+        return val
+    return _DEFAULT_PAGE_SIZE
 
 
 @flax.struct.dataclass
@@ -109,7 +155,7 @@ def insert(cache: KVCache, slot, k, v, length) -> KVCache:
     return cache.replace(k=new_k, v=new_v, lengths=new_len)
 
 
-def append_layer(cache: KVCache, layer: int, k_tok, v_tok) -> KVCache:
+def append_layer(cache, layer: int, k_tok, v_tok):
     """Decode write for ONE layer: each slot's token row lands at that
     slot's current length.
 
@@ -117,13 +163,16 @@ def append_layer(cache: KVCache, layer: int, k_tok, v_tok) -> KVCache:
     token's k/v per slot.  ``layer`` is static (the decode forward is an
     unrolled python loop over layers).  Lengths do NOT advance here —
     call :func:`advance` once after the last layer so every layer of a
-    decode step writes to the same position.
+    decode step writes to the same position.  Dispatches on the cache
+    layout: dense slot cache or paged pool.
     """
     if k_tok.shape != (cache.slots, cache.kv_heads, cache.head_dim):
         raise ValueError(
             f"token k/v must be [slots={cache.slots}, "
             f"kv_heads={cache.kv_heads}, head_dim={cache.head_dim}], "
             f"got {tuple(k_tok.shape)}")
+    if isinstance(cache, PagedKVCache):
+        return _append_layer_paged(cache, layer, k_tok, v_tok)
 
     def write(buf, tok, pos):
         # buf [kv_heads, max_seq, d], tok [kv_heads, d]: one token row
@@ -140,28 +189,301 @@ def append_layer(cache: KVCache, layer: int, k_tok, v_tok) -> KVCache:
     return cache.replace(k=new_k, v=new_v)
 
 
-def advance(cache: KVCache, active) -> KVCache:
+def advance(cache, active):
     """Advance the active slots' lengths by the one token the decode
     step just appended; inactive slots stay put (their garbage write at
-    position ``length`` stays dead).
+    position ``length`` stays dead).  Returns ``(cache, truncated)``.
 
-    Lengths clamp at ``max_seq``: a slot decoded past capacity stops
-    growing instead of walking its length off the buffer (the append's
-    clamped write would otherwise keep overwriting the last row while
-    the mask treats ever more rows as live).  Retiring full slots is
-    the scheduler's job — the clamp just bounds the damage of a missing
-    guard to the final cache row."""
-    return cache.replace(
-        lengths=jnp.minimum(
-            cache.lengths + jnp.asarray(active, jnp.int32),
-            jnp.int32(cache.max_seq)))
+    Lengths clamp at capacity (``max_seq`` dense, the slot's owned
+    pages paged): a slot decoded past capacity stops growing instead of
+    walking its length off the buffer.  ``truncated`` is a ``[slots]``
+    bool vector — True where an active slot was ALREADY at capacity, so
+    the token this step emitted for it could not be appended and its
+    stream is no longer extendable.  The silent clamp was ISSUE 6's
+    surfaced bug: callers (the scheduler) must retire truncated slots
+    and record why instead of dropping tokens on the floor."""
+    act = jnp.asarray(active)
+    cap = (cache.capacity if isinstance(cache, PagedKVCache)
+           else jnp.int32(cache.max_seq))
+    # cap > 0 gates the flag: a never-admitted paged slot (capacity 0)
+    # marked active is empty, not a truncated stream
+    truncated = act.astype(bool) & (cache.lengths >= cap) & (cap > 0)
+    new_len = jnp.minimum(cache.lengths + act.astype(jnp.int32), cap)
+    return cache.replace(lengths=new_len), truncated
 
 
-def evict(cache: KVCache, slot) -> KVCache:
-    """Retire a slot: zero its length.  Metadata-only — the k/v rows are
-    left in place, masked by the length, and overwritten by the next
-    insert into this slot."""
+def evict(cache, slot):
+    """Retire a slot: zero its length (and, paged, its capacity, with
+    the page-table row re-parked on the trash page).  Metadata-only —
+    the k/v rows/pages are left in place; a paged slot's page IDs are
+    reclaimed host-side by the :class:`PageAllocator`.
+
+    Paged eviction MUST run before the slot's pages are reassigned:
+    unlike the dense cache's slot-private rows, a stale page-table row
+    would keep routing the slot's (masked, garbage) decode appends into
+    pages that now belong to another request.  Resetting the row to the
+    trash page makes the idle slot's writes land where the pool absorbs
+    them by design."""
     slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.zeros((1,), jnp.int32)
+    new_len = jax.lax.dynamic_update_slice(cache.lengths, zero, (slot,))
+    if isinstance(cache, PagedKVCache):
+        null_row = jnp.full((1, cache.max_pages_per_slot),
+                            cache.null_page, jnp.int32)
+        return cache.replace(
+            lengths=new_len,
+            capacity=jax.lax.dynamic_update_slice(
+                cache.capacity, zero, (slot,)),
+            page_table=jax.lax.dynamic_update_slice(
+                cache.page_table, null_row, (slot, jnp.int32(0))))
+    return cache.replace(lengths=new_len)
+
+
+# --------------------------------------------------------------------------
+# ragged paged pool (ISSUE 6)
+# --------------------------------------------------------------------------
+
+@flax.struct.dataclass
+class PagedKVCache:
+    """Fixed-size page pool + per-slot page table (module docstring).
+
+    ``k``/``v`` hold ``pages`` physical pages of ``page_size`` token
+    rows each; the LAST page (``null_page == pages - 1``) is the trash
+    page the allocator never hands out.  ``page_table[slot, j]`` names
+    the physical page backing virtual positions ``[j*page_size,
+    (j+1)*page_size)`` of the slot; entries beyond the slot's
+    reservation hold ``null_page``.  ``capacity[slot]`` is
+    ``page_size *`` the slot's owned pages — the clamp bound
+    :func:`advance` enforces (the dense cache's ``max_seq``, made
+    per-slot).
+
+    ``attn_max_pages`` is STATIC aux data (not a leaf): the engine's
+    kernel/XLA crossover override for
+    :func:`~apex_tpu.ops.paged_attention.paged_decode_attention`
+    (None = the env/default dispatch).
+    """
+    k: jax.Array           # [pages, layers, kv_heads, page_size, head_dim]
+    v: jax.Array           # same shape/dtype as k
+    page_table: jax.Array  # [slots, max_pages_per_slot] int32
+    lengths: jax.Array     # [slots] int32: live tokens per slot
+    capacity: jax.Array    # [slots] int32: page_size * owned pages
+    attn_max_pages: Optional[int] = flax.struct.field(
+        pytree_node=False, default=None)
+
+    @property
+    def pages(self) -> int:
+        """Total physical pages INCLUDING the trash page."""
+        return self.k.shape[0]
+
+    @property
+    def null_page(self) -> int:
+        return self.k.shape[0] - 1
+
+    @property
+    def alloc_pages(self) -> int:
+        """Pages the allocator may hand out (pool minus the trash page)."""
+        return self.k.shape[0] - 1
+
+    @property
+    def layers(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def kv_heads(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def head_dim(self) -> int:
+        return self.k.shape[4]
+
+    @property
+    def slots(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        return self.page_table.shape[1]
+
+    @property
+    def max_seq(self) -> int:
+        """The virtual per-slot window: ``max_pages_per_slot *
+        page_size`` (what the dense cache calls ``max_seq``)."""
+        return self.page_table.shape[1] * self.k.shape[3]
+
+
+def init_paged_cache(pages: int, layers: int, kv_heads: int,
+                     page_size: int, head_dim: int, *, slots: int,
+                     max_pages_per_slot: int, dtype=jnp.bfloat16,
+                     attn_max_pages: Optional[int] = None) -> PagedKVCache:
+    """Allocate an empty pool: ``pages`` allocatable pages (+1 trash
+    page appended), every page-table entry pointing at the trash page,
+    every slot empty."""
+    if pages < 1 or page_size < 1 or max_pages_per_slot < 1:
+        raise ValueError(
+            f"pages ({pages}), page_size ({page_size}) and "
+            f"max_pages_per_slot ({max_pages_per_slot}) must be >= 1")
+    shape = (pages + 1, layers, kv_heads, page_size, head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        page_table=jnp.full((slots, max_pages_per_slot), pages,
+                            jnp.int32),
+        lengths=jnp.zeros((slots,), jnp.int32),
+        capacity=jnp.zeros((slots,), jnp.int32),
+        attn_max_pages=attn_max_pages)
+
+
+def page_row(page_ids: Sequence[int], max_pages_per_slot: int,
+             null_page: int) -> np.ndarray:
+    """Host helper: pad an allocator's page-ID list to a full
+    ``[max_pages_per_slot]`` int32 page-table row (dead entries point
+    at the trash page)."""
+    ids = list(page_ids)
+    if len(ids) > max_pages_per_slot:
+        raise ValueError(
+            f"{len(ids)} pages exceed max_pages_per_slot "
+            f"{max_pages_per_slot}")
+    return np.asarray(ids + [null_page] * (max_pages_per_slot - len(ids)),
+                      np.int32)
+
+
+def insert_pages(cache: PagedKVCache, slot, k, v, length,
+                 row) -> PagedKVCache:
+    """Prefill write: park a prompt's k/v into the slot's pages.
+
+    ``k``/``v``: ``[layers, kv_heads, s, head_dim]`` with ``s`` the
+    bucket-padded prompt length — ``s`` must tile into whole pages (the
+    engine guarantees it: buckets and page sizes are both powers of
+    two, ``page_size <= bucket``).  ``row`` is the slot's FULL page-
+    table row (``[max_pages_per_slot]`` int32, traced OK — see
+    :func:`page_row`); the first ``s // page_size`` entries receive the
+    prompt's pages, later owned entries are decode headroom, trash-page
+    entries absorb any static overhang harmlessly.  The slot's capacity
+    is derived in-program from the row (owned pages x page_size), so
+    one compiled insert serves every page assignment.
+    """
+    ps, s = cache.page_size, k.shape[2]
+    if k.shape != v.shape or k.shape[0] != cache.layers \
+            or k.shape[1] != cache.kv_heads \
+            or k.shape[3] != cache.head_dim:
+        raise ValueError(
+            f"prefill k/v must be [layers={cache.layers}, "
+            f"kv_heads={cache.kv_heads}, s, head_dim={cache.head_dim}], "
+            f"got k {tuple(k.shape)} v {tuple(v.shape)}")
+    if s % ps or s > cache.max_seq:
+        raise ValueError(
+            f"prompt slab length {s} must be a multiple of page_size "
+            f"{ps} and <= max_seq {cache.max_seq}")
+    row = jnp.asarray(row, jnp.int32)
+    if row.shape != (cache.max_pages_per_slot,):
+        raise ValueError(
+            f"page row must be [{cache.max_pages_per_slot}], got "
+            f"{tuple(row.shape)}")
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.int32(0)
+    n = s // ps
+
+    def paged_slab(x):
+        # [layers, kvh, s, d] -> [n, layers, kvh, ps, d]: one entry per
+        # bucket page, scattered to its physical page in ONE op (bucket
+        # overhang beyond the reservation targets the trash page; the
+        # trash page appearing more than once just stacks garbage)
+        return jnp.moveaxis(
+            x.reshape(x.shape[0], x.shape[1], n, ps, x.shape[3]), 2, 0)
+
+    new_k = cache.k.at[row[:n]].set(paged_slab(k).astype(cache.k.dtype),
+                                    mode="drop")
+    new_v = cache.v.at[row[:n]].set(paged_slab(v).astype(cache.v.dtype),
+                                    mode="drop")
+    owned = jnp.sum((row != cache.null_page).astype(jnp.int32))
     return cache.replace(
+        k=new_k, v=new_v,
+        page_table=jax.lax.dynamic_update_slice(
+            cache.page_table, row[None], (slot, zero)),
         lengths=jax.lax.dynamic_update_slice(
-            cache.lengths, jnp.zeros((1,), jnp.int32), (slot,)))
+            cache.lengths, jnp.asarray(length, jnp.int32)[None], (slot,)),
+        capacity=jax.lax.dynamic_update_slice(
+            cache.capacity, (owned * ps)[None], (slot,)))
+
+
+def _append_layer_paged(cache: PagedKVCache, layer: int, k_tok,
+                        v_tok) -> PagedKVCache:
+    """Paged decode write for ONE layer: slot ``i``'s token row lands in
+    page ``page_table[i, lengths[i] // page_size]`` at row
+    ``lengths[i] % page_size``.  One vectorized scatter per buffer
+    (every slot's ``(page, row)`` target derives from the traced
+    lengths/page table up front) — the paged analog of the dense
+    append's vmap, donation-safe like every ``.at[].set`` on a donated
+    operand.  At capacity the write clamps into the trash page / last
+    row — the same bounded-damage semantics as the dense clamp, with
+    the damage redirected off the live data entirely (slots at
+    capacity may alias the trash page; they hold garbage by contract,
+    so scatter order between them is irrelevant)."""
+    ps, mpps = cache.page_size, cache.max_pages_per_slot
+    pos = cache.lengths                                     # [slots]
+    ordinal = jnp.minimum(pos // ps, jnp.int32(mpps - 1))
+    pages = jnp.take_along_axis(cache.page_table, ordinal[:, None],
+                                axis=1)[:, 0]               # [slots]
+    offs = jnp.minimum(pos - ordinal * ps, jnp.int32(ps - 1))
+    # advanced indices (pages, offs) with interior slices: the
+    # broadcast slot dim leads, giving [slots, kv_heads, head_dim] —
+    # exactly the token layout
+    new_k = cache.k.at[pages, layer, :, offs, :].set(
+        k_tok.astype(cache.k.dtype), mode="drop")
+    new_v = cache.v.at[pages, layer, :, offs, :].set(
+        v_tok.astype(cache.v.dtype), mode="drop")
+    return cache.replace(k=new_k, v=new_v)
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the pool's allocatable pages.
+
+    The scheduler's admission-control arm: a request is admitted only
+    if :meth:`alloc` can hand it every page it may need (prompt +
+    token budget, rounded up to whole pages) — out-of-pages is
+    BACKPRESSURE (the request waits), never a mid-decode failure,
+    because reservations are made in full before prefill.  LIFO reuse
+    keeps recently-touched pages hot.  Double-free and foreign-page
+    frees raise — a leaked page is a capacity leak forever, so the
+    bookkeeping is strict.
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 max_pages_per_slot: int):
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self._free: List[int] = list(range(self.num_pages))
+        self._outstanding: set = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, tokens: int) -> int:
+        """Whole pages covering ``tokens``, clamped to the per-slot
+        table size (a request past the virtual window truncates at
+        capacity — the scheduler records why)."""
+        need = -(-int(tokens) // self.page_size)
+        return max(1, min(need, self.max_pages_per_slot))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` page IDs, or None (backpressure) if the pool can't
+        cover the reservation."""
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._outstanding.update(ids)
+        return ids
+
+    def free(self, ids: Sequence[int]) -> None:
+        for pid in ids:
+            if pid not in self._outstanding:
+                raise ValueError(
+                    f"page {pid} is not outstanding (double free, or a "
+                    f"page this allocator never issued)")
+            self._outstanding.discard(pid)
+            self._free.append(pid)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from apex_tpu.inference import kv_cache
 from apex_tpu.ops import layer_norm, rms_norm
 from apex_tpu.ops.attention import decode_attention, flash_attention
+from apex_tpu.ops.paged_attention import paged_decode_attention
 from apex_tpu.transformer.functional.fused_rope import (
     fused_apply_rotary_pos_emb_cached,
 )
@@ -71,6 +72,20 @@ def _linear(p, x):
     if "bias" in p:
         y = y + p["bias"]
     return y
+
+
+def _cache_attend(cache, layer: int, q, live):
+    """Single-token attention against ONE layer of whichever cache
+    layout the engine runs: the dense slot window
+    (:func:`~apex_tpu.ops.attention.decode_attention`) or the paged
+    pool threaded through the slot page table
+    (:func:`~apex_tpu.ops.paged_attention.paged_decode_attention`).
+    Both score the pre-broadcast per-kv-head cache (GQA/MQA grouped)."""
+    if isinstance(cache, kv_cache.PagedKVCache):
+        return paged_decode_attention(
+            q, cache.k[:, layer], cache.v[:, layer], cache.page_table,
+            live, xla_max_pages=cache.attn_max_pages)
+    return decode_attention(q, cache.k[:, layer], cache.v[:, layer], live)
 
 
 # --------------------------------------------------------------------------
@@ -156,7 +171,7 @@ def _gpt_decode(cfg, params, cache, tokens):
                         lp["input_layernorm"]["bias"])
         q, k_tok, v_tok = _gpt_attn_proj(lp, h1, heads, head_dim)
         cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
-        ctx = decode_attention(q, cache.k[:, i], cache.v[:, i], live)
+        ctx = _cache_attend(cache, i, q, live)
         x = x + _linear(lp["self_attention"]["dense"],
                         ctx.reshape(ctx.shape[0], -1))
         h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
@@ -259,8 +274,8 @@ def _llama_decode(cfg, params, cache, tokens):
         q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
         k_tok = fused_apply_rotary_pos_emb_cached(k_tok, cos, sin)
         cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
-        # grouped-query scoring straight off the per-kv-head cache
-        ctx = decode_attention(q, cache.k[:, i], cache.v[:, i], live)
+        # grouped-query scoring straight off the per-kv-head cache/pool
+        ctx = _cache_attend(cache, i, q, live)
         x = x + _linear(lp["attention"]["o_proj"],
                         ctx.reshape(ctx.shape[0], -1))
         h1 = rms_norm(x, lp["post_attention_norm"]["weight"],

@@ -1,4 +1,5 @@
-"""Static-bucket continuous batching: a host-side slot allocator.
+"""Static-bucket continuous batching: a host-side slot (+page)
+allocator.
 
 The Megatron/vLLM-style serving loop reduced to its TPU-native core: the
 DEVICE programs never change shape — decode is always ``[slots]``-wide,
@@ -6,12 +7,19 @@ prefill pads to one of O(log max_seq) buckets — and the HOST admits and
 retires requests between device steps:
 
     admit:   free slot + queued request -> prefill into the slot
-             (one donated executable; first token sampled in-program)
+             (one donated executable; first token sampled in-program).
+             PAGED engines additionally need the request's page
+             reservation (prompt + token budget, whole pages) from the
+             pool — short of pages the request WAITS (backpressure)
+             until a retire reclaims some, so admission is bounded by
+             free HBM pages, not by worst-case slots.
     step:    one decode executable over every slot (inactive slots
              compute garbage that is masked and never advances)
-    retire:  EOS or the token budget frees the slot; eviction is pure
-             metadata (the next insert overwrites), so retiring moves
-             zero bytes on device
+    retire:  EOS, the token budget, or slot capacity frees the slot
+             (and returns its pages to the pool); eviction is pure
+             metadata, so retiring moves zero bytes on device.  Every
+             finished request records WHY in ``finish_reasons`` —
+             capacity truncation is surfaced, never silent (ISSUE 6).
 
 A wave of requests therefore flows through a FIXED set of compiled
 programs — the continuous-batching property: a finished sequence's slot
@@ -26,7 +34,15 @@ from typing import Optional
 
 import numpy as np
 
+from apex_tpu.inference import kv_cache
+
 __all__ = ["Request", "SlotScheduler", "generate"]
+
+#: finish_reasons codes
+REASON_EOS = "eos"                    # the request's eos_id was sampled
+REASON_LENGTH = "length"              # max_new_tokens budget exhausted
+REASON_TRUNCATED = "truncated"        # slot capacity (max_seq or page
+#                                       reservation) cut the stream
 
 
 @dataclasses.dataclass
@@ -45,6 +61,8 @@ class _SlotState:
     max_new_tokens: int
     eos_id: Optional[int]
     prompt_len: int = 0
+    capacity: int = 0              # cache positions this slot owns
+    pages: Optional[list] = None   # reserved page IDs (paged engines)
 
     def done(self) -> bool:
         if self.eos_id is not None and self.generated \
@@ -61,12 +79,24 @@ class _SlotState:
 
 
 class SlotScheduler:
-    """Maps a request queue onto the engine's fixed slots."""
+    """Maps a request queue onto the engine's fixed slots (and, paged,
+    onto its page pool).
+
+    ``finish_reasons[uid]`` records why each request stopped:
+    ``"eos"``, ``"length"`` (token budget), or ``"truncated"`` (slot
+    capacity — ``max_seq``, or the page reservation when prompt +
+    budget exceeded the virtual window).  ``peak_active`` tracks the
+    maximum concurrently-decoding requests the run reached — the
+    admission-capacity observable the paged cache exists to raise.
+    """
 
     def __init__(self, engine):
         self.engine = engine
         self.queue: collections.deque = collections.deque()
         self._next_uid = 0
+        self.alloc = engine.new_allocator() if engine.paged else None
+        self.finish_reasons: dict = {}
+        self.peak_active = 0
 
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_id: Optional[int] = None) -> int:
@@ -78,19 +108,52 @@ class SlotScheduler:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds engine max_seq "
                 f"{self.engine.max_seq}")
+        if self.alloc is not None:
+            # fail fast: a request no empty pool could ever cover would
+            # otherwise stall the FIFO mid-run after earlier requests
+            # already finished (and their results were built)
+            need = self.alloc.pages_needed(len(prompt)
+                                           + int(max_new_tokens))
+            if need > self.engine.num_pages:
+                raise ValueError(
+                    f"request needs {need} pages of "
+                    f"{self.engine.page_size} (prompt {len(prompt)} + "
+                    f"budget {int(max_new_tokens)} tokens) but the "
+                    f"pool has only {self.engine.num_pages}; grow "
+                    f"num_pages or shrink the request")
         uid = self._next_uid
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, int(max_new_tokens),
                                   eos_id))
         return uid
 
+    # -- admission ----------------------------------------------------------
+    def _reservation(self, req: Request):
+        """(pages or None, capacity) for one request.  Paged: whole
+        pages covering prompt + token budget — the static prefill
+        bucket may be LARGER, but bucket pages past the reservation
+        hold only dead padding rows (masked by the length) and spill
+        into the pool's trash page by construction, so they cost
+        nothing.  ``None`` pages means the pool can't cover the
+        request right now (backpressure).  Dense: capacity is the
+        shared ``max_seq``."""
+        eng = self.engine
+        if not eng.paged:
+            return None, eng.max_seq
+        need = self.alloc.pages_needed(
+            len(req.prompt) + req.max_new_tokens)
+        pages = self.alloc.alloc(need)
+        if pages is None:
+            return None, 0
+        return pages, min(need * eng.page_size, eng.max_seq)
+
     def run(self, cache=None) -> dict:
         """Drain the queue; returns ``{uid: generated token list}``.
 
-        One pass of the loop = admit every free slot it can, then one
-        batched decode step.  The device sees only the fixed-shape
-        prefill/decode executables; everything else here is host-side
-        bookkeeping on ints.
+        One pass of the loop = admit every free slot (and, paged, every
+        page reservation) it can, then one batched decode step.  The
+        device sees only the fixed-shape prefill/decode executables;
+        everything else here is host-side bookkeeping on ints.
         """
         eng = self.engine
         if cache is None:
@@ -100,52 +163,91 @@ class SlotScheduler:
         last = np.zeros((eng.slots,), np.int32)
         results: dict = {}
 
-        def retire(slot):
+        def retire(slot, reason):
+            nonlocal cache
             st = slots[slot]
             # token budget may have been crossed by an EOS cut
             gen = st.generated[:st.max_new_tokens]
             if st.eos_id is not None and st.eos_id in gen:
                 gen = gen[:gen.index(st.eos_id) + 1]
+                reason = REASON_EOS
             results[st.uid] = gen
+            self.finish_reasons[st.uid] = reason
+            if st.pages is not None:
+                # device-side metadata evict BEFORE the pages can be
+                # reassigned: it re-parks the slot's page-table row on
+                # the trash page, so the idle slot's masked decode
+                # appends can never land in another request's pages
+                # (dense slots skip this — their rows are slot-private)
+                cache = kv_cache.evict(cache, slot)
+                self.alloc.free(st.pages)      # pages back to the pool
             slots[slot] = None
             free.append(slot)          # eviction = metadata; insert
             # on re-admit overwrites the stale cache rows
 
         while self.queue or any(s is not None for s in slots):
-            # admit: fill every free slot from the queue
+            # admit: fill free slots from the queue (FIFO — a request
+            # the pool can't cover yet blocks later ones rather than
+            # being starved by them)
             while self.queue and free:
+                pages, capacity = self._reservation(self.queue[0])
+                if eng.paged and pages is None:
+                    break              # out of pages: wait for a retire
                 req = self.queue.popleft()
                 slot = free.pop()
-                cache, tok, _ = eng.prefill(cache, req.prompt, slot)
+                cache, tok, _ = eng.prefill(cache, req.prompt, slot,
+                                            pages=pages)
                 tok = int(np.asarray(tok))
                 slots[slot] = _SlotState(req.uid, [tok],
                                          req.max_new_tokens, req.eos_id,
-                                         prompt_len=len(req.prompt))
+                                         prompt_len=len(req.prompt),
+                                         capacity=capacity, pages=pages)
                 last[slot] = tok
                 if slots[slot].done():
-                    retire(slot)
+                    retire(slot, REASON_LENGTH)
             active = np.array([s is not None for s in slots], bool)
             if not active.any():
+                if self.queue:
+                    # nothing running and the head request still can't
+                    # be admitted: the POOL itself is too small for it
+                    req = self.queue[0]
+                    raise RuntimeError(
+                        f"request {req.uid} needs more pages than the "
+                        f"pool frees up (prompt {len(req.prompt)} + "
+                        f"budget {req.max_new_tokens} tokens vs "
+                        f"{self.alloc.free_pages} free pages of "
+                        f"{self.alloc.page_size}); grow num_pages or "
+                        f"shrink the request")
                 continue
-            # guard: a slot at cache capacity cannot take another token.
+            # guard: a slot at its capacity cannot take another token.
             # Lengths are derived host-side (_SlotState.cache_len) — no
             # device readback in the control loop beyond the sampled
-            # tokens themselves.
+            # tokens themselves.  The decode step's `truncated` output
+            # is the device-side belt to this suspender.
             for slot, st in enumerate(slots):
-                if st is not None and st.cache_len() >= eng.max_seq:
-                    retire(slot)
+                if st is not None and st.cache_len() >= st.capacity:
+                    retire(slot, REASON_TRUNCATED)
                     active[slot] = False
             if not active.any():
                 continue
-            cache, toks, _ = eng.decode(cache, last, active)
+            # counted AFTER the capacity guard: peak_active measures
+            # requests that actually decode concurrently this step
+            self.peak_active = max(self.peak_active, int(active.sum()))
+            cache, toks, _, truncated = eng.decode(cache, last, active)
             toks = np.asarray(toks)
+            truncated = np.asarray(truncated)
             for slot, st in enumerate(slots):
                 if st is None or not active[slot]:
+                    continue
+                if truncated[slot]:
+                    # the host guard above should have retired this
+                    # slot first; trust the device flag regardless
+                    retire(slot, REASON_TRUNCATED)
                     continue
                 st.generated.append(int(toks[slot]))
                 last[slot] = toks[slot]
                 if st.done():
-                    retire(slot)
+                    retire(slot, REASON_LENGTH)
         return results
 
 

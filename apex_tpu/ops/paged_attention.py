@@ -1,0 +1,266 @@
+"""Ragged paged decode attention — single-token attention against a
+paged KV pool (PAPERS.md "Ragged Paged Attention", TPU-native).
+
+The paged twin of :func:`apex_tpu.ops.attention.decode_attention`: one
+query per sequence slot scores the slot's live tokens, but the tokens
+live in fixed-size PAGES of a shared pool rather than a contiguous
+per-slot ``max_seq`` window —
+
+    k_pages, v_pages : [pages, kv_heads, page_size, head_dim]
+    page_table       : [slots, max_pages_per_slot]  int32
+    lengths          : [slots]                      int32
+
+virtual position ``t`` of a slot resolves to physical page
+``page_table[slot, t // page_size]``, row ``t % page_size``.
+
+Two implementations behind one crossover knob, mirroring the dense
+kernel/XLA machinery in ``attention.py``:
+
+* **Pallas kernel** (long virtual windows): grid ``(slots, pages)``
+  with the page table and lengths as SCALAR-PREFETCH operands — the
+  k/v BlockSpec index map reads ``page_table[slot, page]`` so Pallas
+  DMAs exactly that slot's live pages from HBM, page by page, with its
+  standard double buffering; nothing resembling the gathered
+  ``[slots, max_seq]`` window ever materializes.  Online softmax (fp32
+  running max/normalizer/accumulator in VMEM scratch, base-2 log
+  domain like the flash kernels) carries across the page loop; dead
+  pages are skipped (``pl.when``) and their DMA is deduplicated by
+  clamping the index map to the slot's last live page (Pallas skips
+  refetching an unchanged block index).  Dead rows inside the last
+  live page mask to ``_NEG_INF``.
+
+* **XLA gather fallback** (short windows): gather the slot's pages
+  into the dense ``[slots, kv_heads, max_seq, d]`` window and reuse
+  ``decode_attention``'s grouped-query einsum chain — at small
+  ``max_pages_per_slot`` the gather transient is cheap and XLA's fused
+  matvec wins for the same reason the dense crossover exists.  The
+  gathered window equals the dense cache's view position for position,
+  so this path is numerically IDENTICAL to the dense XLA decode path.
+
+GQA/MQA: ``kv_heads`` divides the query heads; the kernel loops kv
+heads (static, small) scoring each head's ``group`` query rows against
+the once-per-kv-head page — no broadcast materialized anywhere.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.attention import (_LOG2E, _NEG_INF, decode_attention)
+from apex_tpu.utils import interpret_mode
+
+__all__ = ["paged_decode_attention", "paged_xla_max_pages"]
+
+#: paged kernel/XLA crossover, in PAGES per slot (the paged analog of
+#: ``_DECODE_XLA_MAX_SEQ``; ~4096 tokens at the default page size 64).
+#: Below it the XLA gather fallback materializes the slot windows —
+#: fine while they are small; above it the Pallas kernel streams pages
+#: straight from the pool.  PROVISIONAL like the dense decode crossover
+#: was at introduction: override per-run with the environment variable
+#: ``APEX_TPU_PAGED_XLA_MAX_PAGES`` or per-call with ``xla_max_pages=``
+#: (0 forces the kernel path); bench infer captures stamp the
+#: effective value so on-chip sweeps can refine it without a code edit.
+_PAGED_XLA_MAX_PAGES = 64
+
+_PAGED_XLA_MAX_PAGES_ENV = "APEX_TPU_PAGED_XLA_MAX_PAGES"
+
+
+def paged_xla_max_pages(override=None) -> int:
+    """Effective paged-decode crossover: explicit kwarg override >
+    ``APEX_TPU_PAGED_XLA_MAX_PAGES`` env var > the provisional
+    default."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get(_PAGED_XLA_MAX_PAGES_ENV)
+    if env:
+        try:
+            return int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_PAGED_XLA_MAX_PAGES_ENV} must be an int, got "
+                f"{env!r}") from e
+    return _PAGED_XLA_MAX_PAGES
+
+
+# --------------------------------------------------------------------------
+# Pallas kernel: grid (slots, pages), page table as scalar prefetch
+# --------------------------------------------------------------------------
+
+def _paged_kernel(scale, kvh, group, ps, mpps,
+                  pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  s_scr, m_scr, l_scr, acc_scr):
+    sid = pl.program_id(0)
+    p = pl.program_id(1)
+    h = kvh * group
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[sid]
+    live_pages = (length + ps - 1) // ps
+
+    @pl.when(p < live_pages)
+    def _body():
+        q = q_ref[0]                                     # [h, d]
+        # per-kv-head scoring: each kv head's page block serves its
+        # `group` query rows (GQA) — kvh is static and small, and the
+        # disjoint row segments land in one [h, ps] score scratch
+        for i in range(kvh):
+            seg = slice(i * group, (i + 1) * group)
+            s_scr[seg, :] = jax.lax.dot_general(
+                q[seg], k_ref[0, i], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * (scale * _LOG2E)
+        cols = p * ps + jax.lax.broadcasted_iota(jnp.int32, (h, ps), 1)
+        s = jnp.where(cols < length, s_scr[...], _NEG_INF)
+        # online softmax, base-2 log domain (scale absorbed log2e):
+        # within a live page every row has >= 1 live column, so no
+        # fully-masked-row guard is needed here (length-0 slots never
+        # enter the body and finish at l == 0 -> zeros)
+        m_prev = m_scr[...]                              # [h, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        pmat = jnp.exp2(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + \
+            jnp.sum(pmat, axis=1, keepdims=True)
+        for i in range(kvh):
+            seg = slice(i * group, (i + 1) * group)
+            acc_scr[seg, :] = acc_scr[seg, :] * alpha[seg] + jax.lax.dot(
+                pmat[seg, :].astype(v_ref.dtype), v_ref[0, i],
+                preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(p == mpps - 1)
+    def _finish():
+        l = l_scr[...]
+        o_ref[0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)
+                    ).astype(o_ref.dtype)
+
+
+def _paged_kernel_call(q, k_pages, v_pages, page_table, lengths, scale):
+    slots, h, d = q.shape
+    _, kvh, ps, _ = k_pages.shape
+    mpps = page_table.shape[1]
+    group = h // kvh
+
+    def page_index(s, p, pt, ln):
+        # clamp dead trailing pages to the slot's last live page: an
+        # unchanged block index lets Pallas skip the (useless) refetch,
+        # and pl.when skips its compute entirely
+        last = jnp.maximum((ln[s] + ps - 1) // ps - 1, 0)
+        return (pt[s, jnp.minimum(p, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, mpps),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((1, kvh, ps, d), page_index),
+            pl.BlockSpec((1, kvh, ps, d), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, p, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, ps), jnp.float32),     # score block
+            pltpu.VMEM((h, 1), jnp.float32),      # running max (base 2)
+            pltpu.VMEM((h, 1), jnp.float32),      # running normalizer
+            pltpu.VMEM((h, d), jnp.float32),      # fp32 output accum
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale, kvh, group, ps, mpps)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, h, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(page_table, lengths, q, k_pages, v_pages)
+
+
+# --------------------------------------------------------------------------
+# public entry
+# --------------------------------------------------------------------------
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           sm_scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None,
+                           xla_max_pages: Optional[int] = None):
+    """Single-token attention against a paged KV pool.
+
+    * ``q``: ``[slots, h, 1, d]`` (or ``[slots, h, d]``) — the current
+      token's query heads per slot.
+    * ``k_pages``/``v_pages``: ``[pages, kv_heads, page_size, d]`` —
+      ONE layer's slice of the pool, ``kv_heads`` dividing ``h``.
+    * ``page_table``: ``[slots, max_pages_per_slot]`` int32 — physical
+      page backing each ``page_size`` stretch of the slot's virtual
+      window; dead entries may hold any valid page index (they are
+      masked by ``lengths``, and the pool's trash page is the
+      conventional filler).
+    * ``lengths``: ``[slots]`` int32 — live tokens per slot; a slot
+      with length 0 emits zeros.
+
+    ``use_kernel=None`` auto-dispatches on ``max_pages_per_slot``: at
+    or under the crossover (``xla_max_pages`` kwarg >
+    ``APEX_TPU_PAGED_XLA_MAX_PAGES`` env var > the provisional default
+    ``_PAGED_XLA_MAX_PAGES``) the pages are gathered into dense slot
+    windows and scored by ``decode_attention``'s XLA einsum chain
+    (numerically identical to the dense cache's decode); above it the
+    Pallas kernel streams the live pages via the page table with no
+    materialized gather.
+    """
+    squeezed = q.ndim == 3
+    if squeezed:
+        q = q[:, :, None, :]
+    slots, h, q_len, d = q.shape
+    if q_len != 1:
+        raise ValueError(
+            f"paged_decode_attention is the q_len == 1 path, got q_len "
+            f"{q_len}; use flash_attention for prefill")
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4 \
+            or k_pages.shape[3] != d:
+        raise ValueError(
+            f"k/v pages must be [pages, kv_heads, page_size, {d}] and "
+            f"equal-shaped; got k {tuple(k_pages.shape)} v "
+            f"{tuple(v_pages.shape)}")
+    kvh = k_pages.shape[1]
+    if kvh == 0 or h % kvh:
+        raise ValueError(
+            f"kv_heads ({kvh}) must divide query heads ({h})")
+    if page_table.ndim != 2 or page_table.shape[0] != slots:
+        raise ValueError(
+            f"page_table must be [{slots}, max_pages_per_slot], got "
+            f"{tuple(page_table.shape)}")
+    if lengths.shape != (slots,):
+        raise ValueError(
+            f"lengths must be [{slots}], got {tuple(lengths.shape)}")
+    mpps = page_table.shape[1]
+    ps = k_pages.shape[2]
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    page_table = page_table.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    if use_kernel is None:
+        use_kernel = mpps > paged_xla_max_pages(xla_max_pages)
+
+    if not use_kernel:
+        # gather the virtual windows and reuse the dense XLA chain —
+        # [slots, mpps, kvh, ps, d] -> [slots, kvh, mpps*ps, d]
+        def window(pages):
+            g = jnp.take(pages, page_table, axis=0)
+            return jnp.moveaxis(g, 2, 1).reshape(slots, kvh, mpps * ps, d)
+
+        out = decode_attention(q, window(k_pages), window(v_pages),
+                               lengths, sm_scale=scale, use_kernel=False)
+        return out[:, :, 0] if squeezed else out
+
+    out = _paged_kernel_call(q[:, :, 0, :], k_pages, v_pages, page_table,
+                             lengths, scale)
+    return out if squeezed else out[:, :, None, :]

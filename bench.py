@@ -693,9 +693,10 @@ def _microbench_llama(rtt: float, on_tpu: bool):
 
 
 def _microbench_infer(rtt: float, on_tpu: bool):
-    """Inference engine leg (ISSUE 4): prefill throughput + per-token
+    """Inference engine leg (ISSUE 4/6): prefill throughput + per-token
     decode latency of the prefill/decode engine over the flagship GPT
-    shape.
+    shape, in the dense slot-cache OR the paged-pool memory model
+    (``--override paged=1 [page_size=N pages=N]``).
 
     Both phases time the REAL engine step functions (the same donated
     executables ``InferenceEngine`` jits) iterated inside one scan:
@@ -703,13 +704,20 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     carries (cache, tokens, step) so every iteration extends the
     sequences exactly as serving does.  ``infer_decode_token_us`` is the
     step latency — the time to hand every active slot its next token —
-    and ``infer_decode_tokens_per_s`` counts all ``slots`` streams."""
+    and ``infer_decode_tokens_per_s`` counts all ``slots`` streams.
+    ``infer_hbm_bytes_per_concurrent_request`` is the serving-capacity
+    metric the paged cache exists to shrink: KV HBM divided by the
+    requests it can hold concurrently at THIS leg's request shape
+    (dense: ``slots`` regardless of length; paged: the pool divided by
+    the request's page reservation)."""
     import numpy as np
 
     from apex_tpu.inference import InferenceEngine
     from apex_tpu.inference.engine import make_decode_fn, make_prefill_fn
+    from apex_tpu.inference.kv_cache import default_page_size, page_row
     from apex_tpu.inference.sampling import SamplingConfig
     from apex_tpu.ops.attention import decode_xla_max_seq
+    from apex_tpu.ops.paged_attention import paged_xla_max_pages
     from apex_tpu.transformer import parallel_state
     from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
 
@@ -727,6 +735,8 @@ def _microbench_infer(rtt: float, on_tpu: bool):
         slots, iters = 2, 2
     max_seq = cfg.max_seq_length
     prefill_len = max_seq // 2          # leaves decode headroom
+    paged = bool(_ov("paged", 0))
+    page_size = _ov("page_size", default_page_size()) if paged else None
 
     parallel_state.destroy_model_parallel()
     parallel_state.initialize_model_parallel(1)
@@ -734,37 +744,71 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     params = model.init(jax.random.PRNGKey(1),
                         jax.random.randint(jax.random.PRNGKey(0), (1, 8),
                                            0, cfg.vocab_size))
-    engine = InferenceEngine("gpt", cfg, params, slots=slots,
-                             max_seq=max_seq)
+    pages_per_req = None
+    if paged:
+        # pool sized to THIS leg's load (every slot mid-sequence), not
+        # to slots * max_seq — the memory model under test
+        pages_per_req = -(-(prefill_len + iters) // page_size)
+        num_pages = _ov("pages", slots * pages_per_req)
+        if num_pages < slots * pages_per_req:
+            raise ValueError(
+                f"--override pages={num_pages} cannot hold this leg's "
+                f"warm state: {slots} slots x {pages_per_req} pages "
+                f"per request needs >= {slots * pages_per_req}")
+        engine = InferenceEngine("gpt", cfg, params, slots=slots,
+                                 max_seq=max_seq, page_size=page_size,
+                                 num_pages=num_pages)
+    else:
+        engine = InferenceEngine("gpt", cfg, params, slots=slots,
+                                 max_seq=max_seq)
     sampling = SamplingConfig()                      # greedy
-    prefill_fn = make_prefill_fn("gpt", cfg, sampling)
+    prefill_fn = make_prefill_fn("gpt", cfg, sampling, paged=paged)
     decode_fn = make_decode_fn("gpt", cfg, sampling)
     prompt = jax.random.randint(jax.random.PRNGKey(2), (prefill_len,),
                                 0, cfg.vocab_size, dtype=jnp.int32)
     key = jax.random.PRNGKey(3)
+    alloc = engine.new_allocator() if paged else None
 
     # prefill: re-admit the prompt into slot 0 every iteration (cache
     # carried, so the insert is a live donated update, not DCE'd)
-    def prefill_step(cache, batch):
-        tokens, key_ = batch
-        cache, _, _ = prefill_fn(cache, engine.params, tokens,
-                                 jnp.int32(0), jnp.int32(prefill_len),
-                                 key_, jnp.int32(0))
-        return cache
+    if paged:
+        row0_ids = alloc.alloc(pages_per_req)
+        row0 = jnp.asarray(page_row(row0_ids, engine.max_pages_per_slot,
+                                    engine.num_pages))
+
+        def prefill_step(cache, batch):
+            tokens, key_ = batch
+            cache, _, _ = prefill_fn(cache, engine.params, tokens,
+                                     jnp.int32(0),
+                                     jnp.int32(prefill_len), row0,
+                                     key_, jnp.int32(0))
+            return cache
+    else:
+        def prefill_step(cache, batch):
+            tokens, key_ = batch
+            cache, _, _ = prefill_fn(cache, engine.params, tokens,
+                                     jnp.int32(0),
+                                     jnp.int32(prefill_len),
+                                     key_, jnp.int32(0))
+            return cache
 
     t_pre = _bench_loop(prefill_step, engine.init_cache(), (prompt, key),
                         iters, rtt)
 
     # decode: warm cache (every slot mid-sequence), then scan steps
+    if paged:
+        alloc.free(row0_ids)     # the prefill-timing slot's reservation
     cache = engine.init_cache()
     for slot in range(slots):
-        cache, _, _ = engine.prefill(cache, np.asarray(prompt), slot)
+        pages = alloc.alloc(pages_per_req) if paged else None
+        cache, _, _ = engine.prefill(cache, np.asarray(prompt), slot,
+                                     pages=pages)
 
     def decode_step(state, batch):
         cache, toks, step = state
         active, key_ = batch
-        cache, toks, _ = decode_fn(cache, engine.params, toks, active,
-                                   key_, step)
+        cache, toks, _, _ = decode_fn(cache, engine.params, toks, active,
+                                      key_, step)
         return (cache, toks, step + 1)
 
     state = (cache, jnp.zeros((slots,), jnp.int32), jnp.int32(0))
@@ -773,17 +817,33 @@ def _microbench_infer(rtt: float, on_tpu: bool):
                         (jnp.ones((slots,), bool), key),
                         decode_iters, rtt)
 
-    return {"infer_prefill_tokens_per_s": round(prefill_len / t_pre.best,
-                                                1),
-            "infer_prefill_us": round(t_pre.best * 1e6, 1),
-            "infer_prefill_us_median": round(t_pre.median * 1e6, 1),
-            "infer_decode_token_us": round(t_dec.best * 1e6, 1),
-            "infer_decode_token_us_median": round(t_dec.median * 1e6, 1),
-            "infer_decode_tokens_per_s": round(slots / t_dec.best, 1),
-            "infer_shape": [slots, prefill_len, cfg.num_layers,
-                            cfg.hidden_size],
-            # crossover knob stamp (same contract as attn_xla_max_seq)
-            "infer_decode_xla_max_seq": decode_xla_max_seq()}
+    cache_bytes = engine.cache_hbm_bytes()
+    if paged:
+        # decode is [slots]-wide: an over-provisioned pool can't serve
+        # more concurrent requests than the engine has slots
+        concurrent = min(slots, engine.num_pages // pages_per_req)
+    else:
+        concurrent = slots
+    out = {"infer_prefill_tokens_per_s": round(prefill_len / t_pre.best,
+                                               1),
+           "infer_prefill_us": round(t_pre.best * 1e6, 1),
+           "infer_prefill_us_median": round(t_pre.median * 1e6, 1),
+           "infer_decode_token_us": round(t_dec.best * 1e6, 1),
+           "infer_decode_token_us_median": round(t_dec.median * 1e6, 1),
+           "infer_decode_tokens_per_s": round(slots / t_dec.best, 1),
+           "infer_shape": [slots, prefill_len, cfg.num_layers,
+                           cfg.hidden_size],
+           "infer_hbm_cache_bytes": cache_bytes,
+           "infer_hbm_bytes_per_concurrent_request":
+               round(cache_bytes / max(concurrent, 1)),
+           "infer_paged": int(paged),
+           # crossover knob stamp (same contract as attn_xla_max_seq)
+           "infer_decode_xla_max_seq": decode_xla_max_seq()}
+    if paged:
+        out["infer_page_size"] = page_size
+        out["infer_pages"] = engine.num_pages
+        out["infer_paged_xla_max_pages"] = paged_xla_max_pages()
+    return out
 
 
 MICRO_LEGS = {

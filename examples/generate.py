@@ -53,6 +53,18 @@ def parse_args(argv=None):
                    help="llama only: < heads for GQA, 1 for MQA")
     p.add_argument("--max-seq", type=int, default=128)
     p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--page-size", type=int, default=None,
+                   help="serve from a paged KV pool with this page "
+                        "size (tokens, power of two) instead of the "
+                        "dense slot cache")
+    p.add_argument("--num-pages", type=int, default=None,
+                   help="paged pool size (default: dense-equivalent "
+                        "slots * max_seq / page_size)")
+    p.add_argument("--straggler-demo", action="store_true",
+                   help="serve a straggler-shaped workload through the "
+                        "slot cache and a paged pool of the SAME KV "
+                        "HBM and report how many requests each admits "
+                        "concurrently")
     p.add_argument("--prompts", type=int, default=6)
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--temperature", type=float, default=0.0,
@@ -103,6 +115,54 @@ def quick_train(model, params, args):
     return state
 
 
+def straggler_demo(args, cfg, params, sampling):
+    """Admission capacity at EQUAL KV HBM, slot cache vs paged pool.
+
+    The workload one 128K-context user inflicts on a serving fleet,
+    shrunk to demo scale: the dense cache must provision every slot for
+    ``max_seq``, so a fixed HBM budget buys only ``budget_slots``
+    concurrent requests no matter how short they are.  The paged engine
+    spends the SAME bytes on a page pool and admits by free pages — the
+    short requests each pin only their own few pages, so many more run
+    concurrently (``SlotScheduler.peak_active`` is the observable)."""
+    from apex_tpu.inference import SlotScheduler
+
+    budget_slots = 2                  # dense slots the HBM budget buys
+    page_size = args.page_size or 16
+    rng = np.random.RandomState(args.seed + 2)
+    n_req = args.prompts
+    short = max(4, args.max_seq // 8)   # mean_seq << max_seq
+    prompts = [list(rng.randint(0, args.vocab, size=rng.randint(2, short)))
+               for _ in range(n_req)]
+    new_toks = 4
+
+    def run(engine):
+        sched = SlotScheduler(engine)
+        for p in prompts:
+            sched.submit(p, max_new_tokens=new_toks)
+        sched.run()
+        return sched.peak_active, engine.cache_hbm_bytes()
+
+    dense = InferenceEngine(args.model, cfg, params, slots=budget_slots,
+                            max_seq=args.max_seq, dtype=jnp.bfloat16,
+                            sampling=sampling, seed=args.seed)
+    # same HBM: the pool gets exactly the dense cache's pages
+    num_pages = budget_slots * args.max_seq // page_size - 1  # -1: trash
+    paged = InferenceEngine(args.model, cfg, params, slots=n_req,
+                            max_seq=args.max_seq, page_size=page_size,
+                            num_pages=num_pages, dtype=jnp.bfloat16,
+                            sampling=sampling, seed=args.seed)
+    d_peak, d_bytes = run(dense)
+    p_peak, p_bytes = run(paged)
+    print(f"straggler demo ({n_req} short requests <= {short} tokens, "
+          f"max_seq {args.max_seq}):")
+    print(f"  slot cache: {d_bytes} B KV HBM -> {d_peak} concurrent "
+          f"(capped by {budget_slots} max_seq-deep slots)")
+    print(f"  paged pool: {p_bytes} B KV HBM -> {p_peak} concurrent "
+          f"(admitted by free {page_size}-token pages)")
+    assert p_peak > d_peak, "paged admission should beat the slot cache"
+
+
 def main(argv=None):
     args = parse_args(argv)
     parallel_state.destroy_model_parallel()
@@ -113,16 +173,24 @@ def main(argv=None):
 
     sampling = SamplingConfig(temperature=args.temperature,
                               top_k=args.top_k)
+    if args.straggler_demo:
+        straggler_demo(args, cfg, params, sampling)
+        return
+    paged_kw = {}
+    if args.page_size is not None or args.num_pages is not None:
+        paged_kw = dict(page_size=args.page_size,
+                        num_pages=args.num_pages)
     if args.train_steps:
         state = quick_train(model, params, args)
         engine = InferenceEngine.from_train_state(
             args.model, cfg, state, slots=args.slots,
-            max_seq=args.max_seq, sampling=sampling, seed=args.seed)
+            max_seq=args.max_seq, sampling=sampling, seed=args.seed,
+            **paged_kw)
     else:
         engine = InferenceEngine(args.model, cfg, params,
                                  slots=args.slots, max_seq=args.max_seq,
                                  dtype=jnp.bfloat16, sampling=sampling,
-                                 seed=args.seed)
+                                 seed=args.seed, **paged_kw)
 
     rng = np.random.RandomState(args.seed + 1)
     prompts = []

@@ -54,7 +54,7 @@ def _engine_greedy(engine, prompt, n_new, slot=0):
     last[slot], active[slot] = got[-1], True
     logits = None
     for _ in range(n_new - 1):
-        cache, toks, logits = engine.decode(cache, last, active)
+        cache, toks, logits, _ = engine.decode(cache, last, active)
         got.append(int(np.asarray(toks)[slot]))
         last[slot] = got[-1]
     return got, first_logits, (None if logits is None

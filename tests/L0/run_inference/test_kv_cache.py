@@ -75,8 +75,9 @@ def test_append_writes_at_each_slots_own_length():
         np.testing.assert_array_equal(np.asarray(c.v[slot, :, :, pos]),
                                       want_v)
     # lengths only move via advance, and only for active slots
-    c = kv_cache.advance(c, jnp.asarray([True, False, True]))
+    c, trunc = kv_cache.advance(c, jnp.asarray([True, False, True]))
     assert np.asarray(c.lengths).tolist() == [4, 0, 7]
+    assert not np.asarray(trunc).any()
 
 
 def test_append_validates():
@@ -105,7 +106,7 @@ def test_updates_are_donation_safe():
         c = kv_cache.insert(c, 0, k_slab, k_slab, 4)
         for layer in range(LAYERS):
             c = kv_cache.append_layer(c, layer, k_tok, k_tok)
-        return kv_cache.advance(c, jnp.ones((SLOTS,), bool))
+        return kv_cache.advance(c, jnp.ones((SLOTS,), bool))[0]
 
     c = _cache()
     kbuf = c.k
@@ -124,7 +125,8 @@ def test_cache_is_scan_carryable():
     def body(c, tok):
         for layer in range(LAYERS):
             c = kv_cache.append_layer(c, layer, tok, tok)
-        return kv_cache.advance(c, jnp.ones((SLOTS,), bool)), c.lengths
+        return (kv_cache.advance(c, jnp.ones((SLOTS,), bool))[0],
+                c.lengths)
 
     toks = _rand((4, SLOTS, KVH, D), 7)
     c, hist = jax.lax.scan(body, _cache(), toks)

@@ -1,0 +1,139 @@
+"""Ragged paged decode attention vs the dense decode path: the XLA
+gather fallback must be numerically identical to the dense cache's
+decode, the Pallas kernel must match within fp tolerance on ragged
+batches (straggler + shorts) across MHA/GQA/MQA, and the crossover
+knob must dispatch like the dense machinery it mirrors."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.attention import decode_attention
+from apex_tpu.ops.paged_attention import (
+    _PAGED_XLA_MAX_PAGES,
+    paged_decode_attention,
+    paged_xla_max_pages,
+)
+
+
+def _paged_twin(slots, h, kvh, ps, mpps, lengths, d=16, seed=0):
+    """(q, dense k/v, paged pool k/v + scrambled page table, lengths):
+    the SAME cache contents laid out both ways, with dead pool pages
+    holding garbage so masking bugs can't hide."""
+    rng = np.random.RandomState(seed)
+    max_seq = ps * mpps
+    n_pages = slots * mpps
+    q = rng.randn(slots, h, d).astype(np.float32)
+    k = rng.randn(slots, kvh, max_seq, d).astype(np.float32)
+    v = rng.randn(slots, kvh, max_seq, d).astype(np.float32)
+    pool_k = rng.randn(n_pages + 1, kvh, ps, d).astype(np.float32)
+    pool_v = rng.randn(n_pages + 1, kvh, ps, d).astype(np.float32)
+    perm = rng.permutation(n_pages)       # non-contiguous assignment
+    pt = np.empty((slots, mpps), np.int32)
+    i = 0
+    for s in range(slots):
+        for j in range(mpps):
+            pid = perm[i]
+            i += 1
+            pt[s, j] = pid
+            pool_k[pid] = k[s, :, j * ps:(j + 1) * ps, :]
+            pool_v[pid] = v[s, :, j * ps:(j + 1) * ps, :]
+    return (jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(pt),
+            jnp.asarray(lengths, jnp.int32))
+
+
+RAGGED = [32, 0, 1, 7, 8, 9]              # straggler + shorts around ps
+
+
+@pytest.mark.parametrize("h,kvh", [(4, 4), (8, 2), (4, 1)])  # MHA/GQA/MQA
+def test_xla_path_is_bitwise_the_dense_decode(h, kvh):
+    q, k, v, pk, pv, pt, ln = _paged_twin(6, h, kvh, 8, 4, RAGGED)
+    dense = decode_attention(q, k, v, ln, use_kernel=False)
+    paged = paged_decode_attention(q, pk, pv, pt, ln, use_kernel=False)
+    # the gathered window IS the dense window: identical ops, identical
+    # bits — the paged memory model changes storage, not math
+    np.testing.assert_array_equal(np.asarray(paged), np.asarray(dense))
+
+
+@pytest.mark.parametrize("h,kvh", [(4, 4), (8, 2), (4, 1)])
+def test_kernel_matches_dense_on_ragged_batch(h, kvh):
+    q, k, v, pk, pv, pt, ln = _paged_twin(6, h, kvh, 8, 4, RAGGED)
+    dense = decode_attention(q, k, v, ln, use_kernel=False)
+    kern = paged_decode_attention(q, pk, pv, pt, ln, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_bf16_matches_dense_bf16():
+    q, k, v, pk, pv, pt, ln = _paged_twin(4, 8, 2, 8, 3, [24, 5, 0, 13])
+    bf = jnp.bfloat16
+    dense = decode_attention(q.astype(bf), k.astype(bf), v.astype(bf),
+                             ln, use_kernel=False)
+    kern = paged_decode_attention(q.astype(bf), pk.astype(bf),
+                                  pv.astype(bf), pt, ln, use_kernel=True)
+    np.testing.assert_allclose(
+        np.asarray(kern, np.float32), np.asarray(dense, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_zero_length_slots_emit_zeros_finite():
+    q, k, v, pk, pv, pt, ln = _paged_twin(3, 4, 2, 4, 3, [0, 5, 0])
+    for use_kernel in (False, True):
+        out = np.asarray(paged_decode_attention(q, pk, pv, pt, ln,
+                                                use_kernel=use_kernel))
+        assert np.all(out[0] == 0) and np.all(out[2] == 0)
+        assert np.all(np.isfinite(out))
+
+
+def test_four_dim_q_round_trips():
+    q, k, v, pk, pv, pt, ln = _paged_twin(3, 4, 2, 4, 3, [5, 3, 1])
+    out3 = paged_decode_attention(q, pk, pv, pt, ln)
+    out4 = paged_decode_attention(q[:, :, None, :], pk, pv, pt, ln)
+    assert out4.shape == (3, 4, 1, 16)
+    np.testing.assert_array_equal(np.asarray(out4[:, :, 0]),
+                                  np.asarray(out3))
+
+
+def test_crossover_knob(monkeypatch):
+    assert paged_xla_max_pages() == _PAGED_XLA_MAX_PAGES
+    assert paged_xla_max_pages(8) == 8                 # kwarg wins
+    monkeypatch.setenv("APEX_TPU_PAGED_XLA_MAX_PAGES", "3")
+    assert paged_xla_max_pages() == 3
+    assert paged_xla_max_pages(7) == 7
+    monkeypatch.setenv("APEX_TPU_PAGED_XLA_MAX_PAGES", "bogus")
+    with pytest.raises(ValueError, match="must be an int"):
+        paged_xla_max_pages()
+
+
+def test_auto_dispatch_selects_kernel_above_crossover(monkeypatch):
+    """The traced program contains a pallas_call exactly when the page
+    count exceeds the effective crossover — the knob really steers."""
+    q, k, v, pk, pv, pt, ln = _paged_twin(3, 4, 2, 4, 3, [5, 3, 1])
+
+    def has_pallas(xla_max_pages):
+        jaxpr = jax.make_jaxpr(
+            lambda *a: paged_decode_attention(
+                *a, xla_max_pages=xla_max_pages))(q, pk, pv, pt, ln)
+        return "pallas_call" in str(jaxpr)
+
+    assert not has_pallas(3)          # mpps == 3 <= 3: XLA gather path
+    assert has_pallas(2)              # mpps > 2: kernel path
+    assert has_pallas(0)              # 0 forces the kernel
+    monkeypatch.setenv("APEX_TPU_PAGED_XLA_MAX_PAGES", "0")
+    assert has_pallas(None)           # env steers the auto dispatch
+
+
+def test_validates_shapes():
+    q, k, v, pk, pv, pt, ln = _paged_twin(3, 4, 2, 4, 3, [5, 3, 1])
+    with pytest.raises(ValueError, match="q_len == 1"):
+        paged_decode_attention(jnp.zeros((3, 4, 2, 16)), pk, pv, pt, ln)
+    with pytest.raises(ValueError, match="equal-shaped"):
+        paged_decode_attention(q, pk, pv[:, :, :2], pt, ln)
+    with pytest.raises(ValueError, match="must divide"):
+        bad = jnp.zeros((5, 3, 4, 16))      # 3 kv heads !| 4 q heads
+        paged_decode_attention(q, bad, bad, pt, ln)
+    with pytest.raises(ValueError, match="page_table"):
+        paged_decode_attention(q, pk, pv, pt[:2], ln)
+    with pytest.raises(ValueError, match="lengths"):
+        paged_decode_attention(q, pk, pv, pt, ln[:2])

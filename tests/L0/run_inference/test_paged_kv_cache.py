@@ -1,0 +1,287 @@
+"""Paged KV pool semantics (ISSUE 6): page-table-threaded donated
+mutations, trash-page overflow containment, truncation surfacing, and
+the host-side page allocator's no-leak bookkeeping."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.inference import kv_cache
+
+LAYERS, KVH, PS, D, SLOTS, MPPS, PAGES = 2, 2, 4, 8, 3, 4, 6
+
+
+def _cache(dtype=jnp.float32, **kw):
+    return kv_cache.init_paged_cache(PAGES, LAYERS, KVH, PS, D,
+                                     slots=SLOTS, max_pages_per_slot=MPPS,
+                                     dtype=dtype, **kw)
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape),
+                       jnp.float32)
+
+
+def _row(ids):
+    return kv_cache.page_row(ids, MPPS, PAGES)
+
+
+def test_init_geometry_and_trash_page():
+    c = _cache(jnp.bfloat16)
+    # pool carries PAGES allocatable pages + 1 trash page
+    assert c.k.shape == (PAGES + 1, LAYERS, KVH, PS, D)
+    assert c.k.dtype == jnp.bfloat16 and c.v.dtype == jnp.bfloat16
+    assert (c.pages, c.null_page, c.alloc_pages) == (PAGES + 1, PAGES,
+                                                     PAGES)
+    assert (c.slots, c.max_pages_per_slot, c.page_size) == (SLOTS, MPPS,
+                                                            PS)
+    assert c.max_seq == MPPS * PS
+    # empty: every table entry parks on the trash page, nothing owned
+    assert np.all(np.asarray(c.page_table) == PAGES)
+    assert np.all(np.asarray(c.lengths) == 0)
+    assert np.all(np.asarray(c.capacity) == 0)
+
+
+def test_insert_pages_places_slabs_and_derives_capacity():
+    c = _cache()
+    k = _rand((LAYERS, KVH, 2 * PS, D), 1)
+    v = _rand((LAYERS, KVH, 2 * PS, D), 2)
+    ids = [4, 1]                      # deliberately non-contiguous
+    c = kv_cache.insert_pages(c, 1, k, v, 5, _row(ids))
+    # slab pages landed at the assigned physical pages, in order
+    np.testing.assert_array_equal(np.asarray(c.k[4]),
+                                  np.asarray(k[:, :, :PS]))
+    np.testing.assert_array_equal(np.asarray(c.k[1]),
+                                  np.asarray(k[:, :, PS:]))
+    np.testing.assert_array_equal(np.asarray(c.v[4]),
+                                  np.asarray(v[:, :, :PS]))
+    # table row = assigned pages padded with the trash page
+    assert np.asarray(c.page_table[1]).tolist() == [4, 1, PAGES, PAGES]
+    # capacity derived in-program from the owned-page count
+    assert np.asarray(c.lengths).tolist() == [0, 5, 0]
+    assert np.asarray(c.capacity).tolist() == [0, 2 * PS, 0]
+    # other slots' rows untouched
+    assert np.all(np.asarray(c.page_table[0]) == PAGES)
+
+
+def test_bucket_overhang_spills_into_trash_page():
+    """A prefill bucket larger than the reservation writes its dead
+    padding pages into the trash page, not into anyone's data."""
+    c = _cache()
+    victim = _rand((LAYERS, KVH, PS, D), 3)
+    c = kv_cache.insert_pages(c, 0, victim, victim, PS, _row([2]))
+    # slot 1 inserts a 3-page slab but owns only 1 page: pages 1-2 of
+    # the slab overhang into the trash page
+    k = _rand((LAYERS, KVH, 3 * PS, D), 4)
+    c = kv_cache.insert_pages(c, 1, k, k, 3, _row([5]))
+    np.testing.assert_array_equal(np.asarray(c.k[2]), np.asarray(victim))
+    np.testing.assert_array_equal(np.asarray(c.k[5]),
+                                  np.asarray(k[:, :, :PS]))
+    assert np.asarray(c.capacity).tolist() == [PS, PS, 0]
+
+
+def test_append_crosses_page_boundary():
+    c = _cache()
+    k = _rand((LAYERS, KVH, PS, D), 1)
+    c = kv_cache.insert_pages(c, 0, k, k, PS - 1, _row([0, 3]))
+    tok1 = _rand((SLOTS, KVH, D), 5)
+    tok2 = _rand((SLOTS, KVH, D), 6)
+    for layer in range(LAYERS):
+        c = kv_cache.append_layer(c, layer, tok1, tok1)
+    c, _ = kv_cache.advance(c, jnp.asarray([True, False, False]))
+    for layer in range(LAYERS):
+        c = kv_cache.append_layer(c, layer, tok2, tok2)
+    c, _ = kv_cache.advance(c, jnp.asarray([True, False, False]))
+    # token 1 filled the last row of page 0; token 2 opened page 3
+    np.testing.assert_array_equal(
+        np.asarray(c.k[0, :, :, PS - 1]),
+        np.broadcast_to(np.asarray(tok1[0]), (LAYERS, KVH, D)))
+    np.testing.assert_array_equal(
+        np.asarray(c.k[3, :, :, 0]),
+        np.broadcast_to(np.asarray(tok2[0]), (LAYERS, KVH, D)))
+    assert np.asarray(c.lengths)[0] == PS + 1
+
+
+def test_advance_truncates_at_capacity_and_protects_pages():
+    c = _cache()
+    k = _rand((LAYERS, KVH, PS, D), 1)
+    c = kv_cache.insert_pages(c, 0, k, k, PS - 1, _row([2]))  # cap PS
+    tok = _rand((SLOTS, KVH, D), 7)
+    for layer in range(LAYERS):
+        c = kv_cache.append_layer(c, layer, tok, tok)
+    c, trunc = kv_cache.advance(c, jnp.asarray([True, False, False]))
+    assert np.asarray(trunc).tolist() == [False, False, False]
+    assert np.asarray(c.lengths)[0] == PS
+    # at capacity: the append clamps into the trash page, advance
+    # reports truncation, the owned page keeps its data
+    page2 = np.asarray(c.k[2]).copy()
+    for layer in range(LAYERS):
+        c = kv_cache.append_layer(c, layer, tok * 9, tok * 9)
+    c, trunc = kv_cache.advance(c, jnp.asarray([True, False, False]))
+    assert np.asarray(trunc).tolist() == [True, False, False]
+    assert np.asarray(c.lengths)[0] == PS            # clamped
+    np.testing.assert_array_equal(np.asarray(c.k[2]), page2)
+
+
+def test_evict_zeroes_metadata_and_reparks_page_row():
+    c = _cache()
+    k = _rand((LAYERS, KVH, PS, D), 1)
+    c = kv_cache.insert_pages(c, 1, k, k, 3, _row([0]))
+    c = kv_cache.evict(c, 1)
+    assert np.asarray(c.lengths).tolist() == [0, 0, 0]
+    assert np.asarray(c.capacity).tolist() == [0, 0, 0]
+    # the row re-parks on the trash page so the idle slot's future
+    # appends cannot chase the freed page into its next owner
+    assert np.all(np.asarray(c.page_table[1]) == c.null_page)
+    # data untouched (masked; the allocator reclaims page 0 host-side)
+    np.testing.assert_array_equal(np.asarray(c.k[0]), np.asarray(k))
+
+
+def test_retired_slot_append_cannot_corrupt_reassigned_page():
+    """Regression (review finding): slot 0 is retired and its page is
+    reassigned to slot 1; slot 0's still-running masked decode appends
+    must land in the trash page, not in slot 1's new data."""
+    c = _cache()
+    a = _rand((LAYERS, KVH, PS, D), 1)
+    c = kv_cache.insert_pages(c, 0, a, a, 2, _row([3]))
+    c = kv_cache.evict(c, 0)                 # retire; page 3 reclaimed
+    b = _rand((LAYERS, KVH, PS, D), 2)
+    c = kv_cache.insert_pages(c, 1, b, b, 3, _row([3]))  # reassigned
+    tok = jnp.full((SLOTS, KVH, D), 7.0)
+    for layer in range(LAYERS):
+        c = kv_cache.append_layer(c, layer, tok, tok)
+    c, _ = kv_cache.advance(c, jnp.asarray([True, True, False]))
+    got = np.asarray(c.k[3])
+    want = np.asarray(b).copy()
+    want[:, :, 3] = 7.0                      # slot 1's own append only
+    np.testing.assert_array_equal(got, want)
+
+
+def test_advance_does_not_flag_empty_active_slots_truncated():
+    """Regression (review finding): an active-but-never-admitted paged
+    slot (capacity 0) is empty, not a truncated stream."""
+    c = _cache()
+    k = _rand((LAYERS, KVH, PS, D), 1)
+    c = kv_cache.insert_pages(c, 0, k, k, 1, _row([0]))
+    c, trunc = kv_cache.advance(c, jnp.ones((SLOTS,), bool))
+    assert np.asarray(trunc).tolist() == [False, False, False]
+    assert np.asarray(c.lengths).tolist() == [2, 0, 0]
+
+
+def test_insert_validates():
+    c = _cache()
+    good = _rand((LAYERS, KVH, PS, D))
+    with pytest.raises(ValueError, match="prefill k/v"):
+        kv_cache.insert_pages(c, 0, _rand((LAYERS, KVH + 1, PS, D)),
+                              _rand((LAYERS, KVH + 1, PS, D)), 3,
+                              _row([0]))
+    with pytest.raises(ValueError, match="multiple of page_size"):
+        kv_cache.insert_pages(c, 0, _rand((LAYERS, KVH, PS + 1, D)),
+                              _rand((LAYERS, KVH, PS + 1, D)), 3,
+                              _row([0]))
+    with pytest.raises(ValueError, match="page row"):
+        kv_cache.insert_pages(c, 0, good, good, 3,
+                              np.zeros((MPPS + 1,), np.int32))
+    with pytest.raises(ValueError, match="exceed max_pages_per_slot"):
+        kv_cache.page_row(list(range(MPPS + 1)), MPPS, PAGES)
+
+
+def test_updates_are_donation_safe():
+    """insert+append+advance jit with the pool donated — one
+    allocation for the engine's lifetime, like the dense cache."""
+
+    def step(c, slab, tok, row):
+        c = kv_cache.insert_pages(c, 0, slab, slab, 3, row)
+        for layer in range(LAYERS):
+            c = kv_cache.append_layer(c, layer, tok, tok)
+        c, _ = kv_cache.advance(c, jnp.ones((SLOTS,), bool))
+        return c
+
+    c = _cache()
+    kbuf, tbuf = c.k, c.page_table
+    slab = _rand((LAYERS, KVH, PS, D), 1)
+    tok = _rand((SLOTS, KVH, D), 2)
+    c2 = jax.jit(step, donate_argnums=(0,))(c, slab, tok,
+                                            jnp.asarray(_row([0, 1])))
+    jax.block_until_ready(c2)
+    assert kbuf.is_deleted() and tbuf.is_deleted()
+    # slots 1/2 own no pages (capacity 0): advance holds them at 0 —
+    # un-admitted slots can't drift, unlike the dense cache's clamp
+    assert np.asarray(c2.lengths).tolist() == [4, 0, 0]
+
+
+def test_pool_is_scan_carryable():
+    def body(c, tok):
+        for layer in range(LAYERS):
+            c = kv_cache.append_layer(c, layer, tok, tok)
+        c, _ = kv_cache.advance(c, jnp.ones((SLOTS,), bool))
+        return c, c.lengths
+
+    c = _cache()
+    slab = _rand((LAYERS, KVH, PS, D), 1)
+    c = kv_cache.insert_pages(c, 0, slab, slab, 0, _row([0, 1]))
+    c = kv_cache.insert_pages(c, 1, slab, slab, 0, _row([2]))
+    c = kv_cache.insert_pages(c, 2, slab, slab, 0, _row([3]))
+    toks = _rand((4, SLOTS, KVH, D), 7)
+    c, hist = jax.lax.scan(body, c, toks)
+    assert np.asarray(c.lengths).tolist() == [4, 4, 4]
+    assert hist.shape == (4, SLOTS)
+
+
+# --------------------------------------------------------------------------
+# host-side page allocator
+# --------------------------------------------------------------------------
+
+def test_allocator_alloc_free_reuse():
+    al = kv_cache.PageAllocator(4, PS, MPPS)
+    a = al.alloc(2)
+    b = al.alloc(2)
+    assert sorted(a + b) == [0, 1, 2, 3]
+    assert al.alloc(1) is None            # exhausted -> backpressure
+    al.free(a)
+    c = al.alloc(2)
+    assert sorted(c) == sorted(a)         # freed pages come back
+    assert al.free_pages == 0
+
+
+def test_allocator_interleaved_retire_admit_leaks_nothing():
+    """Fragmentation shape: interleaved alloc/free of uneven requests
+    returns the pool to fully-free — no page leaked, none duplicated."""
+    al = kv_cache.PageAllocator(8, PS, MPPS)
+    held = {}
+    rng = np.random.RandomState(0)
+    uid = 0
+    for _ in range(200):
+        if held and (rng.rand() < 0.5 or al.free_pages == 0):
+            k = list(held)[rng.randint(len(held))]
+            al.free(held.pop(k))
+        else:
+            got = al.alloc(int(rng.randint(1, 4)))
+            if got is not None:
+                held[uid] = got
+                uid += 1
+        live = [p for ids in held.values() for p in ids]
+        assert len(live) == len(set(live))           # no double issue
+        assert len(live) + al.free_pages == 8        # conservation
+    for ids in held.values():
+        al.free(ids)
+    assert al.free_pages == 8
+
+
+def test_allocator_eviction_returns_all_pages_and_rejects_double_free():
+    al = kv_cache.PageAllocator(6, PS, MPPS)
+    ids = al.alloc(3)
+    al.free(ids)                          # retire returns EVERY page
+    assert al.free_pages == 6
+    with pytest.raises(ValueError, match="not outstanding"):
+        al.free(ids)                      # double free is a bug, loudly
+    with pytest.raises(ValueError, match="not outstanding"):
+        al.free([99])                     # foreign page likewise
+
+
+def test_allocator_pages_needed_rounds_and_clamps():
+    al = kv_cache.PageAllocator(8, 4, 3)
+    assert al.pages_needed(1) == 1
+    assert al.pages_needed(4) == 1
+    assert al.pages_needed(5) == 2
+    assert al.pages_needed(400) == 3      # clamped to the table width

@@ -86,7 +86,7 @@ def test_decode_is_one_executable_and_donates():
         jax.clear_caches()
         events.clear()
         for _ in range(5):
-            cache, toks, _ = eng.decode(cache, last, active)
+            cache, toks, _, _ = eng.decode(cache, last, active)
             last = np.asarray(toks)
         jax.block_until_ready(cache)
         n = sum(1 for e in events if "compile_requests" in e)
@@ -95,7 +95,7 @@ def test_decode_is_one_executable_and_donates():
         # donation: the old cache buffers are invalidated by the call
         cache2 = eng.init_cache()
         kbuf, vbuf = cache2.k, cache2.v
-        cache3, _, _ = eng.decode(cache2, last, active)
+        cache3, _, _, _ = eng.decode(cache2, last, active)
         jax.block_until_ready(cache3)
         assert kbuf.is_deleted() and vbuf.is_deleted(), \
             "decode did not consume the donated cache buffers"
@@ -127,7 +127,7 @@ def test_decode_advances_only_active_slots():
     cache, _, _ = eng.prefill(cache, [1, 2, 3], 0)
     cache, _, _ = eng.prefill(cache, [4, 5], 1)
     lengths0 = np.asarray(cache.lengths).copy()
-    cache, _, _ = eng.decode(cache, np.zeros((2,), np.int32),
+    cache, _, _, _ = eng.decode(cache, np.zeros((2,), np.int32),
                              np.array([True, False]))
     lengths1 = np.asarray(cache.lengths)
     assert lengths1[0] == lengths0[0] + 1

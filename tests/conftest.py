@@ -70,6 +70,13 @@ SLOW = {
     # lane keeps the 1-layer GQA sentinel
     # (test_llama_gqa_one_layer_greedy_fast) plus the kv-cache/decode-
     # attention/sampling/scheduler coverage
+    # paged engine (ISSUE 6): multi-layer / dual-engine parity runs
+    # measured 5-12 s; the fast lane keeps the 1-layer paged GQA
+    # sentinel (test_llama_gqa_one_layer_paged_greedy_fast) plus the
+    # admission-by-pages, truncation-reason and compile-count coverage
+    "tests/L0/run_inference/test_paged_engine.py::test_paged_generate_equals_dense_generate",
+    "tests/L0/run_inference/test_paged_engine.py::test_paged_kernel_path_engine_matches_dense",
+    "tests/L0/run_inference/test_paged_engine.py::test_out_of_pages_is_backpressure_not_failure",
     "tests/L0/run_inference/test_engine_parity.py::test_gpt_greedy_decode_matches_full_forward",
     "tests/L0/run_inference/test_engine_parity.py::test_gpt_bf16_params_greedy_matches",
     "tests/L0/run_inference/test_engine_parity.py::test_llama_gqa_greedy_decode_matches_full_forward",
