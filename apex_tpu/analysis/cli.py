@@ -136,6 +136,9 @@ def main(argv: Optional[list] = None) -> int:
               "peak-live estimate grew past .analysis_budget.json")
         print("APX216 comm-identity-violation     spmd audit: ZeRO "
               "RS+AG==AR accounting broken (PERF.md round-6)")
+        print("APX217 comm-not-overlapped         spmd audit: overlapped "
+              "executable's compiled HLO has no async start/done pair "
+              "(or schedulable compute) between collectives")
         return 0
 
     if args.write_budget:

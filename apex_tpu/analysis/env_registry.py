@@ -54,6 +54,29 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "decode_attention(xla_max_seq=...)",
         read_by="apex_tpu/ops/attention.py"),
     EnvKnob(
+        name="APEX_TPU_ZERO_PREFETCH",
+        default="0",
+        effect="number of layered-prefetch gather spans a ZeRO train "
+               "state is built with when prefetch= is not passed: the "
+               "flat master's param all-gather splits along leaf "
+               "boundaries into this many independent per-span gathers "
+               "XLA overlaps with the consuming layers (APX217-"
+               "verified; 0/1 = monolithic gather); stamped into ZeRO "
+               "bench captures as zero_prefetch",
+        read_by="apex_tpu/train_step.py"),
+    EnvKnob(
+        name="APEX_TPU_TP_OVERLAP_CHUNKS",
+        default="1",
+        effect="default overlap_chunks for tensor-parallel Column/Row "
+               "layers: >1 decomposes the row-parallel matmul+psum "
+               "(and the column-parallel backward psum) into an "
+               "N-chunk matmul/ppermute ring pipeline at identical "
+               "ring bytes (1 = fused psum; must be a multiple of the "
+               "tensor axis size); per-layer override: "
+               "overlap_chunks=; stamped into TP bench captures as "
+               "tp_overlap_chunks",
+        read_by="apex_tpu/transformer/tensor_parallel/mappings.py"),
+    EnvKnob(
         name="APEX_TPU_PAGE_SIZE",
         default="64",
         effect="default KV page size (tokens per page, power of two) "

@@ -95,7 +95,7 @@ STATIC_ATTRS = {
     # blanket-exempt those attribute reads on arbitrary objects and
     # silence true positives — the lint is AST-based, untyped).
     "shard", "shard_axis", "shard_dp", "shard_len", "global_numel",
-    "padded_numel",
+    "padded_numel", "spans", "span_sizes", "span_padded",
 }
 
 _DISABLE_RE = re.compile(r"#\s*apex-lint:\s*disable=([A-Z0-9_,\s]+)")

@@ -42,9 +42,26 @@ invariants PRs 3–4 proved by hand, per registered executable:
   APX216 machine-checks PERF.md round-6's ZeRO accounting on the zero
   step's own jaxpr: all-gather bytes == reduce-scatter bytes, i.e.
   RS + AG == the ring all-reduce of the same flat buffer.
+* **APX217 — comm/compute overlap (async scheduling).**  For
+  executables restructured for overlap (ISSUE 7: the layered-prefetch
+  zero step, the chunked TP ring), the COMPILED executable — the same
+  lowered-HLO route APX214 takes for donation, one step further — must
+  actually expose the overlap: on backends that schedule async
+  collectives, a strict majority of ``*-start``/``*-done`` pairs with
+  a compute op scheduled between start and done; on backends that
+  lower collectives synchronously (the CPU host devices this audit
+  runs on), the dependency-graph equivalent — a strict majority of the
+  DOMINANT collectives must each have substantial compute that is
+  mutually independent of them (exactly what a latency-hiding
+  scheduler would run between that start and its done; a decomposed
+  pipeline exposes only its boundary collectives, while a monolithic
+  gather gates every consumer and a fused matmul+psum hides at most
+  its wgrad half).  The pre-overlap lowerings fire this check — the
+  seeded-violation tests keep it honest.
 
 Everything is trace-only (``jax.make_jaxpr`` + ``jit(...).lower``) —
-zero FLOPs, runs on the 8 forced host devices in seconds.
+zero FLOPs, runs on the 8 forced host devices in seconds — except
+APX217, which compiles its (two) flagged executables for the host.
 """
 from __future__ import annotations
 
@@ -115,6 +132,7 @@ class ExecSpec:
     flag_undonated: bool = False     # step-shaped: flag alias-able args
     check_update_uniformity: bool = False
     rs_ag_identity: bool = False     # machine-check RS+AG==AR (PERF r6)
+    check_overlap: bool = False      # APX217: comm/compute overlap
 
 
 def _builders():
@@ -165,13 +183,21 @@ def _builders():
         step = train_step.make_train_step(_mlp_loss, tx)
         return step, (state, _mlp_batch()), {}
 
-    def train_step_zero():
+    def train_step_zero(prefetch=8):
         from apex_tpu import train_step
         from apex_tpu.optimizers import functional
         tx = functional.fused_adam(lr=1e-2)
         mesh = Mesh(np.array(jax.devices()[:2]), (ps.DATA_AXIS,))
+        # layered prefetch ON (one gather span per layer): the param
+        # all-gather decomposes into 8 independent per-span gathers the
+        # scheduler can hide under the consuming layers (APX217), at
+        # bytes identical to the monolithic gather (APX215 pins it).
+        # The prefetch=0 twin (train_step_zero_mono) keeps the
+        # production default — APEX_TPU_ZERO_PREFETCH=0, monolithic
+        # gather — under APX211-APX216.
         state, specs = train_step.init_zero_train_state(
-            tx, _mlp_params(), ps.DATA_AXIS, 2, loss_scale="dynamic")
+            tx, _mlp_params(), ps.DATA_AXIS, 2, loss_scale="dynamic",
+            prefetch=prefetch)
         step = train_step.make_train_step(_mlp_loss, tx, zero=True)
         fn = shard_map(step, mesh=mesh, in_specs=(specs, P()),
                        out_specs=(specs, P()))
@@ -191,17 +217,31 @@ def _builders():
         fn = shard_map(body, mesh=mesh, in_specs=(P(),), out_specs=P())
         return fn, (grads,), dict(mesh.shape)
 
-    def tp_column_row():
+    def tp_column_row(chunks=4):
         from apex_tpu.transformer import tensor_parallel
         ps.destroy_model_parallel()
         ps.initialize_model_parallel(tensor_model_parallel_size_=2)
         mesh = ps.get_mesh()
+        # chunked overlap ON: the row matmul+psum becomes a 4-chunk
+        # matmul/ppermute ring (+ all-gather) and the column backward
+        # psum the matching ring pipeline — same ring bytes as the
+        # fused psums (APX215), chunk GEMMs schedulable under the hops
+        # (APX217).  Tokens 4 (was 3) so the ring chunks divide; 4
+        # chunks (not 2) because at tp=2 a 2-chunk ring is ONE hop —
+        # boundary-dominated at this fixture size, so only half its
+        # collectives can overlap and APX217's majority bar
+        # (correctly) treats that as not pipelined.  The chunks=1 twin
+        # (tp_column_row_fused) keeps the production default —
+        # APEX_TPU_TP_OVERLAP_CHUNKS=1, fused psums — under
+        # APX211-APX216.
         col = tensor_parallel.ColumnParallelLinear(8, 16,
                                                    gather_output=False,
-                                                   bias=False)
+                                                   bias=False,
+                                                   overlap_chunks=chunks)
         row = tensor_parallel.RowParallelLinear(16, 8,
                                                 input_is_parallel=True,
-                                                bias=False)
+                                                bias=False,
+                                                overlap_chunks=chunks)
 
         def body(x):
             pc = col.init(jax.random.key(0), x)
@@ -217,8 +257,8 @@ def _builders():
 
         fn = shard_map(body, mesh=mesh, in_specs=(P(),),
                        out_specs=(P(), P()))
-        x = jnp.asarray(np.linspace(-1, 1, 3 * 8,
-                                    dtype=np.float32).reshape(3, 8))
+        x = jnp.asarray(np.linspace(-1, 1, 4 * 8,
+                                    dtype=np.float32).reshape(4, 8))
         return fn, (x,), dict(mesh.shape)
 
     def pipeline_1f1b():
@@ -307,52 +347,69 @@ def _builders():
         return fn, args, {}
 
     return {
-        # name: (builder, path, donate, flag_undonated, update_unif, rs_ag)
+        # name: (builder, path, donate, flag_undonated, update_unif,
+        #        rs_ag, overlap)
         "train_step_dense": (train_step_dense, "apex_tpu/train_step.py",
-                             (0,), True, True, False),
+                             (0,), True, True, False, False),
         "train_step_zero": (train_step_zero, "apex_tpu/train_step.py",
-                            (0,), True, True, True),
+                            (0,), True, True, True, True),
+        # production default (APEX_TPU_ZERO_PREFETCH=0): the monolithic
+        # gather stays machine-checked even though the overlapped
+        # fixture above is what APX217 verifies
+        "train_step_zero_mono": (functools.partial(train_step_zero,
+                                                   prefetch=0),
+                                 "apex_tpu/train_step.py",
+                                 (0,), True, True, True, False),
         "ddp_allreduce": (ddp_bucketed_allreduce,
                           "apex_tpu/parallel/distributed.py",
-                          (), False, False, False),
+                          (), False, False, False, False),
         "tp_column_row": (tp_column_row,
                           "apex_tpu/transformer/tensor_parallel/layers.py",
-                          (), False, False, False),
+                          (), False, False, False, True),
+        # production default (APEX_TPU_TP_OVERLAP_CHUNKS=1): the fused
+        # psum lowering stays machine-checked alongside the ring twin
+        "tp_column_row_fused": (functools.partial(tp_column_row,
+                                                  chunks=1),
+                                "apex_tpu/transformer/tensor_parallel/"
+                                "layers.py",
+                                (), False, False, False, False),
         "pipeline_1f1b": (pipeline_1f1b,
                           "apex_tpu/transformer/pipeline_parallel/"
                           "schedules.py",
-                          (), False, False, False),
+                          (), False, False, False, False),
         "ring_attention_cp": (ring_attention_cp,
                               "apex_tpu/ops/ring_attention.py",
-                              (), False, False, False),
+                              (), False, False, False, False),
         "ulysses_attention_cp": (ulysses_attention_cp,
                                  "apex_tpu/ops/ulysses_attention.py",
-                                 (), False, False, False),
+                                 (), False, False, False, False),
         "moe_dispatch": (moe_dispatch,
                          "apex_tpu/transformer/moe/layer.py",
-                         (), False, False, False),
+                         (), False, False, False, False),
         "inference_prefill": (lambda: _inference("inference_prefill"),
                               "apex_tpu/inference/engine.py",
-                              (0,), True, False, False),
+                              (0,), True, False, False, False),
         "inference_decode": (lambda: _inference("inference_decode"),
                              "apex_tpu/inference/engine.py",
-                             (0,), True, False, False),
+                             (0,), True, False, False, False),
         # the paged serving memory model (ISSUE 6), registered at a
         # straggler-shaped fixture: the pool (+page table) is donated
         # like the dense cache, and its APX215 peak-live entry is the
         # number the paged-vs-dense HBM comparison test ratchets
         "inference_prefill_paged": (
             lambda: _inference("inference_prefill_paged"),
-            "apex_tpu/inference/engine.py", (0,), True, False, False),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            False),
         "inference_decode_paged": (
             lambda: _inference("inference_decode_paged"),
-            "apex_tpu/inference/engine.py", (0,), True, False, False),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            False),
     }
 
 
 def exec_specs() -> List[ExecSpec]:
-    return [ExecSpec(name, path, build, donate, undon, unif, rs_ag)
-            for name, (build, path, donate, undon, unif, rs_ag)
+    return [ExecSpec(name, path, build, donate, undon, unif, rs_ag, ovl)
+            for name, (build, path, donate, undon, unif, rs_ag, ovl)
             in _builders().items()]
 
 
@@ -715,6 +772,261 @@ def _check_donation(spec: ExecSpec, fn, args, emit) -> None:
 
 
 # ---------------------------------------------------------------------------
+# APX217 — comm/compute overlap verification on the COMPILED executable
+# ---------------------------------------------------------------------------
+
+#: collective HLO opcodes whose scheduling the overlap check reasons
+#: about (the sync spellings; async backends suffix -start/-done).
+_OVERLAP_COLL_OPS = frozenset({
+    "all-gather", "all-reduce", "collective-permute", "reduce-scatter",
+    "all-to-all", "collective-broadcast"})
+
+#: HLO opcodes that count as REAL compute for "compute scheduled
+#: between start and done" — data movement (bitcast/copy/slice/concat/
+#: broadcast/transpose/tuple) deliberately does not.
+_HLO_COMPUTE_OPS = frozenset({
+    "fusion", "dot", "convolution", "reduce", "reduce-window", "add",
+    "subtract", "multiply", "divide", "tanh", "exponential", "log",
+    "rsqrt", "sqrt", "power", "negate", "maximum", "minimum", "select",
+    "compare", "map", "sort", "scatter", "custom-call"})
+
+_HLO_INSTR_RE = re.compile(r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_HLO_OP_RE = re.compile(r"\s([a-z][a-z0-9\-]*)\(")
+_HLO_REF_RE = re.compile(r"%([\w.\-]+)")
+_HLO_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+_HLO_CALLS_RE = re.compile(r"(?:calls|to_apply)=%?([\w.\-]+)")
+
+_HLO_ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                 "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                 "s64": 8, "u64": 8, "f64": 8}
+
+
+def _hlo_type_bytes(type_seg: str) -> int:
+    total = 0
+    for dt, dims in _HLO_SHAPE_RE.findall(type_seg):
+        size = 1
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        total += size * _HLO_ITEMSIZE.get(dt, 4)
+    return total
+
+
+def _parse_entry_instructions(text: str) -> list:
+    """``[(name, opcode, operand_names, result_bytes)]`` for the
+    compiled module's ENTRY computation, in schedule (program) order.
+    Operand refs that don't name an earlier entry instruction
+    (computation names in ``calls=``/``to_apply=``, metadata) drop out
+    when the dependency graph resolves names.  Handles both HLO text
+    spellings: ``%``-sigiled names, and the sigil-less dump (operand
+    names are then the identifier tokens in the opcode's argument
+    list)."""
+    out = []
+    in_entry = False
+    for line in text.splitlines():
+        if line.lstrip().startswith("ENTRY"):
+            in_entry = True
+            continue
+        if not in_entry:
+            continue
+        if line.strip() == "}":
+            break
+        m = _HLO_INSTR_RE.match(line)
+        if m is None:
+            continue
+        name, rest = m.group(2), m.group(3)
+        om = _HLO_OP_RE.search(" " + rest)
+        if om is None:
+            continue
+        type_seg = (" " + rest)[:om.start(1)]
+        refs = _HLO_REF_RE.findall(rest)
+        if not refs:
+            seg = (" " + rest)[om.end(1):]
+            seg = seg[:seg.index(")")] if ")" in seg else seg
+            seg = re.sub(r"[a-z]+[0-9]*\[[0-9,]*\]\S*", " ", seg)
+            refs = re.findall(r"[A-Za-z_][\w.\-]*", seg)
+        cm = _HLO_CALLS_RE.search(rest)
+        if cm and cm.group(1) not in refs:
+            refs.append(cm.group(1))
+        out.append((name, om.group(1), refs, _hlo_type_bytes(type_seg)))
+    return out
+
+
+def _computation_collectives(text: str) -> dict:
+    """Non-ENTRY computation name -> set of collective opcodes in its
+    body.  Resolves GENERIC ``async-start(...), calls=...`` wrappers —
+    the spelling XLA uses to asyncify collectives without a dedicated
+    fused opcode (e.g. reduce-scatter / all-to-all on TPU) — back to
+    the collective they wrap."""
+    out: dict = {}
+    cur = None
+    for line in text.splitlines():
+        st = line.strip()
+        if st.endswith("{") and "=" not in st:
+            if st.startswith("ENTRY"):
+                cur = None
+                continue
+            m = re.match(r"%?([\w.\-]+)", st)
+            cur = m.group(1) if m else None
+            if cur is not None:
+                out[cur] = set()
+            continue
+        if st == "}":
+            cur = None
+            continue
+        if cur is None:
+            continue
+        m = _HLO_INSTR_RE.match(line)
+        if m is not None:
+            om = _HLO_OP_RE.search(" " + m.group(3))
+            if om is not None and om.group(1) in _OVERLAP_COLL_OPS:
+                out[cur].add(om.group(1))
+    return out
+
+
+def _check_async_overlap(spec: "ExecSpec", fn, args, emit) -> None:
+    """APX217: the compiled executable of an overlap-restructured hot
+    path must expose comm/compute overlap to the scheduler.
+
+    Async backends (TPU latency-hiding scheduler): find
+    ``*-start``/``*-done`` collective pairs — dedicated fused opcodes
+    AND generic ``async-start`` wrappers resolved through their
+    ``calls=`` computation (XLA's spelling for reduce-scatter /
+    all-to-all), following ``async-update`` chains to the done — and
+    require a strict majority with at least one compute op scheduled
+    between start and done.  Synchronous backends (the forced CPU host devices this audit
+    runs on): the dependency-graph equivalent — a dominant collective
+    counts as OVERLAPPED when some substantial compute op is mutually
+    independent of it (exactly the op an async scheduler would place
+    between its start and done), and a strict majority of the dominant
+    collectives must be overlapped.  The majority bar is the pipeline
+    bound: a K-way decomposition exposes only its schedule-boundary
+    collectives (first gather, last scatter — < half for any K >= 2),
+    while a monolithic gather gates every consumer and a fused
+    matmul+psum hides at most its wgrad half (exactly half).  Two
+    floors keep trivia out: collectives below 1/8 of the largest
+    collective's payload (scalar loss pmeans, found_inf pmax) are not
+    dominant, and witness compute below 1/8 of the collective's payload
+    (scaler bookkeeping) does not count as hiding it."""
+    import jax
+
+    jitted = jax.jit(fn, donate_argnums=spec.donate_argnums or ())
+    try:
+        text = jitted.lower(*args).compile().as_text()
+    except Exception as e:  # noqa: BLE001 — surfaced as a finding
+        emit("APX210", f"compiling {spec.name} for overlap verification "
+                       f"failed: {type(e).__name__}: {e}")
+        return
+    _overlap_findings_from_hlo(spec.name, text, emit)
+
+
+def _overlap_findings_from_hlo(name: str, text: str, emit) -> None:
+    """APX217 over already-compiled HLO text (split from
+    :func:`_check_async_overlap` so the async route — which only real
+    TPU lowerings produce — is testable from canned module text)."""
+    instrs = _parse_entry_instructions(text)
+    index = {name: i for i, (name, _, _, _) in enumerate(instrs)}
+
+    def dominant(idxs):
+        if not idxs:
+            return idxs
+        floor = max(instrs[i][3] for i in idxs) / 8
+        return [i for i in idxs if instrs[i][3] >= floor]
+
+    # -- async route: explicit start/done pairs in the schedule --------
+    # two async spellings: dedicated fused opcodes (all-gather-start,
+    # collective-permute-start, ...) and the generic async-start whose
+    # calls= computation wraps the collective (reduce-scatter /
+    # all-to-all on TPU)
+    comp_colls = _computation_collectives(text)
+
+    def async_coll(i):
+        _, op, refs, _ = instrs[i]
+        if op.endswith("-start") and op[:-6] in _OVERLAP_COLL_OPS:
+            return op[:-6]
+        if op == "async-start":
+            for r in refs:
+                if comp_colls.get(r):
+                    return sorted(comp_colls[r])[0]
+        return None
+
+    start_coll = {i: c for i in range(len(instrs))
+                  if (c := async_coll(i)) is not None}
+    starts = dominant(list(start_coll))
+    if starts:
+        overlapped = 0
+        for i in starts:
+            done_ops = {start_coll[i] + "-done", "async-done"}
+            # follow the start's async value through any async-update
+            # links to its done
+            aliases = {instrs[i][0]}
+            done = None
+            for j in range(i + 1, len(instrs)):
+                nm, op, refs, _ = instrs[j]
+                if op == "async-update" and aliases & set(refs):
+                    aliases.add(nm)
+                elif op in done_ops and aliases & set(refs):
+                    done = j
+                    break
+            if done is None:
+                continue
+            # same witness floor as the sync route: scalar bookkeeping
+            # scheduled between start and done is not hiding the comm
+            wfloor = max(instrs[i][3] // 8, 16)
+            if any(instrs[k][1] in _HLO_COMPUTE_OPS
+                   and instrs[k][3] >= wfloor
+                   for k in range(i + 1, done)):
+                overlapped += 1
+        if 2 * overlapped <= len(starts):
+            emit("APX217",
+                 f"{name}: only {overlapped}/{len(starts)} async "
+                 f"collective pair(s) in the compiled schedule have a "
+                 f"compute op between start and done — the comm is "
+                 f"async in name only and still serializes the critical "
+                 f"path")
+        return
+
+    # -- sync route: dependency-graph schedulability -------------------
+    colls = dominant([i for i, (_, op, _, _) in enumerate(instrs)
+                      if op in _OVERLAP_COLL_OPS])
+    if len(colls) < 2:
+        emit("APX217",
+             f"{name}: the compiled executable carries "
+             f"{len(colls)} dominant collective(s) — the overlap "
+             f"restructuring (per-span gathers / ring chunks) did not "
+             f"survive lowering, so there is nothing a scheduler could "
+             f"overlap")
+        return
+    # ancestors as bitsets over instruction indices (defs precede uses)
+    anc = [0] * len(instrs)
+    for i, (_, _, refs, _) in enumerate(instrs):
+        a = 0
+        for rname in refs:
+            j = index.get(rname)
+            if j is not None and j < i:
+                a |= anc[j] | (1 << j)
+        anc[i] = a
+    compute = [i for i, (_, op, _, _) in enumerate(instrs)
+               if op in _HLO_COMPUTE_OPS]
+    overlapped = 0
+    for c in colls:
+        wfloor = max(instrs[c][3] // 8, 16)
+        if any(instrs[f][3] >= wfloor
+               and not (anc[f] & (1 << c)) and not (anc[c] & (1 << f))
+               for f in compute):
+            overlapped += 1
+    if 2 * overlapped <= len(colls):
+        emit("APX217",
+             f"{name}: only {overlapped}/{len(colls)} dominant "
+             f"collective(s) in the compiled executable have substantial "
+             f"compute a scheduler could run between their start and "
+             f"done (the rest each gate — or hang off — every compute "
+             f"op); decompose the collective along the consumption "
+             f"order (per-span gathers, ring chunks) so comm hides "
+             f"under compute")
+
+
+# ---------------------------------------------------------------------------
 # audit driver
 # ---------------------------------------------------------------------------
 
@@ -777,6 +1089,10 @@ def _audit_exec(spec: ExecSpec) -> tuple:
     # APX214 — donation verification on the lowered executable
     if spec.donate_argnums or spec.flag_undonated:
         _check_donation(spec, fn, args, emit)
+
+    # APX217 — comm/compute overlap on the COMPILED executable
+    if spec.check_overlap:
+        _check_async_overlap(spec, fn, args, emit)
 
     # comm/HBM ledger entry
     sizes = dict(axis_sizes)

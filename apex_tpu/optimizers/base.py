@@ -27,8 +27,8 @@ import numpy as np
 from apex_tpu.utils import tree_ravel
 
 __all__ = ["FusedOptimizerBase", "broadcast_leaf_scalars",
-           "shard_leaf_spans", "sharded_leaf_sq_norms",
-           "sharded_leaf_broadcast"]
+           "shard_leaf_spans", "prefetch_leaf_spans",
+           "sharded_leaf_sq_norms", "sharded_leaf_broadcast"]
 
 #: above this DP width the lax.switch-over-ranks static-span paths
 #: (O(dp * n_leaves) compiled branches) give way to the global-buffer
@@ -79,36 +79,103 @@ def shard_leaf_spans(sizes: Sequence[int], dp: int, shard_len: int):
     return spans
 
 
+def prefetch_leaf_spans(sizes: Sequence[int], span_leaves: Sequence[int],
+                        dp: int):
+    """Per-rank leaf spans for the ZeRO *prefetch* shard layout.
+
+    Under the layered-prefetch layout (``FlatState.spans``) the flat
+    master is sharded per gather span instead of as one contiguous
+    block: each span (a group of consecutive leaves, padded to a ``dp``
+    multiple) is split ``1/dp``, and rank r's shard is the concatenation
+    of its slice of every span.  This returns the same
+    ``spans[r] = [(leaf_id, lo, hi)]`` shard-local structure as
+    :func:`shard_leaf_spans`, but with the per-span windows — padding
+    gaps can be INTERIOR (each span's tail), not just at the end."""
+    sizes = [int(s) for s in sizes]
+    from apex_tpu.utils import cdiv
+    out = [[] for _ in range(dp)]
+    leaf0 = 0
+    shard_off = 0                      # shard-local offset of this span
+    for count in span_leaves:
+        group = sizes[leaf0:leaf0 + count]
+        span_size = sum(group)
+        lk = cdiv(span_size, dp)       # per-rank slice of this span
+        offs = [0]
+        for s in group:
+            offs.append(offs[-1] + s)
+        for r in range(dp):
+            start, end = r * lk, (r + 1) * lk
+            for j, (o, s) in enumerate(zip(offs, group)):
+                lo, hi = max(o, start), min(o + s, end)
+                if hi > lo:
+                    out[r].append((leaf0 + j, shard_off + lo - start,
+                                   shard_off + hi - start))
+        leaf0 += count
+        shard_off += lk
+    return out
+
+
 def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
                           *, dp: int, shard_len: int,
-                          rank: jax.Array) -> jax.Array:
+                          rank: jax.Array, spans=None) -> jax.Array:
     """``[len(vecs), n_leaves]`` per-tensor partial sums of squares of MY
     shard of each flat vector, over the static leaf-span layout.  The
     caller ``psum``s the result over the dp axis to get global norms.
 
+    ``spans`` overrides the contiguous-block layout with the ZeRO
+    layered-prefetch shard layout: the per-span leaf-count tuple
+    (``FlatState.spans``), expanded to per-rank windows internally via
+    :func:`prefetch_leaf_spans`.
+
     Compile cost of the switch path is O(dp · n_leaves) HLO ops (dead
     branches are compiled, not executed); above ``_SWITCH_MAX_DP`` this
     falls back to placing the shard into a zeroed global buffer (the
-    leaf layout is globally static and only the shard offset is
-    dynamic), bounding compile size at the cost of O(n) extra HBM
-    traffic."""
+    leaf layout — per whole master OR per span — is globally static and
+    only the shard offset is dynamic, so every rank's leaf windows
+    collapse into ONE branch of sums over the zero-elsewhere buffer),
+    bounding compile size at O(n_leaves + n_spans) — independent of dp
+    for BOTH layouts — at the cost of O(n) extra HBM traffic."""
     sizes = [int(s) for s in sizes]
     n_tensors = len(sizes)
+    spans = tuple(spans) if spans else None
     if dp > _SWITCH_MAX_DP:
-        npad = dp * shard_len
-        offs = list(np.cumsum([0] + sizes[:-1]))
+        if spans is None:
+            # one contiguous block: each leaf is ONE window of the
+            # rank-major global buffer
+            groups = [(0, shard_len, 0, sizes)]
+        else:
+            # span layout: each span is itself a contiguous block
+            # layout of its leaf group (rank r owns [r·lk, (r+1)·lk)
+            # of the dp-padded span), so run the block fallback PER
+            # SPAN — n_spans updates + n_leaves window sums, still
+            # dp-independent (the point of this path)
+            from apex_tpu.utils import cdiv
+            groups, leaf0, off = [], 0, 0
+            for count in spans:
+                group = sizes[leaf0:leaf0 + count]
+                lk = cdiv(sum(group), dp)
+                groups.append((off, lk, leaf0, group))
+                leaf0 += count
+                off += lk
 
         def global_sq_norms(vec):
-            full = jax.lax.dynamic_update_slice_in_dim(
-                jnp.zeros((npad,), jnp.float32),
-                jnp.square(vec.astype(jnp.float32)),
-                rank * shard_len, axis=0)
-            return jnp.stack([
-                jnp.sum(jax.lax.dynamic_slice_in_dim(full, o, s))
-                for o, s in zip(offs, sizes)])
+            sq = jnp.square(vec.astype(jnp.float32))
+            row = [jnp.float32(0.0)] * n_tensors
+            for off, lk, leaf0, group in groups:
+                buf = jax.lax.dynamic_update_slice_in_dim(
+                    jnp.zeros((dp * lk,), jnp.float32),
+                    jax.lax.slice_in_dim(sq, off, off + lk),
+                    rank * lk, axis=0)
+                o = 0
+                for j, s in enumerate(group):
+                    row[leaf0 + j] = jnp.sum(
+                        jax.lax.dynamic_slice_in_dim(buf, o, s))
+                    o += s
+            return jnp.stack(row)
         return jnp.stack([global_sq_norms(v) for v in vecs])
 
-    spans = shard_leaf_spans(sizes, dp, shard_len)
+    spans = (shard_leaf_spans(sizes, dp, shard_len) if spans is None
+             else prefetch_leaf_spans(sizes, spans, dp))
 
     def branch(rs):
         def f(vs):
@@ -130,37 +197,58 @@ def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
 
 def sharded_leaf_broadcast(scalars: jax.Array, sizes: Sequence[int], *,
                            dp: int, shard_len: int, rank: jax.Array,
-                           pad_value: float = 1.0) -> jax.Array:
+                           pad_value: float = 1.0, spans=None) -> jax.Array:
     """Shard-local :func:`broadcast_leaf_scalars`: expand a
     ``(n_leaves,)`` vector to MY rank's ``[shard_len]`` window of the
-    flat per-element buffer (padding tail filled with ``pad_value``).
+    flat per-element buffer (padding gaps filled with ``pad_value``).
     Same static-span / ``lax.switch`` discipline as
-    :func:`sharded_leaf_sq_norms`, with the same bounded-compile
+    :func:`sharded_leaf_sq_norms` (including the ``spans`` override —
+    the per-span leaf-count tuple — for the prefetch layout, whose
+    padding gaps can be interior), with the same bounded-compile
     global-buffer fallback above ``_SWITCH_MAX_DP``."""
     sizes = [int(s) for s in sizes]
+    spans = tuple(spans) if spans else None
     if dp > _SWITCH_MAX_DP:
-        npad = dp * shard_len
-        n = sum(sizes)
-        gsizes = list(sizes)
-        gscalars = scalars
-        if npad > n:
-            gsizes.append(npad - n)
-            gscalars = jnp.concatenate(
-                [scalars, jnp.full((1,), pad_value, scalars.dtype)])
-        return jax.lax.dynamic_slice_in_dim(
-            broadcast_leaf_scalars(gscalars, gsizes),
-            rank * shard_len, shard_len)
+        from apex_tpu.utils import cdiv
+        # per-span block broadcast (one whole-master span when block
+        # layout): each span's global [leaf scalars + tail pad] buffer
+        # sliced at my rank's window, concatenated in shard order —
+        # O(n_leaves + n_spans) segments, independent of dp
+        parts, leaf0 = [], 0
+        for count in (spans if spans is not None else (len(sizes),)):
+            group = sizes[leaf0:leaf0 + count]
+            span_size = sum(group)
+            lk = (cdiv(span_size, dp) if spans is not None
+                  else shard_len)
+            gsizes = list(group)
+            gscalars = scalars[leaf0:leaf0 + count]
+            if dp * lk > span_size:
+                gsizes.append(dp * lk - span_size)
+                gscalars = jnp.concatenate(
+                    [gscalars, jnp.full((1,), pad_value, scalars.dtype)])
+            parts.append(jax.lax.dynamic_slice_in_dim(
+                broadcast_leaf_scalars(gscalars, gsizes), rank * lk, lk))
+            leaf0 += count
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
-    spans = shard_leaf_spans(sizes, dp, shard_len)
+    spans = (shard_leaf_spans(sizes, dp, shard_len) if spans is None
+             else prefetch_leaf_spans(sizes, spans, dp))
 
     def branch(rs):
         def f(scalars):
-            vals = [scalars[i] for i, _, _ in rs]
-            span_sizes = [hi - lo for _, lo, hi in rs]
-            covered = sum(span_sizes)
-            if covered < shard_len:     # padding tail
+            # walk the rank's spans in shard order, filling every gap
+            # (block layout: one tail; prefetch layout: per-span tails)
+            vals, span_sizes, pos = [], [], 0
+            for i, lo, hi in sorted(rs, key=lambda t: t[1]):
+                if lo > pos:
+                    vals.append(jnp.asarray(pad_value, scalars.dtype))
+                    span_sizes.append(lo - pos)
+                vals.append(scalars[i])
+                span_sizes.append(hi - lo)
+                pos = hi
+            if pos < shard_len:
                 vals.append(jnp.asarray(pad_value, scalars.dtype))
-                span_sizes.append(shard_len - covered)
+                span_sizes.append(shard_len - pos)
             return broadcast_leaf_scalars(jnp.stack(vals), span_sizes)
         return f
 

@@ -60,7 +60,74 @@ __all__ = [
     "fused_adagrad",
     "shard_flat_grads",
     "export_params",
+    "prefetch_span_layout",
 ]
+
+
+def prefetch_span_layout(sizes, k: int) -> tuple:
+    """Group ``len(sizes)`` leaves into at most ``k`` gather spans of
+    roughly equal element counts, aligned to leaf boundaries (the
+    layered-prefetch split of the flat master along ``leaf_offsets``).
+
+    Returns a tuple of per-span LEAF COUNTS (``sum == len(sizes)``) —
+    the static ``FlatState.spans`` layout.  Greedy: close a span once it
+    reaches ``total/k`` elements, so homogeneous stacks of layers land
+    one layer per span."""
+    sizes = [int(s) for s in sizes]
+    k = max(1, min(int(k), len(sizes)))
+    target = sum(sizes) / k
+    counts, run, acc = [], 0, 0
+    for i, s in enumerate(sizes):
+        run += 1
+        acc += s
+        remaining_leaves = len(sizes) - i - 1
+        if (acc >= target and len(counts) < k - 1) \
+                or remaining_leaves < (k - 1 - len(counts)):
+            counts.append(run)
+            run, acc = 0, 0
+    if run:
+        counts.append(run)
+    return tuple(counts)
+
+
+def _normalize_prefetch(prefetch, sizes) -> tuple:
+    """Resolve a ``prefetch=`` argument to the static ``FlatState.spans``
+    tuple: a tuple of per-span leaf counts passes through, an int > 1 is
+    grouped along leaf boundaries by :func:`prefetch_span_layout`, and
+    ``None``/0/1 mean the contiguous block layout (``()``).  The single
+    place this rule lives — ``_init_state`` and
+    ``train_step.init_zero_train_state`` both go through it."""
+    if prefetch is None:
+        return ()
+    if isinstance(prefetch, tuple):
+        spans = tuple(int(c) for c in prefetch)
+        if spans and (min(spans) <= 0 or sum(spans) != len(sizes)):
+            raise ValueError(
+                f"prefetch span layout {spans} must be positive leaf "
+                f"counts summing to the number of leaves "
+                f"({len(sizes)}); got sum {sum(spans)}")
+        return spans
+    return (prefetch_span_layout(sizes, int(prefetch))
+            if int(prefetch) > 1 else ())
+
+
+def _layout_master(master, *, sizes, spans, dp: int):
+    """Pad a GLOBAL unpadded flat buffer to its dp-shardable layout:
+    zero-pad to the dp multiple (block layout), or per-span pad and
+    rank-major permute (:func:`_enspan`, prefetch layout)."""
+    if spans:
+        span_sizes, leaf = [], 0
+        for count in spans:
+            span_sizes.append(sum(sizes[leaf:leaf + count]))
+            leaf += count
+        span_padded = tuple(cdiv(s, dp) * dp for s in span_sizes)
+        return _enspan(master, tuple(span_sizes), span_padded, dp)
+    n = int(master.shape[0])
+    padded = cdiv(n, dp) * dp
+    if padded != n:
+        return jnp.concatenate(
+            [master, jnp.zeros((padded - n,), master.dtype)])
+    return master
 
 
 def _f32(x):
@@ -88,6 +155,17 @@ class FlatState:
     (see :mod:`apex_tpu.optimizers.base`).  Because the flat master is
     ONE contiguous buffer, sharding it is a static slice — not a
     297-leaf bucketing problem.
+
+    ``spans`` is the layered-prefetch layout (ISSUE 7 comm/compute
+    overlap): ``()`` (the contiguous-block shard above, default) or a
+    tuple of per-span LEAF COUNTS.  Each span — a group of consecutive
+    leaves, padded to a ``dp`` multiple INDIVIDUALLY — is sharded
+    ``1/dp``, and the rank's shard is the concatenation of its slice of
+    every span.  The param gather then decomposes into one independent
+    ``all_gather`` per span, so XLA's scheduler can prefetch span k+1
+    while span k's layers compute; autodiff's transpose produces the
+    matching per-span ``psum_scatter``, the grads arrive flat in the
+    same shard layout, and the fused update kernels are untouched.
     """
     master: jax.Array               # fp32 flat master buffer (or shard)
     count: jax.Array                # f32 scalar: completed update count
@@ -98,6 +176,7 @@ class FlatState:
     unravel: Optional[Callable] = flax.struct.field(pytree_node=False,
                                                     default=None)
     shard: tuple = flax.struct.field(pytree_node=False, default=())
+    spans: tuple = flax.struct.field(pytree_node=False, default=())
 
     @property
     def offsets(self) -> tuple:
@@ -122,7 +201,25 @@ class FlatState:
         return sum(self.sizes)
 
     @property
+    def span_sizes(self) -> tuple:
+        """Unpadded element count of each prefetch span (``()`` for the
+        block layout)."""
+        out, leaf = [], 0
+        for count in self.spans:
+            out.append(sum(self.sizes[leaf:leaf + count]))
+            leaf += count
+        return tuple(out)
+
+    @property
+    def span_padded(self) -> tuple:
+        """Per-span dp-padded element counts."""
+        dp = self.shard_dp
+        return tuple(cdiv(s, dp) * dp for s in self.span_sizes)
+
+    @property
     def padded_numel(self) -> int:
+        if self.spans:
+            return sum(self.span_padded)
         return cdiv(self.global_numel, self.shard_dp) * self.shard_dp
 
     @property
@@ -130,12 +227,29 @@ class FlatState:
         """Per-rank shard length: ``ceil(P_padded / dp)`` elements."""
         return self.padded_numel // self.shard_dp
 
+    def _despan(self, flat):
+        """Reassemble the GLOBAL unpadded flat master from a rank-major
+        span-layout padded buffer (static slices + one concat)."""
+        dp, lt = self.shard_dp, self.shard_len
+        parts, off = [], 0
+        for size_k, padded_k in zip(self.span_sizes, self.span_padded):
+            lk = padded_k // dp
+            span = jnp.concatenate(
+                [jax.lax.slice_in_dim(flat, r * lt + off, r * lt + off + lk)
+                 for r in range(dp)]) if dp > 1 else \
+                jax.lax.slice_in_dim(flat, off, off + lk)
+            parts.append(span[:size_k] if padded_k != size_k else span)
+            off += lk
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
     def _full_master(self, dtype=None):
         """GLOBAL unpadded flat master.  For a sharded LOCAL view this
         all-gathers over the shard axis (call inside the mapped region);
         a sharded GLOBAL view (buffers already full-size, e.g. a state
         passed OUT of shard_map with a dp-sharded out-spec) and the
-        dense case just slice."""
+        dense case just slice.  A prefetch-layout buffer (local or
+        global view) is rank-major per span and is statically
+        reassembled after the gather."""
         flat = self.master
         if dtype is not None:
             flat = flat.astype(dtype)
@@ -143,6 +257,8 @@ class FlatState:
                 and flat.shape[0] != self.padded_numel:
             flat = jax.lax.all_gather(flat, self.shard_axis, axis=0,
                                       tiled=True)
+        if self.spans and self.shard_dp > 1:
+            return self._despan(flat)
         n = self.global_numel
         return flat[:n] if flat.shape[0] != n else flat
 
@@ -195,6 +311,28 @@ def export_params(flat, params_template, *, dtype=None):
     return tree if dtype is None else _cast_floating(tree, dtype)
 
 
+def _enspan(flat, span_sizes, span_padded, dp):
+    """Permute a GLOBAL unpadded flat buffer into the rank-major
+    prefetch layout: each span zero-padded to its dp multiple, then the
+    per-rank slices concatenated rank-major (the exact buffer a
+    ``P(axis)`` block split hands each rank as its span-layout shard).
+    Inverse of :meth:`FlatState._despan`."""
+    padded_spans, off = [], 0
+    for size_k, padded_k in zip(span_sizes, span_padded):
+        span = jax.lax.slice_in_dim(flat, off, off + size_k)
+        if padded_k != size_k:
+            span = jnp.concatenate(
+                [span, jnp.zeros((padded_k - size_k,), span.dtype)])
+        padded_spans.append(span)
+        off += size_k
+    blocks = []
+    for r in range(dp):
+        for span, padded_k in zip(padded_spans, span_padded):
+            lk = padded_k // dp
+            blocks.append(jax.lax.slice_in_dim(span, r * lk, (r + 1) * lk))
+    return jnp.concatenate(blocks) if len(blocks) > 1 else blocks[0]
+
+
 def shard_flat_grads(flat_grads: jax.Array, state: FlatState, *,
                      mean: bool = True) -> jax.Array:
     """Reduce-scatter a FULL per-rank flat grad buffer into MY shard's
@@ -202,16 +340,22 @@ def shard_flat_grads(flat_grads: jax.Array, state: FlatState, *,
     ``psum_scatter`` over the shard axis, and (by default) divide by dp
     for data-parallel mean semantics.  Comm bytes equal the old
     all-reduce's reduce-scatter half; the all-gather half moves to the
-    params side (:meth:`FlatState.params` / the zero train step).
+    params side (:meth:`FlatState.params` / the zero train step).  A
+    prefetch-layout state permutes the grads rank-major per span first,
+    so the scatter lands each rank exactly its span-layout shard.
 
     No-op (beyond the mean) when ``state`` is dense or dp == 1 — so the
     same step code serves every topology."""
     if not state.shard or state.shard_dp == 1:
         return flat_grads
-    pad = state.padded_numel - state.global_numel
-    if pad:
-        flat_grads = jnp.concatenate(
-            [flat_grads, jnp.zeros((pad,), flat_grads.dtype)])
+    if state.spans:
+        flat_grads = _enspan(flat_grads, state.span_sizes,
+                             state.span_padded, state.shard_dp)
+    else:
+        pad = state.padded_numel - state.global_numel
+        if pad:
+            flat_grads = jnp.concatenate(
+                [flat_grads, jnp.zeros((pad,), flat_grads.dtype)])
     gshard = jax.lax.psum_scatter(
         flat_grads, state.shard_axis, scatter_dimension=0, tiled=True)
     return gshard / state.shard_dp if mean else gshard
@@ -222,7 +366,7 @@ def _shard_of(flat: jax.Array, shard_len: int, rank):
         flat, jnp.asarray(rank, jnp.int32) * shard_len, shard_len)
 
 
-def _init_state(tx, params, shard=None) -> FlatState:
+def _init_state(tx, params, shard=None, prefetch=None) -> FlatState:
     """Shared init: ravel a pytree (or accept an already-flat buffer)
     into a donation-safe fp32 master + the rule's zero slots.
 
@@ -230,7 +374,13 @@ def _init_state(tx, params, shard=None) -> FlatState:
     ``1/dp`` shard of the dp-padded master (and slots).  ``rank``
     defaults to ``lax.axis_index(axis_name)`` — the in-``shard_map``
     case; pass an explicit int to build one rank's shard eagerly
-    (checkpoint resharding, tests)."""
+    (checkpoint resharding, tests).
+
+    ``prefetch`` (with ``shard``) selects the layered-prefetch layout:
+    an int asks for that many gather spans (grouped along leaf
+    boundaries by :func:`prefetch_span_layout`); a tuple of per-span
+    leaf counts is used as-is.  ``None``/0/1 keep the contiguous block
+    layout."""
     if hasattr(params, "ndim") and params.ndim == 1:
         flat, unravel = params, None
         sizes = (int(flat.size),)
@@ -244,19 +394,17 @@ def _init_state(tx, params, shard=None) -> FlatState:
     # single fp32 leaf can alias the caller's param array.
     master = jnp.array(flat, dtype=jnp.float32, copy=True)
     shard_static: tuple = ()
+    spans: tuple = ()
     if shard is not None:
         axis_name, dp, *rank_opt = shard
         dp = int(dp)
         shard_static = (axis_name, dp)
-        n = int(master.shape[0])
-        padded = cdiv(n, dp) * dp
-        if padded != n:
-            master = jnp.concatenate(
-                [master, jnp.zeros((padded - n,), master.dtype)])
+        spans = _normalize_prefetch(prefetch, sizes)
+        master = _layout_master(master, sizes=sizes, spans=spans, dp=dp)
         if dp > 1:
             rank = rank_opt[0] if rank_opt \
                 else jax.lax.axis_index(axis_name)
-            master = _shard_of(master, padded // dp, rank)
+            master = _shard_of(master, int(master.shape[0]) // dp, rank)
     return FlatState(
         master=master,
         count=jnp.zeros((), jnp.float32),
@@ -264,7 +412,8 @@ def _init_state(tx, params, shard=None) -> FlatState:
         sizes=sizes,
         flat_dtype=flat_dtype,
         unravel=unravel,
-        shard=shard_static)
+        shard=shard_static,
+        spans=spans)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,8 +427,8 @@ class _AdamTx:
     adam_w_mode: bool = True
     bias_correction: bool = True
 
-    def init(self, params, shard=None) -> FlatState:
-        return _init_state(self, params, shard=shard)
+    def init(self, params, shard=None, prefetch=None) -> FlatState:
+        return _init_state(self, params, shard=shard, prefetch=prefetch)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"exp_avg": jnp.zeros_like(master),
@@ -329,8 +478,8 @@ class _LambTx:
     grad_averaging: bool = True
     use_nvlamb: bool = False
 
-    def init(self, params, shard=None) -> FlatState:
-        return _init_state(self, params, shard=shard)
+    def init(self, params, shard=None, prefetch=None) -> FlatState:
+        return _init_state(self, params, shard=shard, prefetch=prefetch)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"exp_avg": jnp.zeros_like(master),
@@ -379,7 +528,7 @@ class _LambTx:
             rank = jax.lax.axis_index(axis)
             sq = sharded_leaf_sq_norms(
                 (p, u), sizes, dp=dp, shard_len=state.shard_len,
-                rank=rank)
+                rank=rank, spans=state.spans)
             sq = jax.lax.psum(sq, axis)
             w_norm, u_norm = jnp.sqrt(sq[0]), jnp.sqrt(sq[1])
         else:
@@ -400,7 +549,7 @@ class _LambTx:
         if sharded:
             scale = sharded_leaf_broadcast(
                 ratio, sizes, dp=dp, shard_len=state.shard_len,
-                rank=rank)
+                rank=rank, spans=state.spans)
         else:
             scale = _broadcast_leaf_scalars(ratio, sizes)
         p_new = p - _f32(self.lr if lr is None else lr) * scale * u
@@ -426,8 +575,8 @@ class _SgdTx:
     nesterov: bool = False
     wd_after_momentum: bool = False
 
-    def init(self, params, shard=None) -> FlatState:
-        return _init_state(self, params, shard=shard)
+    def init(self, params, shard=None, prefetch=None) -> FlatState:
+        return _init_state(self, params, shard=shard, prefetch=prefetch)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"momentum_buffer": jnp.zeros_like(master),
@@ -471,8 +620,8 @@ class _NovoGradTx:
     grad_averaging: bool = True
     init_zero: bool = False
 
-    def init(self, params, shard=None) -> FlatState:
-        return _init_state(self, params, shard=shard)
+    def init(self, params, shard=None, prefetch=None) -> FlatState:
+        return _init_state(self, params, shard=shard, prefetch=prefetch)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"exp_avg": jnp.zeros_like(master),
@@ -500,7 +649,8 @@ class _NovoGradTx:
             gsq = jax.lax.psum(
                 sharded_leaf_sq_norms(
                     (g32,), sizes, dp=state.shard_dp,
-                    shard_len=state.shard_len, rank=rank)[0],
+                    shard_len=state.shard_len, rank=rank,
+                    spans=state.spans)[0],
                 state.shard_axis)
         else:
             gsq = jnp.stack([
@@ -515,7 +665,7 @@ class _NovoGradTx:
         if sharded:
             denom = sharded_leaf_broadcast(
                 denom_scalars, sizes, dp=state.shard_dp,
-                shard_len=state.shard_len, rank=rank)
+                shard_len=state.shard_len, rank=rank, spans=state.spans)
         else:
             denom = _broadcast_leaf_scalars(denom_scalars, sizes)
         ghat = g32 / denom + _f32(self.weight_decay if weight_decay is None
@@ -543,8 +693,8 @@ class _AdagradTx:
     weight_decay: float = 0.0
     w_mode: bool = False
 
-    def init(self, params, shard=None) -> FlatState:
-        return _init_state(self, params, shard=shard)
+    def init(self, params, shard=None, prefetch=None) -> FlatState:
+        return _init_state(self, params, shard=shard, prefetch=prefetch)
 
     def init_slots(self, master, *, sizes) -> dict:
         return {"sum": jnp.zeros_like(master)}

@@ -30,6 +30,7 @@ Typical use (the shape ``examples/bert/pretrain_bert.py`` runs)::
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Optional
 
 import flax.struct
@@ -43,10 +44,20 @@ from apex_tpu.amp.scaler import (
     unscale_flat_grads,
     update_scale,
 )
-from apex_tpu.optimizers.functional import FlatState
+from apex_tpu.optimizers.functional import (FlatState, _layout_master,
+                                            _normalize_prefetch)
 
 __all__ = ["TrainState", "init_train_state", "init_zero_train_state",
-           "make_train_step", "train_loop", "leaf_offsets"]
+           "make_train_step", "train_loop", "leaf_offsets",
+           "zero_prefetch_default"]
+
+
+def zero_prefetch_default() -> int:
+    """Effective ``APEX_TPU_ZERO_PREFETCH`` value: the number of
+    layered-prefetch gather spans a ZeRO state is built with when
+    ``prefetch`` is not passed explicitly.  0/1 keep the monolithic
+    gather (today's layout); stamped into ZeRO bench captures."""
+    return int(os.environ.get("APEX_TPU_ZERO_PREFETCH", "0"))
 
 
 @flax.struct.dataclass
@@ -61,7 +72,8 @@ class TrainState:
         return self.opt.params()
 
 
-def init_train_state(tx, params, loss_scale=None, shard=None) -> TrainState:
+def init_train_state(tx, params, loss_scale=None, shard=None,
+                     prefetch=None) -> TrainState:
     """Build a TrainState from a params pytree.
 
     ``loss_scale``: None (no amp scaling), "dynamic", or a fixed float —
@@ -71,13 +83,24 @@ def init_train_state(tx, params, loss_scale=None, shard=None) -> TrainState:
     state (see :class:`~apex_tpu.optimizers.functional.FlatState`);
     without an explicit rank this must run inside ``shard_map`` with the
     axis bound.  Pair with ``make_train_step(..., zero=True)``.
+
+    ``prefetch`` (with ``shard``) selects the layered-prefetch shard
+    layout: the flat master is split along leaf boundaries into this
+    many gather spans so the zero step's param all-gather decomposes
+    into independent per-span gathers XLA can overlap with the layers
+    consuming them.  ``None`` reads ``APEX_TPU_ZERO_PREFETCH``
+    (default 0 = monolithic gather); a tuple of per-span leaf counts is
+    used as-is.
     """
     scaler = None if loss_scale is None else init_loss_scale(loss_scale)
-    return TrainState(opt=tx.init(params, shard=shard), scaler=scaler)
+    if shard is not None and prefetch is None:
+        prefetch = zero_prefetch_default()
+    return TrainState(opt=tx.init(params, shard=shard, prefetch=prefetch),
+                      scaler=scaler)
 
 
 def init_zero_train_state(tx, params, axis_name: str, dp: int,
-                          loss_scale=None):
+                          loss_scale=None, prefetch=None):
     """GLOBAL-view ZeRO state + its PartitionSpec tree, for the
     init-outside / step-inside pattern.
 
@@ -88,7 +111,12 @@ def init_zero_train_state(tx, params, axis_name: str, dp: int,
     and each rank's inside view is exactly its local ``1/dp`` shard.
     The state that comes back OUT is again the global view:
     ``state.params()`` / checkpointing see the reassembled flat master
-    with no extra code."""
+    with no extra code.
+
+    ``prefetch`` selects the layered-prefetch layout (see
+    :func:`init_train_state`): the padded global buffers are laid out
+    rank-major per span, so the same ``P(axis_name)`` specs hand each
+    rank exactly its span-layout shard."""
     from jax.sharding import PartitionSpec as P
 
     # dense init first (it makes the donation-safe copy of the raveled
@@ -96,11 +124,15 @@ def init_zero_train_state(tx, params, axis_name: str, dp: int,
     # per-rank slicing, and the padding arithmetic lives in the
     # FlatState properties
     state = init_train_state(tx, params, loss_scale=loss_scale)
-    opt = state.opt.replace(shard=(axis_name, int(dp)))
-    padded, n = opt.padded_numel, opt.global_numel
-    if padded != n:
-        master = jnp.concatenate(
-            [opt.master, jnp.zeros((padded - n,), opt.master.dtype)])
+    if prefetch is None:
+        prefetch = zero_prefetch_default()
+    opt = state.opt.replace(
+        shard=(axis_name, int(dp)),
+        spans=_normalize_prefetch(prefetch, state.opt.sizes))
+    padded = opt.padded_numel
+    if opt.spans or padded != opt.global_numel:
+        master = _layout_master(opt.master, sizes=opt.sizes,
+                                spans=opt.spans, dp=opt.shard_dp)
         opt = opt.replace(
             master=master, slots=tx.init_slots(master, sizes=opt.sizes))
     state = state.replace(opt=opt)
@@ -151,7 +183,11 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
     the flag pmax'd replica-uniform, and the Pallas fused update touches
     only the local ``1/dp`` of master/slots.  Per-chip optimizer state,
     update FLOPs, and update HBM traffic all drop dp×; everything still
-    composes into ONE donated XLA program.  The reported loss — and
+    composes into ONE donated XLA program.  A state built with
+    ``prefetch`` spans (``init_train_state(..., prefetch=K)`` /
+    ``APEX_TPU_ZERO_PREFETCH``) decomposes that gather into independent
+    per-span all-gathers so comm overlaps the consuming layers' compute
+    — same bytes, same ONE executable.  The reported loss — and
     every float leaf of ``aux`` — is ``pmean``'d over the axis (the
     global-batch metric); integer/bool aux diagnostics stay rank-local.
 
@@ -174,12 +210,36 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
         def flat_loss(flat):
             full = flat.astype(opt.flat_dtype)
             if zero and dp > 1:
-                # params all-gather in the CONSTRUCTION dtype (bf16
-                # comm for bf16 models); the [:n] unpad's transpose is
-                # a zero-pad of the flat cotangent
-                full = jax.lax.all_gather(full, axis, axis=0, tiled=True)
-                if padded != n:
-                    full = full[:n]
+                if opt.spans:
+                    # layered prefetch: one INDEPENDENT all_gather per
+                    # leaf span.  Each gather feeds only its own
+                    # leaves' unravel slices (the slice-of-concat
+                    # simplifies away), so XLA's scheduler issues span
+                    # k+1's gather while span k's layers compute —
+                    # machine-verified by APX217.  The transpose of
+                    # each gather is the matching per-span psum_scatter
+                    # of the flat bf16 grads; total comm bytes are the
+                    # monolithic gather's (modulo per-span padding).
+                    parts, off = [], 0
+                    for size_k, padded_k in zip(opt.span_sizes,
+                                                opt.span_padded):
+                        lk = padded_k // dp
+                        g = jax.lax.all_gather(
+                            jax.lax.slice_in_dim(full, off, off + lk),
+                            axis, axis=0, tiled=True)
+                        parts.append(g[:size_k] if padded_k != size_k
+                                     else g)
+                        off += lk
+                    full = (jnp.concatenate(parts) if len(parts) > 1
+                            else parts[0])
+                else:
+                    # params all-gather in the CONSTRUCTION dtype (bf16
+                    # comm for bf16 models); the [:n] unpad's transpose
+                    # is a zero-pad of the flat cotangent
+                    full = jax.lax.all_gather(full, axis, axis=0,
+                                              tiled=True)
+                    if padded != n:
+                        full = full[:n]
             params = opt.unravel(full)
             out = loss_fn(params, batch)
             loss, aux = out if has_aux else (out, None)

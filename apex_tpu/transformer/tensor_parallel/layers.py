@@ -98,34 +98,102 @@ def _linear_wgrad_fp32_fwd(x, weight):
     return _linear_wgrad_fp32(x, weight), (x, weight)
 
 
+def _matmul_linear_bwd(x, w, dy, *, fp32_wgrad):
+    """Backward of ``x @ W^T``: ``dx = dy @ W`` plus the wgrad
+    contraction — fp32-accumulated straight from the MXU when
+    ``fp32_wgrad`` (the ``_linear_wgrad_fp32`` regime).  The single copy
+    of the fused-wgrad discipline; the fused custom_vjp and the
+    ``overlap_chunks`` ring vjp (:func:`_ring_row_matmul`) both call
+    it, so the two paths cannot drift."""
+    dx = jnp.matmul(dy, w.astype(dy.dtype) if fp32_wgrad else w)
+    bdims = tuple(range(x.ndim - 1))
+    if fp32_wgrad:
+        dw = jax.lax.dot_general(dy, x, ((bdims, bdims), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    else:
+        dw = jax.lax.dot_general(dy, x, ((bdims, bdims), ((), ())))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
 def _linear_wgrad_fp32_bwd(res, dy):
     x, weight = res
-    dx = jnp.matmul(dy, weight.astype(dy.dtype))
-    bdims = tuple(range(x.ndim - 1))
-    dw = jax.lax.dot_general(dy, x, ((bdims, bdims), ((), ())),
-                             preferred_element_type=jnp.float32)
-    return dx, dw.astype(weight.dtype)
+    return _matmul_linear_bwd(x, weight, dy, fp32_wgrad=True)
 
 
 _linear_wgrad_fp32.defvjp(_linear_wgrad_fp32_fwd, _linear_wgrad_fp32_bwd)
 
 
-def _maybe_fused_matmul(x, weight, fused: bool):
-    """Shared GEMM dispatch for Column/Row parallel linears.
+@functools.lru_cache(maxsize=None)
+def _ring_row_matmul(axis_name: str, chunks: int, fused: bool):
+    """``psum(x @ W^T)`` as a ``chunks``-chunk matmul/``ppermute``
+    reduce-scatter ring + all-gather — RowParallelLinear's fused
+    computation-collective pipeline (``overlap_chunks``).
 
-    With ``fused`` the weight MUST be fp32 (the master/main-grad regime):
-    a custom_vjp cotangent must match the primal dtype, so a 16-bit
-    weight would silently round the fp32-accumulated wgrad right back to
-    bf16 — the reference likewise hard-requires an fp32 ``main_grad``
-    buffer on the param.  Fail loud instead.
-    """
+    Each ring step computes ONE token-chunk's partial GEMM and adds it
+    to the accumulator arriving from the previous rank, so every
+    ``ppermute`` hop travels under the NEXT chunk's matmul instead of a
+    monolithic psum blocking after the full GEMM; per-chip bytes equal
+    the fused psum's ring all-reduce exactly ((n-1) hops of B/n + the
+    (n-1)/n·B all-gather).  Verified overlappable by APX217, byte-equal
+    by the APX215 ledger.
+
+    The backward is the fused path's exact backward (``dy`` is
+    replicated because psum's transpose is identity): ``dx = dy @ W``
+    and the wgrad contraction — fp32-accumulated straight from the MXU
+    when ``fused`` (the ``_linear_wgrad_fp32`` regime) — so gradients
+    match the unchunked layer bitwise.
+
+    A factory (cached per static config) because the ring structure
+    must live in a ``custom_vjp`` closure."""
+
+    def ring(x, w):
+        n = jax.lax.axis_size(axis_name)
+        m, gsz, csz = mappings._ring_geometry(
+            axis_name, n, chunks, x.shape[0], "overlap_chunks")
+        wl = w.astype(x.dtype) if fused else w
+
+        def piece(g, c):
+            xs = jax.lax.dynamic_slice_in_dim(
+                x, g * gsz + c * csz, csz, axis=0)
+            return jnp.matmul(xs, wl.T)
+
+        return mappings._ring_reduce(piece, axis_name=axis_name, n=n,
+                                     m=m)
+
+    @jax.custom_vjp
+    def f(x, w):
+        return ring(x, w)
+
+    def fwd(x, w):
+        return ring(x, w), (x, w)
+
+    def bwd(res, dy):
+        x, w = res
+        return _matmul_linear_bwd(x, w, dy, fp32_wgrad=fused)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _require_fp32_master(weight) -> None:
+    """Guard for every ``gradient_accumulation_fusion`` entry point: the
+    weight MUST be fp32 (the master/main-grad regime).  A custom_vjp
+    cotangent must match the primal dtype, so a 16-bit weight would
+    silently round the fp32-accumulated wgrad right back to bf16 — the
+    reference likewise hard-requires an fp32 ``main_grad`` buffer on the
+    param.  Fail loud instead."""
+    if weight.dtype != jnp.float32:
+        raise ValueError(
+            "gradient_accumulation_fusion requires fp32 (master) "
+            f"weights, got {weight.dtype}; the reference's "
+            "wgrad_gemm_accum_fp32 equally requires param.main_grad "
+            "to be fp32")
+
+
+def _maybe_fused_matmul(x, weight, fused: bool):
+    """Shared GEMM dispatch for Column/Row parallel linears."""
     if fused:
-        if weight.dtype != jnp.float32:
-            raise ValueError(
-                "gradient_accumulation_fusion requires fp32 (master) "
-                f"weights, got {weight.dtype}; the reference's "
-                "wgrad_gemm_accum_fp32 equally requires param.main_grad "
-                "to be fp32")
+        _require_fp32_master(weight)
         return _linear_wgrad_fp32(x, weight)
     return jnp.matmul(x, weight.T)
 
@@ -134,7 +202,8 @@ def linear_with_grad_accumulation_and_async_allreduce(
         input, weight, bias=None, gradient_accumulation_fusion: bool = False,
         async_grad_allreduce: bool = True,
         sequence_parallel_enabled: bool = False,
-        axis_name: str = TENSOR_AXIS):
+        axis_name: str = TENSOR_AXIS,
+        overlap_chunks: Optional[int] = None):
     """Functional core of ColumnParallelLinear (reference:
     ``LinearWithGradAccumulationAndAsyncCommunication.apply``).
 
@@ -144,13 +213,18 @@ def linear_with_grad_accumulation_and_async_allreduce(
     back — both directions expressed by ``gather_from_sequence_parallel_
     region``'s custom VJP.  Otherwise ``copy_to...`` makes the backward
     psum explicit.  XLA overlaps that collective with the wgrad dot (the
-    reference's hand-built async overlap).
+    reference's hand-built async overlap); ``overlap_chunks > 1``
+    additionally decomposes that backward psum into the
+    :func:`~apex_tpu.transformer.tensor_parallel.mappings.ring_psum`
+    chunk pipeline (``None`` reads ``APEX_TPU_TP_OVERLAP_CHUNKS``).
     """
+    chunks = mappings.tp_overlap_chunks(overlap_chunks)
     if sequence_parallel_enabled:
         x = mappings.gather_from_sequence_parallel_region(
             input, axis_name, tensor_parallel_output_grad=True)
     elif async_grad_allreduce:
-        x = mappings.copy_to_tensor_model_parallel_region(input, axis_name)
+        x = mappings.copy_to_tensor_model_parallel_region(
+            input, axis_name, chunks=chunks)
     else:
         x = input
     out = _maybe_fused_matmul(x, weight, gradient_accumulation_fusion)
@@ -178,6 +252,10 @@ class ColumnParallelLinear(nn.Module):
     gradient_accumulation_fusion: bool = False
     sequence_parallel_enabled: bool = False
     axis_name: str = TENSOR_AXIS
+    # backward grad-input psum decomposed into a ring-chunk pipeline
+    # (comm/compute overlap); None -> APEX_TPU_TP_OVERLAP_CHUNKS, 1 =
+    # fused psum
+    overlap_chunks: Optional[int] = None
 
     @nn.compact
     def __call__(self, input_):
@@ -195,7 +273,8 @@ class ColumnParallelLinear(nn.Module):
             gradient_accumulation_fusion=self.gradient_accumulation_fusion,
             async_grad_allreduce=not self.no_async_tensor_model_parallel_allreduce,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
-            axis_name=self.axis_name)
+            axis_name=self.axis_name,
+            overlap_chunks=self.overlap_chunks)
         if self.gather_output:
             assert not self.sequence_parallel_enabled, \
                 "gather_output incompatible with sequence_parallel " \
@@ -226,6 +305,10 @@ class RowParallelLinear(nn.Module):
     gradient_accumulation_fusion: bool = False
     sequence_parallel_enabled: bool = False
     axis_name: str = TENSOR_AXIS
+    # matmul+psum decomposed into an N-chunk matmul/ppermute ring
+    # pipeline (comm under the next chunk's GEMM); None ->
+    # APEX_TPU_TP_OVERLAP_CHUNKS, 1 = fused matmul-then-psum
+    overlap_chunks: Optional[int] = None
 
     @nn.compact
     def __call__(self, input_):
@@ -244,12 +327,25 @@ class RowParallelLinear(nn.Module):
                 "sequence_parallel requires input_is_parallel"
             input_parallel = mappings.scatter_to_tensor_model_parallel_region(
                 input_, self.axis_name)
-        output_parallel = _maybe_fused_matmul(
-            input_parallel, weight, self.gradient_accumulation_fusion)
-        if self.sequence_parallel_enabled:
+        chunks = mappings.tp_overlap_chunks(self.overlap_chunks)
+        if chunks > 1 and not self.sequence_parallel_enabled and world > 1:
+            # fused computation-collective pipeline: chunk matmuls ride
+            # the reduce-scatter ring, the psum disappears as a
+            # standalone op (SP keeps its reduce_scatter exit, which is
+            # already half the ring)
+            if self.gradient_accumulation_fusion:
+                _require_fp32_master(weight)
+            output = _ring_row_matmul(
+                self.axis_name, chunks,
+                self.gradient_accumulation_fusion)(input_parallel, weight)
+        elif self.sequence_parallel_enabled:
+            output_parallel = _maybe_fused_matmul(
+                input_parallel, weight, self.gradient_accumulation_fusion)
             output = mappings.reduce_scatter_to_sequence_parallel_region(
                 output_parallel, self.axis_name)
         else:
+            output_parallel = _maybe_fused_matmul(
+                input_parallel, weight, self.gradient_accumulation_fusion)
             output = mappings.reduce_from_tensor_model_parallel_region(
                 output_parallel, self.axis_name)
         if not self.skip_bias_add:

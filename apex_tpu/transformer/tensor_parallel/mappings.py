@@ -24,6 +24,8 @@ is the identity (matching the reference's world_size==1 early-returns).
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -38,7 +40,102 @@ __all__ = [
     "scatter_to_sequence_parallel_region",
     "gather_from_sequence_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "ring_psum",
+    "tp_overlap_chunks",
 ]
+
+
+def tp_overlap_chunks(value=None) -> int:
+    """Effective TP overlap-chunk count: an explicit per-layer value
+    wins; ``None`` reads ``APEX_TPU_TP_OVERLAP_CHUNKS`` (default 1 =
+    the fused single-psum path).  Stamped into TP bench captures."""
+    if value is not None:
+        return int(value)
+    return int(os.environ.get("APEX_TPU_TP_OVERLAP_CHUNKS", "1"))
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _ring_reduce(piece, *, axis_name: str, n: int, m: int):
+    """The reduce-scatter ``ppermute`` ring + all-gather schedule shared
+    by :func:`ring_psum` and ``layers._ring_row_matmul``: within each of
+    the ``m`` chunk groups, chunk ``c`` starts on rank ``c+1`` and lands
+    fully reduced on rank ``c`` after ``n-1`` hops, then the all-gather
+    reassembles the groups in rank order.  ``piece(g, c)`` produces THIS
+    rank's partial for chunk ``c`` of group ``g`` — a static slice for
+    the plain ring psum, or the chunk GEMM for the row-parallel matmul
+    pipeline (which is what lets each hop travel under the next chunk's
+    compute).  Keeping the schedule in one place keeps its invariants
+    (hop direction, landing rank, rank-order reassembly) in one place."""
+    r = jax.lax.axis_index(axis_name)
+    outs = []
+    for g in range(m):
+        idx = (r + n - 1) % n
+        acc = piece(g, idx)
+        for _ in range(n - 1):
+            acc = jax.lax.ppermute(acc, axis_name, perm=_ring_perm(n))
+            idx = (idx + n - 1) % n
+            acc = acc + piece(g, idx)
+        outs.append(jax.lax.all_gather(acc, axis_name, axis=0,
+                                       tiled=True))
+    return jnp.concatenate(outs) if m > 1 else outs[0]
+
+
+def _ring_geometry(axis_name: str, n: int, chunks: int, lead: int,
+                   knob: str):
+    """Validate + derive the chunk schedule shared by :func:`ring_psum`
+    and ``layers._ring_row_matmul``: ``chunks`` must be a multiple of
+    the axis size and divide the leading (token/sequence) dim.  Returns
+    ``(m, gsz, csz)`` — chunk groups, elements per group, elements per
+    chunk — the one place the divisibility contract lives."""
+    if chunks % n:
+        raise ValueError(
+            f"{knob}={chunks} must be a multiple of the "
+            f"{axis_name!r} axis size {n}")
+    if lead % chunks:
+        raise ValueError(
+            f"{knob}={chunks} does not divide the leading "
+            f"(token/sequence) dim {lead}")
+    m = chunks // n
+    gsz = lead // m
+    return m, gsz, gsz // n
+
+
+def ring_psum(x, axis_name: str = TENSOR_AXIS, chunks: int = 0):
+    """``psum(x, axis)`` decomposed into a ``chunks``-chunk
+    reduce-scatter ``ppermute`` ring + all-gather along dim 0.
+
+    Per-chip bytes are IDENTICAL to the fused psum's ring all-reduce —
+    ``(n-1)`` one-hop permutes of ``B/chunks`` plus an all-gather
+    contributing ``(n-1)/n·B`` — but the payload moves in ``chunks``
+    independent pieces with the partial-sum adds between hops, so XLA's
+    scheduler can hide each hop under compute instead of serializing
+    one monolithic all-reduce on the critical path (APX217 verifies the
+    interleaving on the lowered executable).  Reduction order is the
+    fixed ring order (ranks ``c+1..c+n-1, c`` for chunk ``c``), which
+    is bitwise-commutative at n == 2 and within a few ulps of the fused
+    psum beyond.
+
+    ``chunks`` must be a multiple of the axis size and divide
+    ``x.shape[0]``; ``chunks <= 1`` (or axis size 1) falls back to the
+    fused psum.  Like the ``*_region`` wrappers, this deliberately does
+    NOT read ``APEX_TPU_TP_OVERLAP_CHUNKS`` — the env knob is resolved
+    once at layer construction (:func:`tp_overlap_chunks`), so a mapped
+    function's collectives can't flip shape with the environment."""
+    n = jax.lax.axis_size(axis_name)
+    chunks = int(chunks)
+    if chunks <= 1 or n == 1:
+        return jax.lax.psum(x, axis_name)
+    m, gsz, csz = _ring_geometry(axis_name, n, chunks, x.shape[0],
+                                 "ring_psum chunks")
+
+    def piece(g, c):
+        return jax.lax.dynamic_slice_in_dim(
+            x, g * gsz + c * csz, csz, axis=0)
+
+    return _ring_reduce(piece, axis_name=axis_name, n=n, m=m)
 
 
 def _is_identity(axis_name: str, *, vma_safe: bool = False) -> bool:
@@ -84,10 +181,17 @@ def _reduce_scatter(x, axis_name: str, dim: int):
 
 # --- copy / reduce ----------------------------------------------------------
 
-def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS,
+                                         chunks: int = 1):
     """Identity forward / psum backward (``_CopyToModelParallelRegion``).
     Entry point of ColumnParallelLinear: the activation is replicated across
-    TP, so its grad is the sum of per-rank grads."""
+    TP, so its grad is the sum of per-rank grads.
+
+    ``chunks > 1`` replaces the backward's fused psum with the
+    :func:`ring_psum` matmul/collective pipeline (the column-parallel
+    backward half of the chunked TP overlap): the grad-input all-reduce
+    moves in chunks XLA can hide under the wgrad GEMM instead of one
+    blocking collective — same ring bytes."""
     if _is_identity(axis_name, vma_safe=True):
         return x
 
@@ -95,22 +199,42 @@ def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
     def f(x):
         return x
 
-    f.defvjp(lambda x: (x, None),
-             lambda _, g: (jax.lax.psum(g, axis_name),))
+    if chunks > 1:
+        bwd = lambda _, g: (ring_psum(g, axis_name, chunks),)
+    else:
+        bwd = lambda _, g: (jax.lax.psum(g, axis_name),)
+    f.defvjp(lambda x: (x, None), bwd)
     return f(x)
 
 
-def reduce_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+def reduce_from_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS,
+                                             chunks: int = 1):
     """psum forward / identity backward (``_ReduceFromModelParallelRegion``).
-    Exit point of RowParallelLinear: partial products are summed."""
+    Exit point of RowParallelLinear: partial products are summed.
+
+    ``chunks > 1`` swaps the fused psum for the :func:`ring_psum`
+    pipeline (same bytes, overlappable); RowParallelLinear's own
+    ``overlap_chunks`` goes further and interleaves the chunk MATMULS
+    with the ring hops (see ``layers._ring_row_matmul``).  Deliberately
+    an explicit per-call opt-in that does NOT read
+    ``APEX_TPU_TP_OVERLAP_CHUNKS``: the env knob is resolved by the
+    layers (which route overlap through their own pipelines and reach
+    here only on the fused path), and non-matmul callers like the MoE
+    dispatch have leading dims the ring's divisibility contract cannot
+    assume."""
     if _is_identity(axis_name):
         return x
 
-    @jax.custom_vjp
-    def f(x):
+    def impl(x):
+        if chunks > 1:
+            return ring_psum(x, axis_name, chunks)
         return jax.lax.psum(x, axis_name)
 
-    f.defvjp(lambda x: (jax.lax.psum(x, axis_name), None),
+    @jax.custom_vjp
+    def f(x):
+        return impl(x)
+
+    f.defvjp(lambda x: (impl(x), None),
              lambda _, g: (g,))
     return f(x)
 

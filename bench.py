@@ -398,7 +398,25 @@ def _bench_setup(force_cpu: bool):
     return on_tpu, rtt
 
 
-def _zero_train_setup(loss_fn, tx, params, batch_specs):
+def _stamp_step_time_model(extras: dict, jaxpr_thunk, mesh_axes) -> None:
+    """Stamp ``comm_model.step_time_estimate``'s overlap-aware fields
+    (``overlap_step_time_model_us`` / ``sequential_step_time_model_us``
+    / ``exposed_comm_model_us``) into a capture dict — the modeled half
+    of the overlap A/B, shared by the zero and tp legs so their fields
+    stay comparable.  Auxiliary: failures (tracing included, hence the
+    thunk) print and skip the stamp."""
+    try:
+        from apex_tpu.analysis.comm_model import step_time_estimate
+        est = step_time_estimate(jaxpr_thunk(), mesh_axes,
+                                 tflops=_chip_spec()[0])
+        extras["overlap_step_time_model_us"] = est["overlap_us"]
+        extras["sequential_step_time_model_us"] = est["sequential_us"]
+        extras["exposed_comm_model_us"] = est["exposed_comm_us"]
+    except Exception:  # noqa: BLE001 — the model stamp is auxiliary
+        traceback.print_exc()
+
+
+def _zero_train_setup(loss_fn, tx, params, batch_specs, batch):
     """Shared ``--override zero=1`` machinery for the main/bert/llama
     legs: a ZeRO dp-sharded train step over a ``data`` mesh of the
     local devices (``--override zero_dp=N`` narrows it; the single-chip
@@ -406,11 +424,21 @@ def _zero_train_setup(loss_fn, tx, params, batch_specs):
     become no-ops — so multi-chip tunnel sessions can flip dp without
     a code edit).
 
-    Returns ``(state, step_fn, shard, dp)`` with ``shard`` shaped for
-    :func:`_bench_loop` and ``dp`` for the capture extras.  The batch
-    stays REPLICATED (``batch_specs`` of P()): per-chip compute matches
-    the non-zero leg, so the delta is exactly the collective +
+    ``--override overlap=1`` builds the state with the layered-prefetch
+    gather layout (``--override prefetch=N`` spans, default 8; 0 =
+    monolithic) so the A/B between the serialized and overlapped zero
+    step is one flag flip; the effective span count and the
+    comm_model's overlap-aware step-time estimate ride the capture
+    extras (``zero_prefetch``, ``overlap_step_time_model_us``) so the
+    APX215 ledger re-pin and the modeled win land in the same capture.
+
+    Returns ``(state, step_fn, shard, dp, extras)`` with ``shard``
+    shaped for :func:`_bench_loop` and ``extras`` for the capture.  The
+    batch stays REPLICATED (``batch_specs`` of P()): per-chip compute
+    matches the non-zero leg, so the delta is exactly the collective +
     sharded-update cost."""
+    import functools as _ft
+
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -419,11 +447,23 @@ def _zero_train_setup(loss_fn, tx, params, batch_specs):
     devs = jax.devices()
     dp = int(_ov("zero_dp", len(devs)))
     dp = max(1, min(dp, len(devs)))
+    prefetch = int(_ov("prefetch", 8)) if _ov("overlap", 0) else \
+        int(_ov("prefetch", 0))
     mesh = Mesh(np.array(devs[:dp]), ("data",))
-    state, specs = ts.init_zero_train_state(tx, params, "data", dp)
+    state, specs = ts.init_zero_train_state(tx, params, "data", dp,
+                                            prefetch=prefetch)
     step = ts.make_train_step(loss_fn, tx, zero=True)
+    extras = {"zero_dp": dp,
+              "zero_prefetch": len(state.opt.spans) or prefetch}
+    _stamp_step_time_model(
+        extras,
+        lambda: jax.make_jaxpr(_ft.partial(jax.shard_map,
+                                           check_vma=False)(
+            step, mesh=mesh, in_specs=(specs, batch_specs),
+            out_specs=(specs, P())))(state, batch),
+        {"data": dp})
     # TrainState without a scaler: specs tree matches (scaler=None)
-    return state, step, (mesh, specs, batch_specs), dp
+    return state, step, (mesh, specs, batch_specs), dp, extras
 
 
 def _microbench_moe(rtt: float, on_tpu: bool):
@@ -595,8 +635,9 @@ def _microbench_bert(rtt: float, on_tpu: bool):
                                   lm_labels=batch_args[2])
             return loss
 
-        state, zstep, zero_shard, zero_dp = _zero_train_setup(
-            tree_loss, tx, params, (P(), P(), P()))
+        state, zstep, zero_shard, zero_dp, zero_extras = _zero_train_setup(
+            tree_loss, tx, params, (P(), P(), P()),
+            (tokens, types, labels))
         step = lambda s, b: zstep(s, b)[0]              # noqa: E731
     t = _bench_loop(step, state, (tokens, types, labels), iters, rtt,
                     shard=zero_shard)
@@ -614,6 +655,7 @@ def _microbench_bert(rtt: float, on_tpu: bool):
            "bert_shape": [batch, seq, cfg.num_layers, cfg.hidden_size]}
     if zero_dp is not None:
         out["bert_zero_dp"] = zero_dp
+        out.update({k: v for k, v in zero_extras.items() if k != "zero_dp"})
     return out
 
 
@@ -670,9 +712,9 @@ def _microbench_llama(rtt: float, on_tpu: bool):
     if _ov("zero", 0):
         from jax.sharding import PartitionSpec as P
 
-        state, zstep, zero_shard, zero_dp = _zero_train_setup(
+        state, zstep, zero_shard, zero_dp, zero_extras = _zero_train_setup(
             lambda tree, b: model.apply(tree, b[0], b[1]), tx, params,
-            (P(), P()))
+            (P(), P()), (tokens, labels))
         step = lambda s, b: zstep(s, b)[0]              # noqa: E731
     t = _bench_loop(step, state, (tokens, labels), iters, rtt,
                     shard=zero_shard)
@@ -689,6 +731,7 @@ def _microbench_llama(rtt: float, on_tpu: bool):
                            cfg.kv_heads]}
     if zero_dp is not None:
         out["llama_zero_dp"] = zero_dp
+        out.update({k: v for k, v in zero_extras.items() if k != "zero_dp"})
     return out
 
 
@@ -846,6 +889,99 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     return out
 
 
+def _microbench_tp(rtt: float, on_tpu: bool):
+    """Tensor-parallel column->row fwd+bwd over a tp=2 mesh, fused
+    psums vs the chunked matmul/ppermute ring pipelines (``--override
+    overlap=1 [overlap_chunks=N]``) — the TP half of the ISSUE 7
+    comm/compute-overlap A/B.  Reports measured step time for BOTH
+    modes plus the comm_model's overlap-aware estimates, so one capture
+    carries the measured and the modeled win side by side.
+
+    Needs >= 2 local devices (the CPU dryrun forces host devices;
+    single-chip TPU tunnel sessions degrade to a skip stub)."""
+    import functools as _ft
+
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.transformer import parallel_state, tensor_parallel
+
+    if len(jax.devices()) < 2:
+        return {"tp_skipped": "needs >=2 devices for a tensor axis "
+                              "(single-chip backend)"}
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size_=2)
+    mesh = parallel_state.get_mesh()
+    tokens, hidden, ffn = ((_ov("batch", 4) * _ov("seq", 1024), 1024,
+                            4096) if on_tpu else (64, 32, 64))
+    chunks = int(_ov("overlap_chunks", 4)) if _ov("overlap", 0) else 1
+    iters = 20 if on_tpu else 2
+
+    axis = parallel_state.TENSOR_AXIS
+    # weight specs: column shards out-features (dim 0 of [out_pp, in]),
+    # row shards in-features (dim 1 of [out, in_pp])
+    wc_spec, wr_spec = P(axis, None), P(None, axis)
+
+    def make_layers(ch):
+        col = tensor_parallel.ColumnParallelLinear(
+            hidden, ffn, gather_output=False, bias=False,
+            overlap_chunks=ch)
+        row = tensor_parallel.RowParallelLinear(
+            ffn, hidden, input_is_parallel=True, bias=False,
+            overlap_chunks=ch)
+        return col, row
+
+    def init_weights():
+        # one-time param init OUTSIDE the timed step: the threefry
+        # draws (and the shape-probe forward the old body paid every
+        # iteration) must pollute neither the measured times nor the
+        # jaxpr the step-time model prices
+        col, row = make_layers(1)
+        pc = col.init(jax.random.key(0),
+                      jnp.zeros((tokens, hidden), jnp.float32))
+        pr = row.init(jax.random.key(1),
+                      jnp.zeros((tokens, ffn // 2), jnp.float32))
+        return pc["params"]["weight"], pr["params"]["weight"]
+
+    wc, wr = jax.jit(_ft.partial(jax.shard_map, check_vma=False)(
+        init_weights, mesh=mesh, in_specs=(),
+        out_specs=(wc_spec, wr_spec)))()
+
+    def build(ch):
+        col, row = make_layers(ch)
+
+        def body(x, wc, wr):
+            def loss(x):
+                h, _ = col.apply({"params": {"weight": wc}}, x)
+                y, _ = row.apply({"params": {"weight": wr}}, h)
+                return jnp.mean(y.astype(jnp.float32) ** 2)
+
+            return jax.grad(loss)(x)
+
+        return _ft.partial(jax.shard_map, check_vma=False)(
+            body, mesh=mesh, in_specs=(P(), wc_spec, wr_spec),
+            out_specs=P())
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (tokens, hidden),
+                          jnp.float32)
+    t_ring = _bench_fn(build(chunks), (x, wc, wr), iters, rtt)
+    # the fused A-leg only when the B-leg actually differs (chunks=1 IS
+    # the fused path — re-timing it would stamp a fake A/B)
+    t_fused = _aux(lambda: _bench_fn(build(1), (x, wc, wr), iters, rtt),
+                   "tp-fused-baseline") if chunks > 1 else None
+    out = {"tp_row_col_us": round(t_ring.best * 1e6, 1),
+           "tp_row_col_us_median": round(t_ring.median * 1e6, 1),
+           "tp_overlap_chunks": chunks,
+           "tp_shape": [tokens, hidden, ffn]}
+    if t_fused is not None:
+        out["tp_fused_us"] = round(t_fused.best * 1e6, 1)
+    _stamp_step_time_model(out,
+                           lambda: jax.make_jaxpr(build(chunks))(x, wc, wr),
+                           dict(mesh.shape))
+    return out
+
+
 MICRO_LEGS = {
     "adam": _microbench_adam,
     "ln": _microbench_layernorm,
@@ -855,6 +991,7 @@ MICRO_LEGS = {
     "bert": _microbench_bert,
     "llama": _microbench_llama,
     "infer": _microbench_infer,
+    "tp": _microbench_tp,
 }
 
 
@@ -972,8 +1109,9 @@ def _bench_main(force_cpu: bool = False) -> None:
         def tree_loss(tree, batch):
             return model.apply(tree, batch[0], batch[1])
 
-        fused_state, zstep, zero_shard, zero_dp = _zero_train_setup(
-            tree_loss, tx, params, (P(), P()))
+        fused_state, zstep, zero_shard, zero_dp, zero_extras = \
+            _zero_train_setup(tree_loss, tx, params, (P(), P()),
+                              batch_args)
         fused_step = lambda s, b: zstep(s, b)[0]        # noqa: E731
 
     # Fused leg is THE metric: hard-fail (after retries) if it can't run.
@@ -1003,7 +1141,7 @@ def _bench_main(force_cpu: bool = False) -> None:
         "backend": "tpu" if on_tpu else "cpu",
     }
     if zero_dp is not None:
-        extras["zero_dp"] = zero_dp
+        extras.update(zero_extras)
     if _OVERRIDES:
         extras["overrides"] = dict(_OVERRIDES)   # capture self-describes
     print(json.dumps({
@@ -1097,7 +1235,7 @@ def _run_leg(mode: str, leg: str, timeout: float, key=None):
 # tunnel; each micro leg pays 1-2 smaller ones
 LEG_TIMEOUTS = [("main", 1500), ("bert", 1200), ("llama", 1200),
                 ("adam", 700), ("ln", 600), ("attn", 700), ("xent", 600),
-                ("moe", 900), ("infer", 900)]
+                ("moe", 900), ("infer", 900), ("tp", 600)]
 
 
 def _run_all_legs(mode: str, errors: list):
@@ -1196,7 +1334,8 @@ def _summarize_capture(name, payload):
               "bert_mfu", "bert_tokens_per_s",
               "llama_mfu", "llama_tokens_per_s",
               "infer_prefill_tokens_per_s", "infer_decode_tokens_per_s",
-              "infer_decode_token_us"):
+              "infer_decode_token_us", "tp_row_col_us",
+              "overlap_step_time_model_us"):
         # falsy values are broken measurements (e.g. the pre-fix
         # flash_attn_us 0.0 RTT-collapse artifact) — don't republish
         if extras.get(k):
@@ -1323,6 +1462,14 @@ if __name__ == "__main__":
         mode = sys.argv[sys.argv.index("--inner") + 1]
         leg = (sys.argv[sys.argv.index("--leg") + 1]
                if "--leg" in sys.argv else "main")
+        if leg == "tp" and mode == "cpu" and \
+                "--xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            # the TP leg needs a 2-device mesh; on the CPU dryrun force
+            # host devices BEFORE the backend initializes
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                       + " --xla_force_host_platform_"
+                                         "device_count=8").strip()
         if leg == "main":
             _bench_main(force_cpu=(mode == "cpu"))
         else:

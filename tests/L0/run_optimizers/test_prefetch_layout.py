@@ -1,0 +1,251 @@
+"""ZeRO layered-prefetch shard layout (``FlatState.spans``): the
+span-wise split of the flat master along leaf boundaries that lets the
+zero step's param gather decompose into independent per-span
+all-gathers (ISSUE 7 comm/compute overlap).
+
+Covers the pure layout algebra (span grouping, enspan/despan
+round-trip, per-rank leaf windows) and the sharded-state semantics
+(init slicing, ``params()`` reassembly, ``shard_flat_grads``, the
+LAMB/NovoGrad per-leaf machinery over interior padding gaps) — the
+step-level on/off parity lives in ``tests/L1/test_overlap.py``.
+"""
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from apex_tpu.optimizers import functional
+from apex_tpu.optimizers.base import (prefetch_leaf_spans,
+                                      sharded_leaf_broadcast,
+                                      sharded_leaf_sq_norms)
+from apex_tpu.optimizers.functional import (_enspan, prefetch_span_layout)
+from apex_tpu.utils import tree_ravel
+
+shard_map = functools.partial(jax.shard_map, check_vma=False)
+
+
+def _params(seed=0):
+    """Deliberately odd leaf sizes: every dp pads, some spans pad
+    interior (the layout's hard case)."""
+    rng = np.random.RandomState(seed)
+    return {
+        "w0": jnp.asarray(rng.randn(13, 15) * 0.4, jnp.float32),
+        "b0": jnp.asarray(rng.randn(15) * 0.01, jnp.float32),
+        "w1": jnp.asarray(rng.randn(15, 11) * 0.4, jnp.float32),
+        "b1": jnp.asarray(rng.randn(11) * 0.01, jnp.float32),
+        "head": jnp.asarray(rng.randn(3), jnp.float32),
+    }
+
+
+def test_prefetch_span_layout_groups_leaves():
+    sizes = (64, 8) * 8                  # 8 homogeneous layers
+    spans = prefetch_span_layout(sizes, 8)
+    assert sum(spans) == len(sizes)
+    assert spans == (2,) * 8             # one (w, b) pair per span
+    # k > leaves clamps; k <= 1 stays one span
+    assert sum(prefetch_span_layout(sizes, 99)) == len(sizes)
+    assert prefetch_span_layout(sizes, 1) == (len(sizes),)
+
+
+def test_enspan_despan_roundtrip_all_dp():
+    params = _params()
+    flat, _ = tree_ravel(params)
+    sizes = tuple(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    for dp in (1, 2, 3, 4):
+        for k in (2, 3, 5):
+            spans = prefetch_span_layout(sizes, k)
+            state = functional.FlatState(
+                master=flat, count=jnp.zeros(()), slots={},
+                sizes=sizes, shard=("data", dp), spans=spans)
+            packed = _enspan(flat, state.span_sizes, state.span_padded,
+                             dp)
+            assert packed.shape[0] == state.padded_numel
+            assert state.padded_numel % dp == 0
+            out = state.replace(master=packed)._despan(packed)
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(flat))
+
+
+def test_prefetch_leaf_spans_cover_exactly_the_leaves():
+    sizes = [195, 15, 165, 11, 3]
+    for dp in (2, 4):
+        for k in (2, 3):
+            span_leaves = prefetch_span_layout(sizes, k)
+            spans = prefetch_leaf_spans(sizes, span_leaves, dp)
+            assert len(spans) == dp
+            # every leaf's elements appear exactly once across ranks
+            counts = {i: 0 for i in range(len(sizes))}
+            for rs in spans:
+                for i, lo, hi in rs:
+                    assert hi > lo
+                    counts[i] += hi - lo
+            assert counts == {i: s for i, s in enumerate(sizes)}
+
+
+def test_sharded_leaf_helpers_match_dense_over_span_layout():
+    """Per-leaf sq-norms and scalar broadcast over the span layout
+    (interior padding gaps) reassemble to the dense answers."""
+    params = _params()
+    flat, _ = tree_ravel(params)
+    sizes = tuple(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    dense_sq = np.asarray([float(jnp.sum(jnp.square(
+        jax.lax.dynamic_slice_in_dim(flat, o, s))))
+        for o, s in zip(np.cumsum((0,) + sizes[:-1]), sizes)])
+    scalars = jnp.arange(1.0, len(sizes) + 1.0, dtype=jnp.float32)
+
+    for dp in (2, 4):
+        span_leaves = prefetch_span_layout(sizes, 3)
+        spans = prefetch_leaf_spans(sizes, span_leaves, dp)
+        state = functional.FlatState(
+            master=flat, count=jnp.zeros(()), slots={},
+            sizes=sizes, shard=("data", dp), spans=span_leaves)
+        packed = np.asarray(_enspan(flat, state.span_sizes,
+                                    state.span_padded, dp))
+        lt = state.shard_len
+        total = np.zeros(len(sizes), np.float32)
+        for r in range(dp):
+            shard = jnp.asarray(packed[r * lt:(r + 1) * lt])
+            sq = sharded_leaf_sq_norms(
+                (shard,), sizes, dp=dp, shard_len=lt,
+                rank=jnp.int32(r), spans=span_leaves)
+            total += np.asarray(sq[0])
+            # broadcast: covered positions carry their leaf's scalar,
+            # padding gaps the pad value
+            bc = np.asarray(sharded_leaf_broadcast(
+                scalars, sizes, dp=dp, shard_len=lt,
+                rank=jnp.int32(r), pad_value=-1.0, spans=span_leaves))
+            expect = np.full((lt,), -1.0, np.float32)
+            for i, lo, hi in spans[r]:
+                expect[lo:hi] = float(scalars[i])
+            np.testing.assert_array_equal(bc, expect)
+        np.testing.assert_allclose(total, dense_sq, rtol=1e-6)
+
+
+def test_sharded_leaf_helpers_large_dp_fallback_matches_switch():
+    """Above ``_SWITCH_MAX_DP`` the per-leaf helpers swap the
+    lax.switch-over-ranks path for the bounded-compile global-buffer
+    path — for the span layout too (the spans override must not
+    silently reintroduce the O(dp·n_leaves) switch the guard bounds).
+    Both paths must agree exactly."""
+    import apex_tpu.optimizers.base as base
+    params = _params()
+    flat, _ = tree_ravel(params)
+    sizes = tuple(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    scalars = jnp.arange(1.0, len(sizes) + 1.0, dtype=jnp.float32)
+    dp = 4
+    span_leaves = prefetch_span_layout(sizes, 3)
+    spans = prefetch_leaf_spans(sizes, span_leaves, dp)
+    state = functional.FlatState(
+        master=flat, count=jnp.zeros(()), slots={},
+        sizes=sizes, shard=("data", dp), spans=span_leaves)
+    packed = np.asarray(_enspan(flat, state.span_sizes,
+                                state.span_padded, dp))
+    lt = state.shard_len
+    saved = base._SWITCH_MAX_DP
+    try:
+        for r in range(dp):
+            shard = jnp.asarray(packed[r * lt:(r + 1) * lt])
+            args = dict(dp=dp, shard_len=lt, rank=jnp.int32(r),
+                        spans=span_leaves)
+            base._SWITCH_MAX_DP = 32          # switch path
+            sq_sw = sharded_leaf_sq_norms((shard,), sizes, **args)
+            bc_sw = sharded_leaf_broadcast(scalars, sizes,
+                                           pad_value=-1.0, **args)
+            base._SWITCH_MAX_DP = 1           # global-buffer fallback
+            sq_fb = sharded_leaf_sq_norms((shard,), sizes, **args)
+            bc_fb = sharded_leaf_broadcast(scalars, sizes,
+                                           pad_value=-1.0, **args)
+            np.testing.assert_allclose(np.asarray(sq_fb),
+                                       np.asarray(sq_sw), rtol=1e-6)
+            np.testing.assert_array_equal(np.asarray(bc_fb),
+                                          np.asarray(bc_sw))
+            # block layout's fallback keeps agreeing too
+            blk = dict(dp=dp, shard_len=lt, rank=jnp.int32(r))
+            pad = dp * lt - sum(sizes)
+            blk_shard = jnp.asarray(np.concatenate(
+                [np.asarray(flat), np.zeros(pad, np.float32)])
+                [r * lt:(r + 1) * lt])
+            base._SWITCH_MAX_DP = 32
+            sq_sw = sharded_leaf_sq_norms((blk_shard,), sizes, **blk)
+            bc_sw = sharded_leaf_broadcast(scalars, sizes,
+                                           pad_value=-1.0, **blk)
+            base._SWITCH_MAX_DP = 1
+            sq_fb = sharded_leaf_sq_norms((blk_shard,), sizes, **blk)
+            bc_fb = sharded_leaf_broadcast(scalars, sizes,
+                                           pad_value=-1.0, **blk)
+            np.testing.assert_allclose(np.asarray(sq_fb),
+                                       np.asarray(sq_sw), rtol=1e-6)
+            np.testing.assert_array_equal(np.asarray(bc_fb),
+                                          np.asarray(bc_sw))
+    finally:
+        base._SWITCH_MAX_DP = saved
+
+
+def test_init_and_params_roundtrip_span_layout():
+    params = _params()
+    tx = functional.fused_adam(lr=1e-3)
+    for dp in (2, 4):
+        for rank in range(dp):
+            st = tx.init(params, shard=("data", dp, rank), prefetch=3)
+            assert st.spans and st.master.shape[0] == st.shard_len
+        # global view: init on the full padded buffer, params() inverts
+        # the rank-major permutation without a mesh
+        from apex_tpu import train_step
+        state, specs = train_step.init_zero_train_state(
+            tx, params, "data", dp, prefetch=3)
+        assert state.opt.spans
+        out = state.params()
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), out, params)
+        # spec tree still marks exactly the dp-shardable buffers
+        padded = state.opt.padded_numel
+        for leaf, spec in zip(jax.tree.leaves(state),
+                              jax.tree.leaves(
+                                  jax.tree.map(
+                                      lambda s: s, specs,
+                                      is_leaf=lambda x: isinstance(x, P)))):
+            assert (spec == P("data")) == (
+                leaf.ndim == 1 and leaf.shape[0] == padded)
+
+
+def test_shard_flat_grads_span_layout_matches_block():
+    """The ZeRO-2 grad reduce-scatter under the span layout lands each
+    rank the same VALUES as the block layout, just permuted into the
+    span windows — reassembling both through params()-style despan
+    yields identical full gradients."""
+    params = _params()
+    n = int(tree_ravel(params)[0].size)
+    tx = functional.fused_adam(lr=1e-3)
+    rng = np.random.RandomState(7)
+    g_ranks = [jnp.asarray(rng.randn(n), jnp.float32) for _ in range(2)]
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+    def run(prefetch):
+        def body(gstack):
+            st = tx.init(params, shard=("data", 2), prefetch=prefetch)
+            rank = jax.lax.axis_index("data")
+            gshard = functional.shard_flat_grads(gstack[rank], st)
+            return gshard
+
+        gstack = jnp.stack(g_ranks)
+        return np.asarray(jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(),),
+            out_specs=P("data")))(gstack))
+
+    mean = (np.asarray(g_ranks[0]) + np.asarray(g_ranks[1])) / 2
+    block = run(0)
+    np.testing.assert_allclose(block[:n], mean, rtol=1e-6, atol=1e-7)
+    spanned = run(3)
+    # reassemble the span-layout result through _despan
+    st = tx.init(params, shard=("data", 2, 0), prefetch=3)
+    full = np.asarray(st.replace(
+        master=jnp.asarray(spanned))._despan(jnp.asarray(spanned)))
+    np.testing.assert_allclose(full, mean, rtol=1e-6, atol=1e-7)

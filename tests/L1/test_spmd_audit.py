@@ -72,6 +72,23 @@ def test_committed_budget_is_current():
             committed["executables"][name], name
 
 
+def test_budget_covers_every_registered_executable_exactly():
+    """CI guard (ISSUE 7 satellite): the committed ledger's entry set
+    == the SPMD auditor's registered-executable set, name for name.
+    Adding an (overlapped) executable without budgeting it — or
+    silently dropping one from the registry while its stale entry keeps
+    'passing' — fails here fast, before the ratchet could even look the
+    wrong way."""
+    from apex_tpu.analysis.spmd_audit import exec_specs
+    committed = json.loads((REPO / BUDGET_NAME).read_text())
+    registered = {s.name for s in exec_specs()}
+    budgeted = set(committed["executables"])
+    assert registered == budgeted, (
+        f"registered-not-budgeted={sorted(registered - budgeted)}, "
+        f"budgeted-not-registered={sorted(budgeted - registered)} — "
+        f"run apex-tpu-analyze --spmd --write-budget and commit")
+
+
 def test_budget_ratchet_fires_on_growth(tmp_path, capsys):
     """A budget pinned BELOW the current ledger fails the run (comm
     growth detected); re-pinning with --write-budget clears it."""
