@@ -85,6 +85,24 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "captures",
         read_by="apex_tpu/inference/kv_cache.py"),
     EnvKnob(
+        name="APEX_TPU_TELEMETRY",
+        default="0",
+        effect="runtime telemetry sink directory: a path attaches the "
+               "JSONL event log (telemetry.jsonl) and the Prometheus "
+               "text-exposition file (metrics.prom) to the global "
+               "metrics registry at first use; 0 keeps telemetry "
+               "in-process only (instruments still work, nothing is "
+               "written); schema pinned by .telemetry_schema.json",
+        read_by="apex_tpu/observability/__init__.py"),
+    EnvKnob(
+        name="APEX_TPU_PROFILE_DIR",
+        default="0",
+        effect="profiler capture directory: a path arms observability."
+               "profile_capture() — bench legs and examples/generate.py "
+               "drop jax.profiler (TensorBoard/xprof) traces there; 0 "
+               "disables capture (the context manager is a no-op)",
+        read_by="apex_tpu/observability/tracing.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

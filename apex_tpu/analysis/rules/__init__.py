@@ -53,4 +53,5 @@ from apex_tpu.analysis.rules import (  # noqa: E402,F401
     precision,
     prng,
     side_effects,
+    step_timing,
 )

@@ -1,0 +1,121 @@
+"""APX110 — raw wall-clock step-timing around jitted calls.
+
+``t0 = time.perf_counter(); y = step(x); dt = time.perf_counter() - t0``
+around an async-dispatched jitted call measures the *dispatch* (often
+microseconds — the r5 ``flash_attn_us 0.0`` artifact's shape), or, when
+the caller immediately reads a result, silently folds any recompile
+into the sample.  Package code must time steps through
+``apex_tpu.observability.StepTimer`` (dispatch-aware: reports the
+compile-count delta and flags recompiles) — the pattern the training
+and serving telemetry use.
+
+The rule fires when one function body reads a raw clock at least
+twice AND calls an AST-resolvable jit-bound callable between the
+reads: a name assigned from ``jax.jit(...)``, a ``@jax.jit``-decorated
+function, or an inline ``jax.jit(f)(...)``.  Opaque callables (method
+calls, parameters) stay quiet — the lint is untyped and a guess would
+blanket-flag ordinary host timing.
+"""
+from __future__ import annotations
+
+import ast
+
+from apex_tpu.analysis.lint import JIT_WRAPPERS
+from apex_tpu.analysis.rules import Rule, register
+
+_CLOCK_FNS = {"time.perf_counter", "time.monotonic", "time.time"}
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_scope(node):
+    """``ast.walk`` that does not descend into nested function scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, _SCOPE_NODES):
+                stack.append(child)
+
+
+@register
+class RawStepTimingAroundJit(Rule):
+    id = "APX110"
+    name = "raw-step-timing-around-jit"
+    description = ("raw time.perf_counter()/monotonic() bracketing a "
+                   "jitted call — async dispatch makes the reading "
+                   "misleading and recompiles go unflagged; use "
+                   "apex_tpu.observability.StepTimer")
+
+    def check_module(self, ctx):
+        jit_names = self._jit_bound_names(ctx)
+        reported: set = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            clocks, jit_calls = [], []
+            for stmt in body:
+                # walk THIS function's scope only — nested defs/lambdas
+                # are visited by the outer loop as their own scopes, and
+                # a clock inside a nested helper cannot close a timing
+                # bracket in the enclosing function
+                if isinstance(stmt, _SCOPE_NODES):
+                    continue
+                for sub in _walk_scope(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if ctx.resolve(sub.func) in _CLOCK_FNS:
+                        clocks.append(sub)
+                    elif self._is_jit_dispatch(ctx, sub, jit_names):
+                        jit_calls.append(sub)
+            if len(clocks) < 2 or not jit_calls:
+                continue
+            clocks.sort(key=lambda c: (c.lineno, c.col_offset))
+            first = clocks[0]
+            for jc in jit_calls:
+                if jc.lineno < first.lineno:
+                    continue
+                stop = next((c for c in clocks
+                             if c.lineno > jc.lineno), None)
+                if stop is not None and id(stop) not in reported:
+                    reported.add(id(stop))
+                    yield ctx.finding(
+                        self.id, stop,
+                        "raw clock read closes a timing bracket around "
+                        "a jitted call — the sample is dispatch time "
+                        "(or an unflagged recompile), not step time; "
+                        "use apex_tpu.observability.StepTimer")
+                    break              # one finding per function
+
+    @staticmethod
+    def _jit_bound_names(ctx) -> set:
+        """Names that hold jit-compiled callables: ``f = jax.jit(g)``
+        assignments + ``@jax.jit``-decorated defs."""
+        names: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    ctx.resolve(node.value.func) in JIT_WRAPPERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        for info in ctx.jit_infos:
+            if info.is_jit and isinstance(
+                    info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(info.node.name)
+        return names
+
+    @staticmethod
+    def _is_jit_dispatch(ctx, call: ast.Call, jit_names: set) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in jit_names:
+            return True
+        # inline jax.jit(f)(...)
+        if isinstance(f, ast.Call) and \
+                ctx.resolve(f.func) in JIT_WRAPPERS:
+            return True
+        return False

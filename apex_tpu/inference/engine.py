@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu import observability as obs
 from apex_tpu.inference import kv_cache, models
 from apex_tpu.inference.sampling import SamplingConfig, sample_token
 
@@ -66,22 +67,33 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
     inside the bucket-padded ``tokens``."""
 
     def prefill_fn(cache, params, tokens, slot, length, key, step):
-        # length threads into the forward so the lm head projects ONLY
-        # the last real position, not every bucket-padded row
-        logits, ks, vs = models.prefill_forward(kind, cfg, params,
-                                                tokens[None], length)
-        cache = kv_cache.insert(cache, slot, ks, vs, length)
-        last = logits[0].astype(jnp.float32)                # [vocab]
-        tok = sample_token(last, jax.random.fold_in(key, step), sampling)
+        # named_scope = metadata-only xprof regions (no prims added, so
+        # the jaxpr/SPMD audits of these exact builders are unchanged)
+        with obs.named_scope("apex_prefill_forward"):
+            # length threads into the forward so the lm head projects
+            # ONLY the last real position, not every bucket-padded row
+            logits, ks, vs = models.prefill_forward(kind, cfg, params,
+                                                    tokens[None], length)
+        with obs.named_scope("apex_prefill_cache_insert"):
+            cache = kv_cache.insert(cache, slot, ks, vs, length)
+        with obs.named_scope("apex_prefill_sample"):
+            last = logits[0].astype(jnp.float32)            # [vocab]
+            tok = sample_token(last, jax.random.fold_in(key, step),
+                               sampling)
         return cache, tok, last
 
     def prefill_paged_fn(cache, params, tokens, slot, length, row, key,
                          step):
-        logits, ks, vs = models.prefill_forward(kind, cfg, params,
-                                                tokens[None], length)
-        cache = kv_cache.insert_pages(cache, slot, ks, vs, length, row)
-        last = logits[0].astype(jnp.float32)                # [vocab]
-        tok = sample_token(last, jax.random.fold_in(key, step), sampling)
+        with obs.named_scope("apex_prefill_forward"):
+            logits, ks, vs = models.prefill_forward(kind, cfg, params,
+                                                    tokens[None], length)
+        with obs.named_scope("apex_prefill_cache_insert"):
+            cache = kv_cache.insert_pages(cache, slot, ks, vs, length,
+                                          row)
+        with obs.named_scope("apex_prefill_sample"):
+            last = logits[0].astype(jnp.float32)            # [vocab]
+            tok = sample_token(last, jax.random.fold_in(key, step),
+                               sampling)
         return cache, tok, last
 
     return prefill_paged_fn if paged else prefill_fn
@@ -97,12 +109,15 @@ def make_decode_fn(kind: str, cfg, sampling: SamplingConfig):
     the paged pool threads its page table through the same signature."""
 
     def decode_fn(cache, params, tokens, active, key, step):
-        logits, cache = models.decode_forward(kind, cfg, params, cache,
-                                              tokens)
-        logits = logits.astype(jnp.float32)
-        toks = sample_token(logits, jax.random.fold_in(key, step),
-                            sampling)
-        cache, truncated = kv_cache.advance(cache, active)
+        with obs.named_scope("apex_decode_forward"):
+            logits, cache = models.decode_forward(kind, cfg, params,
+                                                  cache, tokens)
+        with obs.named_scope("apex_decode_sample"):
+            logits = logits.astype(jnp.float32)
+            toks = sample_token(logits, jax.random.fold_in(key, step),
+                                sampling)
+        with obs.named_scope("apex_decode_advance"):
+            cache, truncated = kv_cache.advance(cache, active)
         return cache, toks, logits, truncated
 
     return decode_fn
@@ -187,6 +202,13 @@ class InferenceEngine:
         self.params = params
         self._key = jax.random.PRNGKey(seed)
         self._step = 0
+        # dispatch counters are GLOBAL-registry families (engine-level,
+        # process-wide — per-wave serving metrics live in the
+        # scheduler's ServeTelemetry registry); cached so declared()'s
+        # lock + schema lookup is not per-token work, re-resolved on
+        # registry identity so reset_global_registry() can't orphan them
+        self._tel_registry = None
+        self._refresh_dispatch_counters()
         if kind == "bert":
             self._encode = jax.jit(self._make_bert_encode())
         else:
@@ -196,6 +218,15 @@ class InferenceEngine:
                 donate_argnums=(0,))
             self._decode = jax.jit(
                 make_decode_fn(kind, cfg, sampling), donate_argnums=(0,))
+
+    def _refresh_dispatch_counters(self) -> None:
+        reg = obs.global_registry()
+        if reg is not self._tel_registry:
+            self._tel_registry = reg
+            self._prefill_dispatches = reg.declared(
+                "infer_prefill_dispatch_total")
+            self._decode_dispatches = reg.declared(
+                "infer_decode_dispatch_total")
 
     # -- cache ---------------------------------------------------------------
     def init_cache(self):
@@ -275,12 +306,17 @@ class InferenceEngine:
                     f"ceil((prompt + max_new_tokens) / page_size) pages")
             row = kv_cache.page_row(pages, self.max_pages_per_slot,
                                     self.num_pages)
-            return self._prefill(cache, self.params, padded,
-                                 np.int32(slot), np.int32(n), row,
-                                 self._key, self._next_step())
-        return self._prefill(cache, self.params, padded,
-                             np.int32(slot), np.int32(n),
-                             self._key, self._next_step())
+            args = (cache, self.params, padded, np.int32(slot),
+                    np.int32(n), row)
+        else:
+            args = (cache, self.params, padded, np.int32(slot),
+                    np.int32(n))
+        # counted AFTER validation: a rejected reservation raised above
+        # and dispatched nothing
+        self._refresh_dispatch_counters()
+        self._prefill_dispatches.inc()
+        with obs.trace_annotation("apex_tpu.inference.prefill"):
+            return self._prefill(*args, self._key, self._next_step())
 
     def decode(self, cache, last_tokens, active=None):
         """One token for every slot: returns ``(cache, next_tokens,
@@ -298,10 +334,13 @@ class InferenceEngine:
         """
         if active is None:
             active = np.ones((self.slots,), bool)
-        return self._decode(cache, self.params,
-                            np.asarray(last_tokens, np.int32),
-                            np.asarray(active, bool),
-                            self._key, self._next_step())
+        self._refresh_dispatch_counters()
+        self._decode_dispatches.inc()
+        with obs.trace_annotation("apex_tpu.inference.decode"):
+            return self._decode(cache, self.params,
+                                np.asarray(last_tokens, np.int32),
+                                np.asarray(active, bool),
+                                self._key, self._next_step())
 
     def generate(self, prompts, max_new_tokens: int = 16,
                  eos_id: Optional[int] = None):

@@ -25,6 +25,16 @@ A wave of requests therefore flows through a FIXED set of compiled
 programs — the continuous-batching property: a finished sequence's slot
 is refilled on the next loop iteration while the other slots keep
 decoding, with no recompile and no cache reallocation anywhere.
+
+Telemetry (ISSUE 8): every scheduler carries a
+:class:`~apex_tpu.observability.serve.ServeTelemetry` observing the
+lifecycle at the host points the loop ALREADY occupies (it reads
+sampled tokens between steps by construction, so instrumentation adds
+zero device reads and zero recompiles): submit/admit/first-token/finish
+events, TTFT + per-token decode-latency histograms, queue depth,
+backpressure + per-``finish_reasons`` counters, and the page-pool
+free/occupancy gauges.  ``peak_active``/``finish_reasons`` stay as
+attributes for existing callers, mirrored into the registry.
 """
 from __future__ import annotations
 
@@ -35,6 +45,7 @@ from typing import Optional
 import numpy as np
 
 from apex_tpu.inference import kv_cache
+from apex_tpu.observability import ServeTelemetry
 
 __all__ = ["Request", "SlotScheduler", "generate"]
 
@@ -90,21 +101,31 @@ class SlotScheduler:
     admission-capacity observable the paged cache exists to raise.
     """
 
-    def __init__(self, engine):
+    def __init__(self, engine, telemetry: Optional[ServeTelemetry] = None):
         self.engine = engine
         self.queue: collections.deque = collections.deque()
         self._next_uid = 0
         self.alloc = engine.new_allocator() if engine.paged else None
         self.finish_reasons: dict = {}
         self.peak_active = 0
+        # default: the global registry (env-selected sinks attach there);
+        # tests pass a ServeTelemetry over a fresh registry for isolation
+        self.telemetry = (telemetry if telemetry is not None
+                          else ServeTelemetry())
+        if self.alloc is not None:
+            self.telemetry.pool(self.alloc.free_pages,
+                                self.engine.num_pages)
 
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_id: Optional[int] = None) -> int:
         """Queue one request; returns its uid (results key)."""
+        tel = self.telemetry
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
+            tel.request_rejected("empty_prompt")
             raise ValueError("empty prompt")
         if len(prompt) > self.engine.max_seq:
+            tel.request_rejected("prompt_over_max_seq")
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds engine max_seq "
                 f"{self.engine.max_seq}")
@@ -115,6 +136,7 @@ class SlotScheduler:
             need = self.alloc.pages_needed(len(prompt)
                                            + int(max_new_tokens))
             if need > self.engine.num_pages:
+                tel.request_rejected("request_over_pool")
                 raise ValueError(
                     f"request needs {need} pages of "
                     f"{self.engine.page_size} (prompt {len(prompt)} + "
@@ -125,6 +147,8 @@ class SlotScheduler:
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, int(max_new_tokens),
                                   eos_id))
+        tel.request_submitted(uid, len(prompt), int(max_new_tokens),
+                              queue_depth=len(self.queue))
         return uid
 
     # -- admission ----------------------------------------------------------
@@ -156,6 +180,7 @@ class SlotScheduler:
         everything else here is host-side bookkeeping on ints.
         """
         eng = self.engine
+        tel = self.telemetry
         if cache is None:
             cache = eng.init_cache()
         slots: list = [None] * eng.slots
@@ -181,9 +206,11 @@ class SlotScheduler:
                 # (dense slots skip this — their rows are slot-private)
                 cache = kv_cache.evict(cache, slot)
                 self.alloc.free(st.pages)      # pages back to the pool
+                tel.pool(self.alloc.free_pages, eng.num_pages)
             slots[slot] = None
             free.append(slot)          # eviction = metadata; insert
             # on re-admit overwrites the stale cache rows
+            tel.request_finished(st.uid, reason, len(gen))
 
         while self.queue or any(s is not None for s in slots):
             # admit: fill free slots from the queue (FIFO — a request
@@ -192,12 +219,20 @@ class SlotScheduler:
             while self.queue and free:
                 pages, capacity = self._reservation(self.queue[0])
                 if eng.paged and pages is None:
+                    tel.backpressured()
                     break              # out of pages: wait for a retire
                 req = self.queue.popleft()
                 slot = free.pop()
-                cache, tok, _ = eng.prefill(cache, req.prompt, slot,
-                                            pages=pages)
-                tok = int(np.asarray(tok))
+                tel.request_admitted(
+                    req.uid, slot, queue_depth=len(self.queue),
+                    pages=len(pages) if pages is not None else None)
+                if pages is not None:
+                    tel.pool(self.alloc.free_pages, eng.num_pages)
+                with tel.prefill_step():
+                    cache, tok, _ = eng.prefill(cache, req.prompt, slot,
+                                                pages=pages)
+                    tok = int(np.asarray(tok))
+                tel.first_token(req.uid)
                 slots[slot] = _SlotState(req.uid, [tok],
                                          req.max_new_tokens, req.eos_id,
                                          prompt_len=len(req.prompt),
@@ -232,10 +267,17 @@ class SlotScheduler:
                 continue
             # counted AFTER the capacity guard: peak_active measures
             # requests that actually decode concurrently this step
-            self.peak_active = max(self.peak_active, int(active.sum()))
-            cache, toks, _, truncated = eng.decode(cache, last, active)
-            toks = np.asarray(toks)
-            truncated = np.asarray(truncated)
+            n_active = int(active.sum())
+            self.peak_active = max(self.peak_active, n_active)
+            # the decode bracket closes after the token host-read the
+            # loop performs anyway, so the histogram sample is the true
+            # per-token latency (dispatch + sync), and its recompile
+            # flag feeds serve_recompiles_total (pinned 0 by tests)
+            with tel.decode_step(n_active):
+                cache, toks, _, truncated = eng.decode(cache, last,
+                                                       active)
+                toks = np.asarray(toks)
+                truncated = np.asarray(truncated)
             for slot, st in enumerate(slots):
                 if st is None or not active[slot]:
                     continue
@@ -248,6 +290,10 @@ class SlotScheduler:
                 last[slot] = toks[slot]
                 if st.done():
                     retire(slot, REASON_LENGTH)
+        # wave boundary: flush snapshot sinks (the Prometheus file is
+        # only written on export — without this, APEX_TPU_TELEMETRY
+        # would produce the JSONL stream but never metrics.prom)
+        tel.registry.export()
         return results
 
 

@@ -1,0 +1,90 @@
+"""apex_tpu.observability — runtime telemetry for train + serve
+(ISSUE 8).
+
+The analysis suite (APX101–APX217) proves properties at trace time;
+this subsystem reports what the system is DOING at runtime — without
+violating the two invariants the analyzers guard: instrumented paths
+keep ONE donated executable per step, and no host sync enters jitted
+code (device scalars resolve one step late via the deferred collector).
+
+    schema     pinned metric families + JSONL event fields
+               (guarded by the committed .telemetry_schema.json)
+    registry   counters / gauges / bucketed histograms with labels
+    sinks      JSONL event log + Prometheus text-exposition file
+    deferred   one-step-late device-scalar resolution
+    timers     dispatch-aware StepTimer + compile-event counting
+    tracing    trace_annotation / named_scope / profile_capture
+    serve      ServeTelemetry (SlotScheduler lifecycle: TTFT, decode
+               latency, queue depth, finish reasons, page-pool gauges)
+    train      TrainTelemetry (step time, tokens/s, overflow skips,
+               loss-scale gauge, exposed-comm residual)
+
+Knobs (registered in ``analysis/env_registry.py``):
+
+* ``APEX_TPU_TELEMETRY=<dir>`` attaches a JSONL sink
+  (``<dir>/telemetry.jsonl``) and a Prometheus file sink
+  (``<dir>/metrics.prom``) to the global registry at first use; ``0``
+  (default) keeps telemetry in-process only — instruments always work,
+  nothing is written.
+* ``APEX_TPU_PROFILE_DIR=<dir>`` arms :func:`profile_capture` (bench
+  legs, ``examples/generate.py``) to drop ``jax.profiler`` traces.
+"""
+from __future__ import annotations
+
+import os
+
+from apex_tpu.observability.deferred import DeferredScalarCollector
+from apex_tpu.observability.registry import (Counter, Gauge, Histogram,
+                                             Metrics, MetricsRegistry,
+                                             global_metrics,
+                                             global_registry,
+                                             reset_global_registry)
+from apex_tpu.observability.serve import ServeTelemetry
+from apex_tpu.observability.sinks import (JsonlSink, PrometheusSink,
+                                          render_prometheus)
+from apex_tpu.observability.timers import StepSample, StepTimer, \
+    compile_count
+from apex_tpu.observability.tracing import (named_scope, profile_capture,
+                                            profile_dir, start_profile,
+                                            stop_profile,
+                                            trace_annotation)
+from apex_tpu.observability.train import TrainTelemetry
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "global_registry", "reset_global_registry",
+    "JsonlSink", "PrometheusSink", "render_prometheus",
+    "DeferredScalarCollector",
+    "StepTimer", "StepSample", "compile_count",
+    "trace_annotation", "named_scope", "profile_capture", "profile_dir",
+    "start_profile", "stop_profile",
+    "ServeTelemetry", "TrainTelemetry",
+    "telemetry_enabled", "configure_from_env",
+    "Metrics", "global_metrics",
+]
+
+_ENV_TELEMETRY = "APEX_TPU_TELEMETRY"
+
+
+def telemetry_enabled() -> bool:
+    """True when ``APEX_TPU_TELEMETRY`` names a sink directory."""
+    return os.environ.get(_ENV_TELEMETRY, "0") not in ("", "0")
+
+
+def configure_from_env(registry=None) -> MetricsRegistry:
+    """Attach the env-selected sinks to the (global) registry, once.
+    Idempotent PER REGISTRY (the mark lives on the registry object, so
+    explicit and implicit callers can't double-attach sinks, and a
+    fresh ``reset_global_registry()`` registry configures again) and a
+    no-op when the knob is off; returns the registry either way so call
+    sites can chain."""
+    reg = registry if registry is not None else global_registry()
+    if getattr(reg, "_env_sinks_attached", False):
+        return reg
+    reg._env_sinks_attached = True
+    target = os.environ.get(_ENV_TELEMETRY, "0")
+    if target not in ("", "0"):
+        os.makedirs(target, exist_ok=True)
+        reg.add_sink(JsonlSink(os.path.join(target, "telemetry.jsonl")))
+        reg.add_sink(PrometheusSink(os.path.join(target, "metrics.prom")))
+    return reg

@@ -1,0 +1,85 @@
+"""Deferred device-scalar collection: read results one step late so
+telemetry never blocks dispatch.
+
+The training/serving loops get device arrays back from every donated
+executable (loss, grad-norm, found_inf, loss_scale) *immediately* —
+they are futures, and converting one to a Python float blocks the host
+until the step finishes, serializing the dispatch pipeline (exactly the
+APX101 hazard, one frame above the jit boundary).  The collector breaks
+the coupling: callers *enqueue* the arrays with their step index, and
+:meth:`DeferredScalarCollector.poll` resolves only entries from steps
+STRICTLY BEFORE the newest enqueued one — by then step N has been
+dispatched, so blocking on step N-1's outputs costs nothing the
+hardware wasn't already doing.  ``tests/L0/run_observability/
+test_deferred.py`` proves the one-step-late contract (nothing from the
+newest step is ever materialized by ``poll``).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["DeferredScalarCollector"]
+
+
+def _materialize(value) -> float:
+    # np.asarray on a jax array blocks until the producing step is done
+    # — which is why this only ever runs on completed prior steps
+    return float(np.asarray(value))
+
+
+class DeferredScalarCollector:
+    """FIFO of ``(step, {name: device scalar})`` resolved one step late.
+
+    ``on_resolve(step, {name: float})`` fires per resolved entry (the
+    hook :class:`~apex_tpu.observability.train.TrainTelemetry` uses to
+    land gauges/counters).
+    """
+
+    def __init__(self, on_resolve: Optional[Callable] = None):
+        self._pending: collections.deque = collections.deque()
+        self._latest: Optional[int] = None
+        self._on_resolve = on_resolve
+
+    def enqueue(self, step: int, **scalars) -> None:
+        """Park device scalars for ``step`` (no read happens here).
+        ``None`` values are dropped so callers can pass optional signals
+        unconditionally."""
+        step = int(step)
+        if self._latest is not None and step < self._latest:
+            raise ValueError(
+                f"step {step} enqueued after step {self._latest} — the "
+                f"collector is a forward-only step FIFO")
+        scalars = {k: v for k, v in scalars.items() if v is not None}
+        self._pending.append((step, scalars))
+        self._latest = step
+
+    @property
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def poll(self) -> List[Tuple[int, Dict[str, float]]]:
+        """Resolve every entry from steps strictly before the newest
+        enqueued step; entries from the newest step stay parked (their
+        executable may still be in flight)."""
+        out = []
+        while self._pending and self._pending[0][0] < self._latest:
+            out.append(self._resolve_one())
+        return out
+
+    def drain(self) -> List[Tuple[int, Dict[str, float]]]:
+        """Resolve EVERYTHING — the end-of-run boundary, where blocking
+        on the final step is the point."""
+        out = []
+        while self._pending:
+            out.append(self._resolve_one())
+        return out
+
+    def _resolve_one(self) -> Tuple[int, Dict[str, float]]:
+        step, scalars = self._pending.popleft()
+        resolved = {k: _materialize(v) for k, v in scalars.items()}
+        if self._on_resolve is not None:
+            self._on_resolve(step, resolved)
+        return step, resolved

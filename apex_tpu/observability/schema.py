@@ -1,0 +1,202 @@
+"""The pinned telemetry schema: every metric family and JSONL event the
+runtime emits, declared ONCE.
+
+Dashboards and log pipelines consume the Prometheus text file and the
+JSONL event stream by field name; a silent rename breaks them without a
+test failing anywhere.  This module is therefore the single source of
+truth, mirrored to the committed ``.telemetry_schema.json`` and gated by
+``tests/L0/run_observability/test_schema_guard.py`` exactly like the
+SPMD comm/HBM budget ledger (``.analysis_budget.json``): the committed
+file must match :func:`current_schema` bit-for-bit, and instruments can
+only be created FROM these declarations
+(:meth:`~apex_tpu.observability.registry.MetricsRegistry.declared`
+raises on an undeclared name), so the code cannot emit a family the
+schema does not pin.
+
+To change the schema: edit the declarations here, then re-pin with
+
+    python -m apex_tpu.observability.schema --write
+
+and commit both files — the conscious-rename workflow, same as
+``apex-tpu-analyze --spmd --write-budget``.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = ["MetricSpec", "METRIC_SPECS", "EVENT_FIELDS", "SCHEMA_NAME",
+           "SCHEMA_VERSION", "current_schema", "main"]
+
+SCHEMA_NAME = ".telemetry_schema.json"
+SCHEMA_VERSION = 1
+
+#: histogram bucket upper bounds, seconds.  Decode hands one token per
+#: slot per step, so its buckets start an order of magnitude finer than
+#: the request-level latencies (TTFT spans prefill compile + forward).
+DECODE_LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 1.0)
+REQUEST_LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0)
+STEP_TIME_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 15.0, 60.0)
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    kind: str                              # counter | gauge | histogram
+    help: str
+    labels: Tuple[str, ...] = ()
+    buckets: Optional[Tuple[float, ...]] = None   # histograms only
+
+    def __post_init__(self):
+        if self.kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"{self.name}: unknown kind {self.kind!r}")
+        if (self.buckets is not None) != (self.kind == "histogram"):
+            raise ValueError(f"{self.name}: buckets iff histogram")
+
+
+METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
+    # -- serving (SlotScheduler / ServeTelemetry) -------------------------
+    MetricSpec("serve_requests_submitted_total", "counter",
+               "requests handed to SlotScheduler.submit (pre-validation)"),
+    MetricSpec("serve_requests_rejected_total", "counter",
+               "submissions rejected at validation (never queued)",
+               labels=("reason",)),
+    MetricSpec("serve_requests_admitted_total", "counter",
+               "requests admitted into a cache slot (prefill issued)"),
+    MetricSpec("serve_requests_finished_total", "counter",
+               "requests retired, keyed by the scheduler finish reason",
+               labels=("reason",)),
+    MetricSpec("serve_backpressure_waits_total", "counter",
+               "admission passes deferred for lack of free KV pages"),
+    MetricSpec("serve_tokens_generated_total", "counter",
+               "tokens returned to finished requests"),
+    MetricSpec("serve_decode_steps_total", "counter",
+               "batched decode executions (one token per active slot)"),
+    MetricSpec("serve_recompiles_total", "counter",
+               "decode steps that triggered a NEW compile after warmup "
+               "(must stay 0: decode is ONE donated executable)"),
+    MetricSpec("serve_queue_depth", "gauge",
+               "requests waiting in the scheduler queue"),
+    MetricSpec("serve_active_slots", "gauge",
+               "slots decoding concurrently this step"),
+    MetricSpec("serve_peak_active", "gauge",
+               "max concurrently-decoding requests the run reached"),
+    MetricSpec("serve_free_pages", "gauge",
+               "KV page-pool pages currently free (paged engines)"),
+    MetricSpec("serve_page_pool_occupancy", "gauge",
+               "fraction of the KV page pool in use, 0..1 (paged)"),
+    MetricSpec("serve_ttft_seconds", "histogram",
+               "submit -> first token on host (time to first token)",
+               buckets=REQUEST_LATENCY_BUCKETS_S),
+    MetricSpec("serve_prefill_seconds", "histogram",
+               "prefill dispatch + first-token host read, per admission",
+               buckets=REQUEST_LATENCY_BUCKETS_S),
+    MetricSpec("serve_decode_token_seconds", "histogram",
+               "one decode step: dispatch + sampled-token host read "
+               "(= per-token latency; one token per slot per step)",
+               buckets=DECODE_LATENCY_BUCKETS_S),
+    # -- engine dispatch (host wrappers around the donated executables) ---
+    MetricSpec("infer_prefill_dispatch_total", "counter",
+               "InferenceEngine.prefill dispatches"),
+    MetricSpec("infer_decode_dispatch_total", "counter",
+               "InferenceEngine.decode dispatches"),
+    # -- training (TrainTelemetry) ----------------------------------------
+    MetricSpec("train_steps_total", "counter",
+               "instrumented train steps dispatched"),
+    MetricSpec("train_recompiles_total", "counter",
+               "train steps that triggered a NEW compile after warmup "
+               "(must stay 0: the step is ONE donated executable)"),
+    MetricSpec("train_overflow_skips_total", "counter",
+               "steps whose update was skipped on grad overflow "
+               "(found_inf, resolved one step late)"),
+    MetricSpec("train_tokens_per_s", "gauge",
+               "tokens / measured step wall time"),
+    MetricSpec("train_loss", "gauge",
+               "unscaled loss (deferred: reflects the PREVIOUS step)"),
+    MetricSpec("train_loss_scale", "gauge",
+               "dynamic loss scale (deferred: previous step)"),
+    MetricSpec("train_grad_norm", "gauge",
+               "global grad norm when supplied (deferred: previous step)"),
+    MetricSpec("train_exposed_comm_residual_us", "gauge",
+               "measured step time minus comm_model.step_time_estimate "
+               "overlap_us — the un-modeled exposed-comm residual"),
+    MetricSpec("train_step_seconds", "histogram",
+               "per-step wall time: interval between step completions "
+               "(steady state; first step = its own dispatch bracket "
+               "incl. warmup compile)",
+               buckets=STEP_TIME_BUCKETS_S),
+]}
+
+#: JSONL event stream: ``{"ts": float, "kind": str, ...kind fields}``.
+#: Field types are JSON type names; ``"<type>|null"`` marks a field
+#: that may be null (it is still always PRESENT).
+EVENT_FIELDS: Dict[str, Dict[str, str]] = {
+    "request_submit": {"uid": "int", "prompt_len": "int",
+                       "max_new_tokens": "int", "queue_depth": "int"},
+    "request_admit": {"uid": "int", "slot": "int", "wait_s": "float",
+                      "pages": "int|null"},
+    "request_first_token": {"uid": "int", "ttft_s": "float"},
+    "request_finish": {"uid": "int", "reason": "str", "tokens": "int",
+                       "e2e_s": "float"},
+    "train_step": {"step": "int", "seconds": "float|null",
+                   "recompiled": "bool"},
+    "profile_start": {"dir": "str", "tag": "str"},
+    "profile_stop": {"dir": "str", "tag": "str"},
+}
+
+COMMON_EVENT_FIELDS: Dict[str, str] = {"ts": "float", "kind": "str"}
+
+
+def current_schema() -> dict:
+    """The schema as one JSON-stable dict (what ``.telemetry_schema.json``
+    pins)."""
+    return {
+        "version": SCHEMA_VERSION,
+        "prometheus": {
+            name: {
+                "type": s.kind,
+                "help": s.help,
+                "labels": list(s.labels),
+                **({"buckets": list(s.buckets)}
+                   if s.buckets is not None else {}),
+            }
+            for name, s in sorted(METRIC_SPECS.items())
+        },
+        "jsonl": {
+            "common": dict(COMMON_EVENT_FIELDS),
+            "events": {k: dict(v)
+                       for k, v in sorted(EVENT_FIELDS.items())},
+        },
+    }
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised via CLI
+    import argparse
+    from pathlib import Path
+
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.observability.schema",
+        description="print or re-pin the telemetry schema")
+    p.add_argument("--write", action="store_true",
+                   help=f"re-pin <repo>/{SCHEMA_NAME}")
+    args = p.parse_args(argv)
+    text = json.dumps(current_schema(), indent=1) + "\n"
+    if args.write:
+        from apex_tpu.analysis.cli import repo_root
+        path = Path(repo_root()) / SCHEMA_NAME
+        path.write_text(text, encoding="utf-8")
+        print(f"schema written: {path}")
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

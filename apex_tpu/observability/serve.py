@@ -1,0 +1,181 @@
+"""Serving telemetry: the request lifecycle as metrics + events.
+
+One :class:`ServeTelemetry` rides inside each
+:class:`~apex_tpu.inference.scheduler.SlotScheduler` and observes the
+lifecycle the scheduler already walks —
+
+    submit -> (reject) | queue -> admit/prefill -> first token
+           -> decode steps -> finish(reason)
+
+— yielding the PAPERS.md Gemma-serving signals: TTFT and per-token
+decode-latency histograms, queue depth, admitted/backpressured counters,
+finish-reason counts, and the page-pool free/occupancy gauges the PR 6
+scheduler computed internally but never exported.
+
+Sync discipline: every timestamp is taken at a host point the scheduler
+ALREADY occupies (it reads sampled tokens between steps by
+construction), so instrumentation adds zero device reads; the decode
+bracket deliberately closes after the scheduler's token read, making the
+sample the true per-token latency, and its recompile flag feeds
+``serve_recompiles_total`` — which the L1 integration test pins at 0.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from apex_tpu.observability.registry import MetricsRegistry
+from apex_tpu.observability.timers import StepTimer
+
+__all__ = ["ServeTelemetry"]
+
+
+class ServeTelemetry:
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            # default = the global registry with env-selected sinks
+            # attached (lazy import: this module is part of the package)
+            from apex_tpu.observability import configure_from_env
+            registry = configure_from_env()
+        reg = registry
+        self.registry = reg
+        d = reg.declared
+        self.submitted = d("serve_requests_submitted_total")
+        self.rejected = d("serve_requests_rejected_total")
+        self.admitted = d("serve_requests_admitted_total")
+        self.finished = d("serve_requests_finished_total")
+        self.backpressure_waits = d("serve_backpressure_waits_total")
+        self.tokens_generated = d("serve_tokens_generated_total")
+        self.decode_steps = d("serve_decode_steps_total")
+        self.recompiles = d("serve_recompiles_total")
+        self.queue_depth = d("serve_queue_depth")
+        self.active_slots = d("serve_active_slots")
+        self.peak_active = d("serve_peak_active")
+        self.free_pages = d("serve_free_pages")
+        self.pool_occupancy = d("serve_page_pool_occupancy")
+        self.ttft = d("serve_ttft_seconds")
+        self.prefill_seconds = d("serve_prefill_seconds")
+        self.decode_token_seconds = d("serve_decode_token_seconds")
+        # separate timers: prefill legitimately compiles once per prompt
+        # bucket, and must not advance the decode timer past its warmup
+        # step (which would mislabel decode's one compile a recompile)
+        self._prefill_timer = StepTimer()
+        self._decode_timer = StepTimer()
+        self._submit_ts: dict = {}
+        self._first_token_seen: set = set()
+
+    # -- lifecycle ----------------------------------------------------------
+    def request_submitted(self, uid: int, prompt_len: int,
+                          max_new_tokens: int, queue_depth: int) -> None:
+        self.submitted.inc()
+        self.queue_depth.set(queue_depth)
+        self._submit_ts[uid] = time.perf_counter()
+        self.registry.emit_event(
+            "request_submit", uid=int(uid), prompt_len=int(prompt_len),
+            max_new_tokens=int(max_new_tokens),
+            queue_depth=int(queue_depth))
+
+    def request_rejected(self, reason: str) -> None:
+        """A submission that failed validation (counted as submitted —
+        conservation: submitted == finished + active + rejected)."""
+        self.submitted.inc()
+        self.rejected.inc(reason=reason)
+
+    def request_admitted(self, uid: int, slot: int, queue_depth: int,
+                         pages: Optional[int] = None) -> None:
+        self.admitted.inc()
+        self.queue_depth.set(queue_depth)
+        wait = time.perf_counter() - self._submit_ts.get(
+            uid, time.perf_counter())
+        self.registry.emit_event(
+            "request_admit", uid=int(uid), slot=int(slot),
+            wait_s=round(wait, 9),
+            pages=int(pages) if pages is not None else None)
+
+    @contextlib.contextmanager
+    def prefill_step(self):
+        """Bracket one admission's prefill dispatch + first-token read."""
+        self._prefill_timer.start()
+        try:
+            yield
+        finally:
+            self.prefill_seconds.observe(self._prefill_timer.stop().seconds)
+
+    def first_token(self, uid: int) -> None:
+        """The request's first token reached the host: observe TTFT."""
+        if uid in self._first_token_seen:
+            return
+        self._first_token_seen.add(uid)
+        t0 = self._submit_ts.get(uid)
+        if t0 is None:
+            return
+        ttft = time.perf_counter() - t0
+        self.ttft.observe(ttft)
+        self.registry.emit_event("request_first_token", uid=int(uid),
+                                 ttft_s=round(ttft, 9))
+
+    @contextlib.contextmanager
+    def decode_step(self, active: int):
+        """Bracket one batched decode: dispatch + the scheduler's token
+        read.  One sample = one token per active slot."""
+        self.active_slots.set(active)
+        self.peak_active.set_max(active)
+        self._decode_timer.start()
+        try:
+            yield
+        finally:
+            sample = self._decode_timer.stop()
+            self.decode_steps.inc()
+            self.decode_token_seconds.observe(sample.seconds)
+            if sample.recompiled:
+                self.recompiles.inc()
+
+    def backpressured(self) -> None:
+        self.backpressure_waits.inc()
+
+    def request_finished(self, uid: int, reason: str,
+                         n_tokens: int) -> None:
+        self.finished.inc(reason=reason)
+        self.tokens_generated.inc(n_tokens)
+        t0 = self._submit_ts.pop(uid, None)
+        self._first_token_seen.discard(uid)
+        e2e = (time.perf_counter() - t0) if t0 is not None else 0.0
+        self.registry.emit_event(
+            "request_finish", uid=int(uid), reason=str(reason),
+            tokens=int(n_tokens), e2e_s=round(e2e, 9))
+
+    def pool(self, free: int, total: int) -> None:
+        self.free_pages.set(free)
+        if total > 0:
+            self.pool_occupancy.set(1.0 - free / total)
+
+    # -- bookkeeping views --------------------------------------------------
+    def conservation(self) -> dict:
+        """The lifecycle conservation law the scheduler tests assert:
+        ``submitted == finished + active + rejected`` (active = admitted
+        or queued, i.e. submit timestamps not yet retired)."""
+        return {
+            "submitted": int(self.submitted.total()),
+            "finished": int(self.finished.total()),
+            "rejected": int(self.rejected.total()),
+            "active": len(self._submit_ts),
+        }
+
+    def summary(self) -> dict:
+        """Human-oriented digest (examples/generate.py prints this)."""
+        out = {
+            "requests": int(self.finished.total()),
+            "tokens": int(self.tokens_generated.total()),
+            "decode_steps": int(self.decode_steps.total()),
+            "recompiles": int(self.recompiles.total()),
+        }
+        for name, hist in (("ttft", self.ttft),
+                           ("decode_token", self.decode_token_seconds)):
+            if hist.count():
+                out[f"{name}_p50_s"] = hist.quantile(0.5)
+                out[f"{name}_p99_s"] = hist.quantile(0.99)
+                out[f"{name}_mean_s"] = round(
+                    hist.sum() / hist.count(), 9)
+        return out

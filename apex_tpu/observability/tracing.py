@@ -1,0 +1,105 @@
+"""Profiler scopes + on-demand trace capture.
+
+The nvtx story, TPU-native (absorbed from the old
+``apex_tpu/utils/metrics.py``): :func:`trace_annotation` marks host
+regions, :func:`named_scope` names the ops traced inside a region
+(both surface in TensorBoard/xprof), and the
+``APEX_TPU_PROFILE_DIR`` knob arms :func:`profile_capture` — a no-op
+context manager until the knob names a directory, at which point it
+brackets the region with ``jax.profiler.start_trace``/``stop_trace``
+and drops an xprof capture there.  ``bench.py`` legs and
+``examples/generate.py`` run inside it, so grabbing a device trace of
+any leg is one environment variable, zero code edits.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Optional
+
+import jax
+
+__all__ = ["trace_annotation", "named_scope", "profile_dir",
+           "start_profile", "stop_profile", "profile_capture"]
+
+_ENV_PROFILE_DIR = "APEX_TPU_PROFILE_DIR"
+
+
+def trace_annotation(name: str):
+    """Context manager marking a host-side region in profiler traces
+    (analog of ``torch.cuda.nvtx.range``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def named_scope(name: str):
+    """Context manager naming ops traced inside (shows in XLA HLO/xprof).
+    Metadata only — it adds no primitives, so instrumented jaxprs audit
+    identically."""
+    return jax.named_scope(name)
+
+
+def profile_dir() -> Optional[str]:
+    """The capture directory, or None when capture is disarmed
+    (``APEX_TPU_PROFILE_DIR`` unset/``0``)."""
+    val = os.environ.get(_ENV_PROFILE_DIR, "0")
+    return None if val in ("", "0") else val
+
+
+_ACTIVE: Optional[str] = None
+
+
+def start_profile(log_dir: Optional[str] = None) -> bool:
+    """Begin a profiler capture into ``log_dir`` (default: the env
+    knob's directory).  Returns False (and warns) instead of raising
+    when capture can't start — a dead profiler must never kill a
+    training run or a bench leg."""
+    global _ACTIVE
+    log_dir = log_dir or profile_dir()
+    if log_dir is None:
+        return False
+    if _ACTIVE is not None:
+        return False                       # one capture at a time
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        print(f"observability: profiler capture failed to start: {e}",
+              file=sys.stderr)
+        return False
+    _ACTIVE = log_dir
+    return True
+
+
+def stop_profile() -> Optional[str]:
+    """End the active capture; returns its directory (None if none)."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        return None
+    log_dir, _ACTIVE = _ACTIVE, None
+    try:
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001
+        print(f"observability: profiler capture failed to stop: {e}",
+              file=sys.stderr)
+        return None
+    return log_dir
+
+
+@contextlib.contextmanager
+def profile_capture(tag: str = "capture", registry=None):
+    """Capture the enclosed region when ``APEX_TPU_PROFILE_DIR`` is
+    armed; a transparent no-op otherwise.  Emits ``profile_start`` /
+    ``profile_stop`` events so the JSONL log records which captures
+    exist and what they covered."""
+    log_dir = profile_dir()
+    started = start_profile(log_dir) if log_dir else False
+    if started and registry is not None:
+        registry.emit_event("profile_start", dir=log_dir, tag=tag)
+    try:
+        yield started
+    finally:
+        if started:
+            stop_profile()
+            if registry is not None:
+                registry.emit_event("profile_stop", dir=log_dir, tag=tag)

@@ -1,0 +1,146 @@
+"""Training telemetry: step timing, deferred device scalars, and the
+exposed-comm residual.
+
+:class:`TrainTelemetry` instruments a host-driven training loop (see
+:func:`apex_tpu.train_step.instrumented_train_loop`) without violating
+either sacred invariant: the step stays ONE donated executable (the
+timer only brackets its dispatch and counts compile events), and no
+host sync enters the step — loss / found_inf / loss_scale / grad-norm
+are ENQUEUED as device arrays and resolved ONE STEP LATE by the
+:class:`~apex_tpu.observability.deferred.DeferredScalarCollector`, so
+reading them never blocks the next dispatch.
+
+The ``exposed-comm residual`` gauge closes the loop on PR 7's
+overlap-aware step-time model: hand the construction-time
+``comm_model.step_time_estimate(...)["overlap_us"]`` to
+``set_comm_model_us`` and every measured step publishes
+``measured_us - modeled_us`` — the part of the step the model does not
+explain, which is where un-overlapped comm hides.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from apex_tpu.observability.deferred import DeferredScalarCollector
+from apex_tpu.observability.registry import MetricsRegistry
+from apex_tpu.observability.timers import StepTimer
+
+__all__ = ["TrainTelemetry"]
+
+
+class TrainTelemetry:
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 comm_model_us: Optional[float] = None):
+        if registry is None:
+            from apex_tpu.observability import configure_from_env
+            registry = configure_from_env()
+        reg = registry
+        self.registry = reg
+        d = reg.declared
+        self.steps = d("train_steps_total")
+        self.recompiles = d("train_recompiles_total")
+        self.overflow_skips = d("train_overflow_skips_total")
+        self.tokens_per_s = d("train_tokens_per_s")
+        self.loss = d("train_loss")
+        self.loss_scale = d("train_loss_scale")
+        self.grad_norm = d("train_grad_norm")
+        self.exposed_comm_residual_us = d(
+            "train_exposed_comm_residual_us")
+        self.step_seconds = d("train_step_seconds")
+        self._timer = StepTimer()
+        self._collector = DeferredScalarCollector(
+            on_resolve=self._apply_resolved)
+        self._step_index = 0
+        self._prev_stop: Optional[float] = None
+        self._comm_model_us = comm_model_us
+
+    def set_comm_model_us(self, us: Optional[float]) -> None:
+        """Arm the exposed-comm residual gauge with the modeled step
+        time (``comm_model.step_time_estimate(...)["overlap_us"]``)."""
+        self._comm_model_us = us
+
+    # -- per-step -----------------------------------------------------------
+    @contextlib.contextmanager
+    def step(self, tokens: Optional[int] = None):
+        """Bracket one donated step dispatch.
+
+        Timing: on an async-dispatch backend the bracket itself
+        measures only the dispatch (microseconds — the APX110
+        artifact), so the published step time is the INTERVAL between
+        consecutive step completions: at steady state the host loop is
+        rate-limited by the device (via the deferred poll and donated
+        buffers), making the interval the true per-step wall time —
+        with zero added syncs.  The very first COLD step (no prior
+        boundary) reports its own bracket, which there includes the
+        warmup compile the recompile flag deliberately excuses; a WARM
+        step with no prior boundary (first step after ``flush()``) has
+        no honest measurement — its bracket is pure dispatch — so it
+        publishes no timing sample (its ``train_step`` event carries
+        ``seconds: null``)."""
+        self._timer.start()
+        try:
+            yield
+        finally:
+            sample = self._timer.stop()
+            now = time.perf_counter()
+            if self._prev_stop is not None:
+                seconds = now - self._prev_stop
+            elif self._timer.steps_timed == 1:
+                seconds = sample.seconds       # cold: bracket = compile+run
+            else:
+                seconds = None                 # warm, boundary-less
+            self._prev_stop = now
+            self.steps.inc()
+            if sample.recompiled:
+                self.recompiles.inc()
+            if seconds is not None:
+                self.step_seconds.observe(seconds)
+                if tokens:
+                    self.tokens_per_s.set(
+                        tokens / max(seconds, 1e-12))
+                if self._comm_model_us is not None:
+                    self.exposed_comm_residual_us.set(
+                        seconds * 1e6 - self._comm_model_us)
+            self.registry.emit_event(
+                "train_step", step=self._step_index,
+                seconds=(None if seconds is None
+                         else round(seconds, 9)),
+                recompiled=sample.recompiled)
+            self._step_index += 1
+
+    def observe_device(self, loss=None, found_inf=None, loss_scale=None,
+                       grad_norm=None) -> None:
+        """Enqueue this step's device scalars, then poll — landing the
+        PREVIOUS step's scalars on the gauges.  The poll sits here,
+        AFTER this step's enqueue, so it resolves exactly one step
+        back (this step's executable has been dispatched, so blocking
+        on the previous step's outputs costs nothing — the contract
+        :mod:`~apex_tpu.observability.deferred` documents)."""
+        self._collector.enqueue(self._step_index - 1, loss=loss,
+                                found_inf=found_inf,
+                                loss_scale=loss_scale,
+                                grad_norm=grad_norm)
+        self._collector.poll()
+
+    def _apply_resolved(self, step: int, scalars: dict) -> None:
+        if "loss" in scalars:
+            self.loss.set(scalars["loss"])
+        if "loss_scale" in scalars:
+            self.loss_scale.set(scalars["loss_scale"])
+        if "grad_norm" in scalars:
+            self.grad_norm.set(scalars["grad_norm"])
+        if scalars.get("found_inf"):
+            self.overflow_skips.inc()
+
+    def flush(self) -> None:
+        """End-of-run boundary: resolve everything still parked (this
+        one intentionally blocks on the final step) and export sinks.
+        Also closes the step-interval chain — a later run on the same
+        telemetry must not record the idle gap between runs as a
+        step-time sample."""
+        self._collector.drain()
+        self._prev_stop = None
+        self.registry.export()

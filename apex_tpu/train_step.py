@@ -48,8 +48,8 @@ from apex_tpu.optimizers.functional import (FlatState, _layout_master,
                                             _normalize_prefetch)
 
 __all__ = ["TrainState", "init_train_state", "init_zero_train_state",
-           "make_train_step", "train_loop", "leaf_offsets",
-           "zero_prefetch_default"]
+           "make_train_step", "train_loop", "instrumented_train_loop",
+           "leaf_offsets", "zero_prefetch_default"]
 
 
 def zero_prefetch_default() -> int:
@@ -292,6 +292,85 @@ def train_loop(loss_fn, tx, **step_kwargs):
     def run(state: TrainState, batches):
         return jax.lax.scan(step, state, batches)
 
+    return run
+
+
+def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
+                            tokens_per_batch: Optional[int] = None,
+                            **step_kwargs):
+    """Telemetry-instrumented ``run(state, batches) -> (state, metrics)``
+    (ISSUE 8): the same pure step as :func:`train_loop`, jitted ONCE
+    with the state donated, but driven host-side one step at a time so
+    runtime signals exist — the scanned loop is a single opaque
+    executable with nothing observable between steps.
+
+    Invariants preserved (and pinned by ``tests/L1/test_observability``):
+    the step stays ONE donated executable (steps after the first add
+    zero compiles — the telemetry's recompile counter stays 0), and no
+    host sync is added anywhere — the
+    :class:`~apex_tpu.observability.train.TrainTelemetry` only brackets
+    the dispatch with the dispatch-aware timer and ENQUEUES the step's
+    device scalars (loss, ``found_inf``, ``loss_scale``), which resolve
+    one step late via the deferred collector, after the next step has
+    been dispatched.
+
+    ``metrics`` is the per-step metrics list (device values; stack or
+    ``telemetry.flush()`` at the boundary).  Step-loop overhead is the
+    per-step dispatch the scan amortizes — use :func:`train_loop` when
+    nothing needs observing.
+    """
+    from apex_tpu.observability import TrainTelemetry
+
+    if telemetry is None:
+        telemetry = TrainTelemetry()
+    step = make_train_step(loss_fn, tx, **step_kwargs)
+
+    def _step_with_overflow(state, batch):
+        new_state, m = step(state, batch)
+        sc_in, sc_out = state.scaler, new_state.scaler
+        overflow = None
+        if sc_out is not None:
+            # found_inf is consumed in-program (the update kernel's
+            # noop_flag) and cleared by update_scale, so it cannot be
+            # read back.  A dynamic scale strictly DECREASES only on an
+            # overflow backoff, so this compare recovers the flag as a
+            # FRESH in-program value (unlike a passthrough of a donated
+            # buffer, it can never be aliased away by the next step's
+            # donation).  Saturates at the min_scale floor and is
+            # always-False for fixed scales — both already-broken or
+            # skip-free regimes.
+            overflow = sc_out.loss_scale < sc_in.loss_scale
+        return new_state, (m, overflow)
+
+    jstep = jax.jit(_step_with_overflow, donate_argnums=(0,))
+
+    def snap(x):
+        # the scaler scalars live INSIDE the donated state: the NEXT
+        # dispatch consumes their buffers, so the deferred read would
+        # find them deleted.  jnp.copy is an async device-side copy to
+        # an independent buffer — no host sync, one tiny executable
+        # compiled once.  (The loss needs none of this: metrics outputs
+        # are not donated.)
+        return None if x is None else jnp.copy(x)
+
+    def run(state: TrainState, batches):
+        n = jax.tree.leaves(batches)[0].shape[0]
+        metrics = []
+        for i in range(n):
+            batch = jax.tree.map(lambda x: x[i], batches)
+            with telemetry.step(tokens=tokens_per_batch):
+                state, (m, overflow) = jstep(state, batch)
+            loss = m[0] if isinstance(m, tuple) else m
+            sc = state.scaler
+            telemetry.observe_device(
+                loss=loss,
+                found_inf=overflow,
+                loss_scale=None if sc is None else snap(sc.loss_scale))
+            metrics.append(m)
+        telemetry.flush()          # end-of-run boundary: blocking is fine
+        return state, metrics
+
+    run.telemetry = telemetry
     return run
 
 

@@ -1,80 +1,22 @@
-"""Structured training metrics + profiler annotations.
+"""Compatibility shim: the metrics/annotation surface moved to
+:mod:`apex_tpu.observability` (ISSUE 8).
 
-SURVEY.md §5: the reference has no first-class tracing/metrics subsystem —
-only scattered nvtx ranges in contrib and the transformer logger.  The
-rebuild ships the small strictly-better version the survey prescribes:
-
-* ``trace_annotation``/``named_scope`` — ``jax.profiler`` ranges (the nvtx
-  analog; they show up in TensorBoard/xprof traces);
-* ``Metrics`` — a tiny registry for the numbers BASELINE tracking needs
-  (steps/sec, loss scale, overflow count, collective bytes), exportable as
-  one dict/JSON line.
+This module was the pre-observability home of ``trace_annotation`` /
+``named_scope`` / ``Metrics`` / ``global_metrics`` (SURVEY.md §5's
+"small strictly-better" tracing story).  The documented API survives
+verbatim as re-exports; new code should import from
+``apex_tpu.observability``, which adds the full runtime-telemetry
+subsystem (labeled registry, JSONL/Prometheus sinks, deferred
+device-scalar collection, dispatch-aware step timing, profiler
+capture).
 """
 from __future__ import annotations
 
-import collections
-import contextlib
-import json
-import time
-from typing import Dict, Optional
-
-import jax
+from apex_tpu.observability import (  # noqa: F401
+    Metrics,
+    global_metrics,
+    named_scope,
+    trace_annotation,
+)
 
 __all__ = ["trace_annotation", "named_scope", "Metrics", "global_metrics"]
-
-
-def trace_annotation(name: str):
-    """Context manager marking a host-side region in profiler traces
-    (analog of ``torch.cuda.nvtx.range``)."""
-    return jax.profiler.TraceAnnotation(name)
-
-
-def named_scope(name: str):
-    """Context manager naming ops traced inside (shows in XLA HLO/xprof)."""
-    return jax.named_scope(name)
-
-
-class Metrics:
-    """Counters/gauges/rates with one-line JSON export."""
-
-    def __init__(self):
-        self._counters: Dict[str, float] = collections.defaultdict(float)
-        self._gauges: Dict[str, float] = {}
-        self._step_times: collections.deque = collections.deque(maxlen=64)
-        self._last_step: Optional[float] = None
-
-    # -- the BASELINE-relevant numbers --------------------------------------
-    def count(self, name: str, delta: float = 1.0) -> None:
-        self._counters[name] += delta
-
-    def gauge(self, name: str, value) -> None:
-        self._gauges[name] = float(value)
-
-    def step(self) -> None:
-        """Mark a train-step boundary (drives steps/sec)."""
-        now = time.perf_counter()
-        if self._last_step is not None:
-            self._step_times.append(now - self._last_step)
-        self._last_step = now
-        self._counters["steps"] += 1
-
-    @property
-    def steps_per_sec(self) -> float:
-        if not self._step_times:
-            return 0.0
-        return len(self._step_times) / sum(self._step_times)
-
-    def snapshot(self) -> dict:
-        out = dict(self._gauges)
-        out.update(self._counters)
-        out["steps_per_sec"] = round(self.steps_per_sec, 3)
-        return out
-
-    def json_line(self) -> str:
-        return json.dumps(self.snapshot(), sort_keys=True)
-
-    def reset(self) -> None:
-        self.__init__()
-
-
-global_metrics = Metrics()

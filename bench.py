@@ -143,7 +143,10 @@ def _rtt() -> float:
     for _ in range(3):
         t0 = time.perf_counter()
         jax.device_get(triv(jnp.float32(1)))
-        best = min(best, time.perf_counter() - t0)
+        # measuring the RAW dispatch round-trip is this function's whole
+        # job (every leg subtracts it) — the one place the dispatch-
+        # aware timer must not be used
+        best = min(best, time.perf_counter() - t0)  # apex-lint: disable=APX110
     return best
 
 
@@ -886,6 +889,39 @@ def _microbench_infer(rtt: float, on_tpu: bool):
         out["infer_page_size"] = page_size
         out["infer_pages"] = engine.num_pages
         out["infer_paged_xla_max_pages"] = paged_xla_max_pages()
+
+    # serve-path telemetry stamp (ISSUE 8): a short wave through the
+    # REAL continuous-batching scheduler over a private registry — the
+    # runtime signals the offline loops above cannot see: TTFT and
+    # per-token decode latency WITH the host token-read, plus the
+    # recompile counter (must read 0 — the ONE-executable property
+    # under live admit/retire).  Prompts reuse the leg's prefill length
+    # so the warm bucket executable serves the wave (no extra compile).
+    from apex_tpu.inference import SlotScheduler
+    from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+
+    host_prompt = np.asarray(prompt)
+    # warm the ENGINE's own executables first (the loops above jit
+    # their own step fns): the measured wave must not fold the warmup
+    # compile into its TTFT/latency samples
+    warm = SlotScheduler(engine,
+                         telemetry=ServeTelemetry(MetricsRegistry()))
+    warm.submit(list(host_prompt), max_new_tokens=2)
+    warm.run()
+
+    tel = ServeTelemetry(MetricsRegistry())
+    sched = SlotScheduler(engine, telemetry=tel)
+    n_req = slots + 1                   # forces one retire/readmit
+    for i in range(n_req):
+        sched.submit(list((host_prompt + i) % cfg.vocab_size),
+                     max_new_tokens=min(4, max_seq - prefill_len - 1))
+    sched.run()
+    s = tel.summary()
+    out["infer_serve_requests"] = s["requests"]
+    out["infer_serve_recompiles"] = s["recompiles"]
+    out["infer_serve_ttft_us"] = round(s["ttft_mean_s"] * 1e6, 1)
+    out["infer_serve_decode_token_us"] = round(
+        s["decode_token_mean_s"] * 1e6, 1)
     return out
 
 
@@ -1115,8 +1151,11 @@ def _bench_main(force_cpu: bool = False) -> None:
         fused_step = lambda s, b: zstep(s, b)[0]        # noqa: E731
 
     # Fused leg is THE metric: hard-fail (after retries) if it can't run.
-    t_fused = _bench_loop(fused_step, fused_state, batch_args, iters, rtt,
-                          shard=zero_shard)
+    # APEX_TPU_PROFILE_DIR=<dir> captures a jax.profiler trace of it.
+    from apex_tpu.observability import profile_capture
+    with profile_capture(tag="bench_main_fused"):
+        t_fused = _bench_loop(fused_step, fused_state, batch_args, iters,
+                              rtt, shard=zero_shard)
     # Baseline + microbench legs are auxiliary: degrade to null.
     t_naive = _aux(
         lambda: _bench_loop(naive_step, state, batch_args, iters, rtt),
@@ -1155,9 +1194,16 @@ def _bench_main(force_cpu: bool = False) -> None:
 
 
 def _bench_micro_leg(name: str, force_cpu: bool = False) -> None:
-    """Run ONE microbench leg and print its extras dict as a JSON line."""
+    """Run ONE microbench leg and print its extras dict as a JSON line.
+
+    ``APEX_TPU_PROFILE_DIR=<dir>`` drops a ``jax.profiler`` trace of the
+    whole leg there (transparent no-op otherwise) — grabbing a device
+    trace of any leg is one environment variable, zero code edits."""
+    from apex_tpu.observability import profile_capture
+
     on_tpu, rtt = _bench_setup(force_cpu)
-    res = MICRO_LEGS[name](rtt, on_tpu)
+    with profile_capture(tag=f"bench_{name}"):
+        res = MICRO_LEGS[name](rtt, on_tpu)
     res["_leg"] = name
     print(json.dumps(res))
 
@@ -1281,6 +1327,14 @@ _MAX_PLAUSIBLE_SPEEDUP = 100.0
 #: the us==0.0 artifact's other face (tokens / garbage-negative time).
 _MAX_PLAUSIBLE_TOKENS_PER_S = 1e8
 
+#: latency sanity ceiling for ``*_us`` capture fields (ISSUE 8: the
+#: telemetry TTFT / per-token decode latencies now ride in captures).
+#: One HOUR for a single step/request latency is not physics — it is a
+#: stuck tunnel, a wedged profiler, or a unit bug (seconds stamped into
+#: a ``_us`` field would read ~1e6x small, its inverse ~1e6x large);
+#: negatives are clock-skew garbage, 0.0 the RTT-collapse artifact.
+_MAX_PLAUSIBLE_LATENCY_US = 3.6e9
+
 
 def _is_us_key(key: str) -> bool:
     return key == "us" or key.endswith("_us") or key.startswith("us_")
@@ -1292,12 +1346,14 @@ def _is_tokens_per_s_key(key: str) -> bool:
 
 def _scrub_capture_values(obj):
     """Drop physically impossible values from a capture payload
-    (recursively): ``*_us``/``us_*`` fields that read exactly 0.0 (the
-    RTT-collapse artifact — covers the decode-latency fields too),
-    ``*_speedup`` fields above ``_MAX_PLAUSIBLE_SPEEDUP``, and
-    ``*tokens_per_s`` throughputs that are non-positive or beyond
-    ``_MAX_PLAUSIBLE_TOKENS_PER_S``.  Returns a scrubbed copy;
-    containers are preserved, only the corrupt scalar fields vanish."""
+    (recursively): ``*_us``/``us_*`` latency fields that are
+    non-positive (0.0 = the RTT-collapse artifact, negatives =
+    clock-skew garbage) or beyond ``_MAX_PLAUSIBLE_LATENCY_US`` (covers
+    the telemetry TTFT / decode-latency fields), ``*_speedup`` fields
+    above ``_MAX_PLAUSIBLE_SPEEDUP``, and ``*tokens_per_s`` throughputs
+    that are non-positive or beyond ``_MAX_PLAUSIBLE_TOKENS_PER_S``.
+    Returns a scrubbed copy; containers are preserved, only the corrupt
+    scalar fields vanish."""
     if isinstance(obj, dict):
         out = {}
         for k, v in obj.items():
@@ -1305,7 +1361,8 @@ def _scrub_capture_values(obj):
                 out[k] = _scrub_capture_values(v)
                 continue
             if isinstance(v, (int, float)) and not isinstance(v, bool):
-                if _is_us_key(k) and v == 0.0:
+                if _is_us_key(k) and \
+                        not 0.0 < v <= _MAX_PLAUSIBLE_LATENCY_US:
                     continue
                 if (k == "speedup" or k.endswith("_speedup")) \
                         and v > _MAX_PLAUSIBLE_SPEEDUP:

@@ -19,6 +19,7 @@ continuations visibly count upward — a one-glance correctness check.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -30,7 +31,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))                # repo root on sys.path
 
-from apex_tpu.inference import InferenceEngine, SamplingConfig
+from apex_tpu import observability as obs
+from apex_tpu.inference import InferenceEngine, SamplingConfig, \
+    SlotScheduler
 from apex_tpu.optimizers import functional
 from apex_tpu import train_step
 from apex_tpu.transformer import parallel_state
@@ -199,14 +202,25 @@ def main(argv=None):
         n = rng.randint(4, 12)
         prompts.append([(start + i) % args.vocab for i in range(n)])
 
+    # serve through the scheduler explicitly (what engine.generate
+    # wraps) so its telemetry is in hand; APEX_TPU_PROFILE_DIR=<dir>
+    # drops a jax.profiler trace of the serve, APEX_TPU_TELEMETRY=<dir>
+    # writes the JSONL event log + Prometheus file alongside
+    sched = SlotScheduler(engine)
     t0 = time.perf_counter()
-    outs = engine.generate(prompts, max_new_tokens=args.max_new_tokens)
+    with obs.profile_capture(tag="generate",
+                             registry=sched.telemetry.registry):
+        uids = [sched.submit(p, max_new_tokens=args.max_new_tokens)
+                for p in prompts]
+        out = sched.run()
     dt = time.perf_counter() - t0
+    outs = [out[u] for u in uids]
     n_new = sum(len(o) for o in outs)
     for p, o in zip(prompts, outs):
         print(f"  prompt {p} -> {o}")
     print(f"{n_new} tokens in {dt:.2f}s "
           f"({n_new / dt:.1f} tok/s incl. compile)")
+    print(f"telemetry: {json.dumps(sched.telemetry.summary())}")
     if args.train_steps and args.temperature == 0.0:
         want = [[(p[-1] + 1 + i) % args.vocab
                  for i in range(len(o))] for p, o in zip(prompts, outs)]

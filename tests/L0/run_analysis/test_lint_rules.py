@@ -171,6 +171,36 @@ def crossover():
     return int(os.environ.get(_ENV, "256"))
 ''',
     ),
+    "APX110": (
+        '''
+import time
+
+import jax
+
+step = jax.jit(lambda s, b: s + b)
+
+def run(state, batches):
+    for batch in batches:
+        t0 = time.perf_counter()
+        state = step(state, batch)
+        dt = time.perf_counter() - t0     # measures DISPATCH, not step
+    return state, dt
+''',
+        '''
+import jax
+
+from apex_tpu.observability import StepTimer
+
+step = jax.jit(lambda s, b: s + b)
+
+def run(state, batches):
+    timer = StepTimer()                   # dispatch-aware: reports the
+    for batch in batches:                 # compile delta, flags recompiles
+        with timer.time_step():
+            state = step(state, batch)
+    return state, timer.last.seconds
+''',
+    ),
     "APX109": (
         '''
 import jax
@@ -224,6 +254,29 @@ def test_clean_fixtures_fully_clean():
 
 
 # --- engine behaviours ------------------------------------------------------
+
+def test_apx110_ignores_clocks_in_nested_scopes():
+    """A clock read inside a nested helper cannot close a timing
+    bracket in the enclosing function — no cross-scope false
+    positive."""
+    src = '''
+import time
+
+import jax
+
+step = jax.jit(lambda s, b: s + b)
+
+def run(state, batch):
+    t0 = time.perf_counter()        # host timing of non-jit work
+    state = step(state, batch)
+
+    def helper():                   # separate scope: not a bracket
+        return time.perf_counter()
+
+    return state, helper
+'''
+    assert "APX110" not in rules_of(src)
+
 
 def test_syntax_error_is_a_finding():
     fs = lint_source("def broken(:\n", "broken.py")

@@ -1,0 +1,99 @@
+"""DeferredScalarCollector: the one-step-late contract, proven.
+
+The collector exists so telemetry never blocks dispatch: a device
+scalar from step N is only materialized after step N+1 has been
+ENQUEUED (i.e. dispatched by the caller).  These tests use a probe
+whose ``__array__`` records the moment of materialization, so the
+contract "poll() never touches arrays from the newest step" is
+observed directly, not inferred."""
+import numpy as np
+import pytest
+
+from apex_tpu.observability import DeferredScalarCollector
+
+
+class _Probe:
+    """Stands in for a device array: materialization (np.asarray ->
+    __array__) is observable."""
+
+    def __init__(self, value: float):
+        self.value = value
+        self.materialized = False
+
+    def __array__(self, dtype=None, copy=None):
+        self.materialized = True
+        return np.asarray(self.value, dtype=dtype)
+
+
+def test_poll_resolves_only_strictly_prior_steps():
+    col = DeferredScalarCollector()
+    p0, p1 = _Probe(1.0), _Probe(2.0)
+    col.enqueue(0, loss=p0)
+    assert col.poll() == []            # step 0 is the newest: parked
+    assert not p0.materialized
+
+    col.enqueue(1, loss=p1)
+    resolved = col.poll()
+    assert resolved == [(0, {"loss": 1.0})]
+    assert p0.materialized             # prior step: read
+    assert not p1.materialized         # newest step: NEVER read by poll
+    assert col.pending == 1
+
+
+def test_poll_catches_up_across_many_steps():
+    col = DeferredScalarCollector()
+    probes = [_Probe(float(i)) for i in range(4)]
+    for i, p in enumerate(probes[:3]):
+        col.enqueue(i, loss=p)
+    col.enqueue(3, loss=probes[3])
+    out = col.poll()
+    assert [(s, d["loss"]) for s, d in out] == \
+        [(0, 0.0), (1, 1.0), (2, 2.0)]
+    assert not probes[3].materialized
+
+
+def test_drain_is_the_blocking_boundary():
+    col = DeferredScalarCollector()
+    p = _Probe(7.0)
+    col.enqueue(0, loss=p)
+    assert col.drain() == [(0, {"loss": 7.0})]
+    assert p.materialized              # drain DOES block on the newest
+    assert col.pending == 0
+
+
+def test_none_values_dropped_so_optional_signals_pass_through():
+    col = DeferredScalarCollector()
+    col.enqueue(0, loss=_Probe(1.0), grad_norm=None)
+    col.enqueue(1, loss=_Probe(2.0))
+    [(_, resolved)] = col.poll()
+    assert resolved == {"loss": 1.0}   # no grad_norm key
+
+
+def test_enqueue_is_forward_only():
+    col = DeferredScalarCollector()
+    col.enqueue(3, loss=_Probe(1.0))
+    with pytest.raises(ValueError, match="forward-only"):
+        col.enqueue(2, loss=_Probe(0.0))
+    col.enqueue(3, loss=_Probe(2.0))   # same step is fine (re-enqueue)
+
+
+def test_on_resolve_hook_fires_per_entry():
+    seen = []
+    col = DeferredScalarCollector(
+        on_resolve=lambda step, d: seen.append((step, d)))
+    col.enqueue(0, loss=_Probe(1.0))
+    col.enqueue(1, loss=_Probe(2.0))
+    col.poll()
+    assert seen == [(0, {"loss": 1.0})]
+    col.drain()
+    assert seen == [(0, {"loss": 1.0}), (1, {"loss": 2.0})]
+
+
+def test_works_on_real_jax_arrays():
+    jnp = pytest.importorskip("jax.numpy")
+    col = DeferredScalarCollector()
+    col.enqueue(0, loss=jnp.float32(1.5), found_inf=jnp.bool_(True))
+    col.enqueue(1, loss=jnp.float32(2.5))
+    [(step, resolved)] = col.poll()
+    assert step == 0
+    assert resolved == {"loss": 1.5, "found_inf": 1.0}

@@ -1,0 +1,155 @@
+"""Metrics registry unit tests: counters/gauges/labels semantics and
+the histogram bucketing contract (ISSUE 8 L0 coverage)."""
+import pytest
+
+from apex_tpu.observability import (Counter, Gauge, Histogram,
+                                    MetricsRegistry)
+from apex_tpu.observability import schema
+
+
+# -- counters / gauges -------------------------------------------------------
+
+def test_counter_accumulates_and_rejects_decrease():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == 3.5
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+
+
+def test_counter_labels_are_independent_series():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "help", labels=("reason",))
+    c.inc(reason="eos")
+    c.inc(reason="eos")
+    c.inc(reason="length")
+    assert c.value(reason="eos") == 2
+    assert c.value(reason="length") == 1
+    assert c.value(reason="never") == 0
+    assert c.total() == 3
+
+
+def test_label_names_must_match_declaration():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "help", labels=("reason",))
+    with pytest.raises(ValueError, match="declared label"):
+        c.inc(cause="eos")
+    with pytest.raises(ValueError, match="declared label"):
+        c.inc()                      # missing the declared label
+
+
+def test_gauge_set_and_set_max_ratchet():
+    reg = MetricsRegistry()
+    g = reg.gauge("g", "help")
+    assert g.value() is None
+    g.set(3)
+    g.set(1)
+    assert g.value() == 1.0          # plain set overwrites
+    g.set_max(5)
+    g.set_max(2)
+    assert g.value() == 5.0          # ratchet keeps the peak
+
+
+def test_create_or_get_is_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "help")
+    assert reg.counter("x_total") is a
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+
+
+# -- histogram bucketing -----------------------------------------------------
+
+def test_histogram_bucketing_boundaries():
+    """A sample lands in the FIRST bucket whose upper bound covers it
+    (le semantics: boundary values land in their own bucket), overflow
+    goes to +Inf."""
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 9.9, 11.0):
+        h.observe(v)
+    # raw (non-cumulative) landing: [<=0.1]=2 (0.05, 0.1 itself),
+    # (0.1,1.0]=2, (1.0,10]=1, +Inf=1
+    assert h._values[()]["counts"] == [2, 2, 1, 1]
+    # cumulative _bucket{le=} series (what Prometheus exposes)
+    assert h.cumulative_counts() == [2, 4, 5, 6]
+    assert h.count() == 6
+    assert h.sum() == pytest.approx(0.05 + 0.1 + 0.5 + 1.0 + 9.9 + 11.0)
+
+
+def test_histogram_buckets_sorted_and_required():
+    reg = MetricsRegistry()
+    h = reg.histogram("h2_seconds", "help", buckets=(1.0, 0.1, 10.0))
+    assert h.buckets == (0.1, 1.0, 10.0)     # sorted on construction
+    with pytest.raises(ValueError, match="needs buckets"):
+        reg.histogram("h3_seconds", "help")
+
+
+def test_histogram_quantile_is_bucket_resolution():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "help", buckets=(0.001, 0.01, 0.1))
+    assert h.quantile(0.5) is None           # empty
+    for _ in range(99):
+        h.observe(0.005)
+    h.observe(0.05)
+    assert h.quantile(0.5) == 0.01           # bucket upper bound
+    assert h.quantile(0.99) == 0.01
+    assert h.quantile(1.0) == 0.1
+    h.observe(1e9)                           # +Inf mass
+    assert h.quantile(1.0) == 0.1            # reports largest finite
+
+
+def test_histogram_labeled_series_are_independent():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "help", labels=("leg",),
+                      buckets=(1.0,))
+    h.observe(0.5, leg="a")
+    h.observe(2.0, leg="b")
+    assert h.count(leg="a") == 1
+    assert h.count(leg="b") == 1
+    assert h.cumulative_counts(leg="a") == [1, 1]
+    assert h.cumulative_counts(leg="b") == [0, 1]
+
+
+# -- schema-declared creation (the only production path) ---------------------
+
+def test_declared_creates_from_schema_and_rejects_unknown():
+    reg = MetricsRegistry()
+    h = reg.declared("serve_ttft_seconds")
+    assert isinstance(h, Histogram)
+    assert h.buckets == schema.METRIC_SPECS["serve_ttft_seconds"].buckets
+    c = reg.declared("serve_requests_finished_total")
+    assert isinstance(c, Counter)
+    assert c.labels == ("reason",)
+    with pytest.raises(KeyError, match="not declared"):
+        reg.declared("made_up_metric")
+
+
+def test_every_declared_family_instantiates():
+    """Every spec in the pinned schema constructs the right instrument
+    kind — a spec typo cannot lurk until first runtime use."""
+    reg = MetricsRegistry()
+    kinds = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+    for name, spec in schema.METRIC_SPECS.items():
+        inst = reg.declared(name)
+        assert isinstance(inst, kinds[spec.kind]), name
+
+
+def test_emit_event_rejects_undeclared_kind():
+    reg = MetricsRegistry()
+    with pytest.raises(KeyError, match="not declared"):
+        reg.emit_event("made_up_event", x=1)
+
+
+def test_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "h").inc(2)
+    reg.gauge("b", "h").set(7)
+    h = reg.histogram("c_seconds", "h", labels=("leg",), buckets=(1.0,))
+    h.observe(0.5, leg="x")
+    snap = reg.snapshot()
+    assert snap["counters"] == {"a_total": 2.0}
+    assert snap["gauges"] == {"b": 7.0}
+    assert snap["histograms"]["c_seconds{leg=x}"]["count"] == 1

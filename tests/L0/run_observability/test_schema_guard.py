@@ -1,0 +1,56 @@
+"""Tier-1 guard pinning the telemetry schema (ISSUE 8 satellite).
+
+Dashboards and log pipelines consume the Prometheus file and the JSONL
+stream by FIELD NAME; renaming a family or an event field must be a
+conscious act.  Exactly like the SPMD budget-ledger guard
+(``tests/L1/test_spmd_audit.py``): the committed
+``.telemetry_schema.json`` must match the in-code declarations
+bit-for-bit, and re-pinning is an explicit command::
+
+    python -m apex_tpu.observability.schema --write
+"""
+import json
+from pathlib import Path
+
+from apex_tpu.analysis.cli import repo_root
+from apex_tpu.observability import schema
+
+REPO = Path(repo_root())
+
+
+def test_committed_schema_is_current_bit_for_bit():
+    committed_text = (REPO / schema.SCHEMA_NAME).read_text(
+        encoding="utf-8")
+    expected_text = json.dumps(schema.current_schema(), indent=1) + "\n"
+    assert committed_text == expected_text, (
+        "telemetry schema drifted from the committed "
+        f"{schema.SCHEMA_NAME} — if the change is intentional, re-pin "
+        "with `python -m apex_tpu.observability.schema --write` and "
+        "commit (dashboards parse these names)")
+
+
+def test_schema_pins_types_and_buckets_not_just_names():
+    """The guard covers the full wire contract: kind, labels, buckets
+    (histograms), and per-event field TYPES."""
+    committed = json.loads((REPO / schema.SCHEMA_NAME).read_text())
+    assert committed["version"] == schema.SCHEMA_VERSION
+    for name, spec in schema.METRIC_SPECS.items():
+        entry = committed["prometheus"][name]
+        assert entry["type"] == spec.kind, name
+        assert tuple(entry["labels"]) == spec.labels, name
+        if spec.kind == "histogram":
+            assert tuple(entry["buckets"]) == spec.buckets, name
+    for kind, fields in schema.EVENT_FIELDS.items():
+        assert committed["jsonl"]["events"][kind] == fields, kind
+    assert committed["jsonl"]["common"] == schema.COMMON_EVENT_FIELDS
+
+
+def test_histogram_buckets_are_sorted_positive():
+    """Non-physical bucket layouts (unsorted, non-positive bounds) are
+    schema bugs — latencies cannot be <= 0."""
+    for name, spec in schema.METRIC_SPECS.items():
+        if spec.kind != "histogram":
+            continue
+        assert list(spec.buckets) == sorted(spec.buckets), name
+        assert all(b > 0 for b in spec.buckets), name
+        assert len(set(spec.buckets)) == len(spec.buckets), name

@@ -1,0 +1,110 @@
+"""Sink unit tests: Prometheus text-exposition format and the JSONL
+event stream — the two surfaces dashboards consume, so the assertions
+here are EXACT-text, not shape checks."""
+import json
+
+from apex_tpu.observability import (JsonlSink, MetricsRegistry,
+                                    PrometheusSink, render_prometheus)
+
+
+def _small_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests", labels=("reason",)) \
+       .inc(3, reason="eos")
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(0.5)
+    h.observe(5.0)
+    return reg
+
+
+def test_prometheus_exposition_exact_text():
+    text = render_prometheus(_small_registry())
+    assert text == (
+        "# HELP depth queue depth\n"
+        "# TYPE depth gauge\n"
+        "depth 2\n"
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="1"} 3\n'
+        'lat_seconds_bucket{le="+Inf"} 4\n'
+        "lat_seconds_sum 6.05\n"
+        "lat_seconds_count 4\n"
+        "# HELP req_total requests\n"
+        "# TYPE req_total counter\n"
+        'req_total{reason="eos"} 3\n'
+    )
+
+
+def test_prometheus_bucket_series_is_cumulative():
+    """_bucket{le=} values are CUMULATIVE (Prometheus semantics), and
+    the +Inf bucket equals _count."""
+    text = render_prometheus(_small_registry())
+    lines = [ln for ln in text.splitlines() if "_bucket" in ln]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
+    assert counts == sorted(counts)
+    inf = next(ln for ln in lines if 'le="+Inf"' in ln)
+    total = next(ln for ln in text.splitlines()
+                 if ln.startswith("lat_seconds_count"))
+    assert inf.rsplit(" ", 1)[1] == total.rsplit(" ", 1)[1]
+
+
+def test_prometheus_value_formatting():
+    """Integral values print without a decimal point; floats use a
+    stable shortest form (no 2.5000000001 artifacts)."""
+    reg = MetricsRegistry()
+    reg.gauge("a", "h").set(4.0)
+    reg.gauge("b", "h").set(0.1 + 0.2)
+    text = render_prometheus(reg)
+    assert "a 4\n" in text
+    assert "b 0.3\n" in text
+
+
+def test_prometheus_empty_registry_renders_empty():
+    assert render_prometheus(MetricsRegistry()) == ""
+
+
+def test_prometheus_unlabeled_zero_counter_exposes_explicit_zero():
+    """The pinned-zero families (serve_recompiles_total) must scrape as
+    0, not be absent, so dashboards can alert on them going nonzero."""
+    reg = MetricsRegistry()
+    reg.counter("recompiles_total", "h")
+    reg.counter("labeled_total", "h", labels=("reason",))
+    text = render_prometheus(reg)
+    assert "recompiles_total 0\n" in text
+    # labeled counters can't enumerate unseen label values: headers only
+    assert "labeled_total{" not in text
+
+
+def test_prometheus_sink_atomic_export(tmp_path):
+    path = tmp_path / "metrics.prom"
+    reg = _small_registry()
+    reg.add_sink(PrometheusSink(str(path)))
+    reg.export()
+    first = path.read_text()
+    assert first == render_prometheus(reg)
+    reg.counter("req_total").inc(reason="eos")
+    reg.export()                       # rewrite, not append
+    assert 'req_total{reason="eos"} 4' in path.read_text()
+    # no temp-file litter from the atomic-rename dance
+    assert [p.name for p in tmp_path.iterdir()] == ["metrics.prom"]
+
+
+def test_jsonl_sink_appends_schema_shaped_lines(tmp_path):
+    path = tmp_path / "telemetry.jsonl"
+    reg = MetricsRegistry()
+    reg.add_sink(JsonlSink(str(path)))
+    reg.emit_event("request_submit", uid=1, prompt_len=4,
+                   max_new_tokens=8, queue_depth=1)
+    reg.emit_event("request_finish", uid=1, reason="eos", tokens=3,
+                   e2e_s=0.25)
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    first, second = (json.loads(ln) for ln in lines)
+    assert first["kind"] == "request_submit" and first["uid"] == 1
+    assert second["kind"] == "request_finish" and second["reason"] == "eos"
+    for obj in (first, second):
+        assert isinstance(obj["ts"], float)     # common fields present

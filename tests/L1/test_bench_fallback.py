@@ -124,6 +124,30 @@ def test_capture_scrubber_covers_inference_fields():
     assert out["infer_shape"] == [8, 512, 8, 1024]
 
 
+def test_capture_scrubber_rejects_nonphysical_ttft_and_latency():
+    """ISSUE 8 satellite: the serve-telemetry latencies the infer leg
+    now stamps (TTFT, per-token decode with host read) get the full
+    physicality check — negatives (clock skew) and > 1 h single-request
+    latencies (stuck tunnel / seconds-vs-us unit bug) vanish alongside
+    the existing 0.0 artifact; plausible values and the non-latency
+    telemetry counters survive."""
+    payload = {
+        "infer_serve_ttft_us": -125.0,             # clock-skew garbage
+        "infer_serve_decode_token_us": 7.2e9,      # > 1 h per token
+        "infer_prefill_us": 0.0,                   # RTT collapse (old rule)
+        "infer_decode_token_us": 812.5,            # plausible
+        "infer_serve_requests": 9,                 # counter: not latency
+        "infer_serve_recompiles": 0,               # pinned-zero counter
+    }
+    out = bench._scrub_capture_values(payload)
+    assert "infer_serve_ttft_us" not in out
+    assert "infer_serve_decode_token_us" not in out
+    assert "infer_prefill_us" not in out
+    assert out["infer_decode_token_us"] == 812.5
+    assert out["infer_serve_requests"] == 9
+    assert out["infer_serve_recompiles"] == 0      # 0 is a VALUE here
+
+
 def test_degraded_capture_carries_value_tpu_best_top_level():
     """The recorded on-chip throughput must surface as a first-class
     top-level sibling of `value` on the degraded path — and never on the
