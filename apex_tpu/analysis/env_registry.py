@@ -85,6 +85,26 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "captures",
         read_by="apex_tpu/inference/kv_cache.py"),
     EnvKnob(
+        name="APEX_TPU_XENT_CHUNK",
+        default="0",
+        effect="token-chunk size of the fused LM-head+cross-entropy "
+               "(the [tokens, vocab] logits never materialize; the "
+               "backward re-projects per chunk) used by loss heads "
+               "when fused_head_xent=/token_chunk= is not passed; 0 "
+               "keeps the unfused dense logits; stamped into "
+               "xent_fused bench captures as xent_chunk",
+        read_by="apex_tpu/ops/fused_lm_xent.py"),
+    EnvKnob(
+        name="APEX_TPU_XENT_VOCAB_CHUNK",
+        default="0",
+        effect="vocab-chunk size of the fused LM-head+cross-entropy's "
+               "inner online-logsumexp scan (shrinks the per-chunk "
+               "logits transient to [token_chunk, vocab_chunk]; must "
+               "divide the vocab) when vocab_chunk= is not passed; 0 "
+               "projects the whole vocab per token chunk; stamped "
+               "into xent_fused bench captures as xent_vocab_chunk",
+        read_by="apex_tpu/ops/fused_lm_xent.py"),
+    EnvKnob(
         name="APEX_TPU_TELEMETRY",
         default="0",
         effect="runtime telemetry sink directory: a path attaches the "

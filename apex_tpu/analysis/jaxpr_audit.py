@@ -111,6 +111,16 @@ def _builders():
         return (lambda l, y: op(l, y),
                 (s((8, 128), bf16), s((8,), jnp.int32)))
 
+    def fused_lm_xent():
+        from apex_tpu.ops import fused_lm_head_cross_entropy as op
+        # traced fused (chunked) so the scan + custom_vjp bodies are
+        # walked; the chunk=0 lowering is the already-audited xentropy
+        # op plus a matmul
+        return (lambda h, w, y: op(h, w, y, token_chunk=32,
+                                   vocab_chunk=0),
+                (s((96, 64), bf16), s((512, 64), bf16),
+                 s((96,), jnp.int32)))
+
     def fused_adam():
         from apex_tpu.ops import fused_adam_flat as op
         p = s((256,), f32)
@@ -241,6 +251,8 @@ def _builders():
                               ("bfloat16",), 0),
         "xentropy": (xentropy, "apex_tpu/ops/xentropy.py",
                      ("float32",), 0),
+        "fused_lm_xent": (fused_lm_xent, "apex_tpu/ops/fused_lm_xent.py",
+                          ("float32",), 0),
         "fused_adam": (fused_adam, "apex_tpu/ops/fused_update.py",
                        ("float32", "float32", "float32"), 0),
         # flax module: dtype promotion is the router's business — audit

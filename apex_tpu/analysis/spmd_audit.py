@@ -203,6 +203,79 @@ def _builders():
                        out_specs=(specs, P()))
         return fn, (state, _mlp_batch()), dict(mesh.shape)
 
+    # --- chunked fused LM-head + CE twins (ISSUE 9) --------------------
+    # A train-step fixture where the [tokens, vocab] logits DOMINATE
+    # memory: tokens=512 x vocab=4096 fp32 logits are 8 MiB, while the
+    # model/optimizer state is ~0.6 MiB.  The env-knob-selected lowering
+    # ships as TWO registered executables — the fused scan (chunk=64,
+    # peak-live O(chunk x vocab)) and its unfused twin (chunk=0, full
+    # logits forward AND softmax-residual backward) — so the APX215
+    # ledger pins the peak-live drop and a regression in either lowering
+    # is caught (the tier-1 twin guard in
+    # tests/L1/test_fused_lm_xent_budget.py asserts fused < unfused and
+    # that the unfused logits alone exceed the fused twin's entire
+    # peak).
+    _LM_TOKENS, _LM_HID, _LM_VOCAB, _LM_CHUNK = 512, 32, 4096, 64
+
+    def _lm_head_params():
+        base = np.linspace(-0.05, 0.05, _LM_VOCAB * _LM_HID,
+                           dtype=np.float32)
+        proj = np.linspace(-0.3, 0.3, _LM_HID * _LM_HID,
+                           dtype=np.float32)
+        return {"head_w": jnp.asarray(base.reshape(_LM_VOCAB, _LM_HID)),
+                "proj": jnp.asarray(proj.reshape(_LM_HID, _LM_HID))}
+
+    def _lm_head_batch():
+        x = np.linspace(-1.0, 1.0, _LM_TOKENS * _LM_HID,
+                        dtype=np.float32).reshape(_LM_TOKENS, _LM_HID)
+        y = (np.arange(_LM_TOKENS) * 37) % _LM_VOCAB
+        return {"x": jnp.asarray(x),
+                "y": jnp.asarray(y, dtype=jnp.int32)}
+
+    def _lm_head_loss(chunk):
+        from apex_tpu.ops.fused_lm_xent import fused_lm_head_cross_entropy
+
+        def loss(params, batch):
+            h = jnp.tanh(batch["x"] @ params["proj"])
+            return fused_lm_head_cross_entropy(
+                h, params["head_w"], batch["y"], smoothing=0.1,
+                token_chunk=chunk, vocab_chunk=0).mean()
+        return loss
+
+    def lm_xent_step(chunk):
+        from apex_tpu import train_step
+        from apex_tpu.optimizers import functional
+        tx = functional.fused_adam(lr=1e-2)
+        state = train_step.init_train_state(tx, _lm_head_params(),
+                                            loss_scale="dynamic")
+        step = train_step.make_train_step(_lm_head_loss(chunk), tx)
+        return step, (state, _lm_head_batch()), {}
+
+    def tp_fused_lm_xent():
+        from apex_tpu.ops.fused_lm_xent import (
+            fused_lm_head_vocab_parallel_cross_entropy)
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(tensor_model_parallel_size_=2)
+        mesh = ps.get_mesh()
+        tokens, hid, vocab, chunk = 64, 16, 256, 16
+
+        def body(h, w, y):
+            def loss(h, w):
+                return fused_lm_head_vocab_parallel_cross_entropy(
+                    h, w, y, smoothing=0.1, token_chunk=chunk,
+                    grad_input_psum=True).mean()
+            return jax.value_and_grad(loss, argnums=(0, 1))(h, w)
+
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P(), P(ps.TENSOR_AXIS, None), P()),
+                       out_specs=(P(), (P(), P(ps.TENSOR_AXIS, None))))
+        h = jnp.asarray(np.linspace(-1, 1, tokens * hid,
+                                    dtype=np.float32).reshape(tokens, hid))
+        w = jnp.asarray(np.linspace(-0.2, 0.2, vocab * hid,
+                                    dtype=np.float32).reshape(vocab, hid))
+        y = jnp.asarray((np.arange(tokens) * 7) % vocab, dtype=jnp.int32)
+        return fn, (h, w, y), dict(mesh.shape)
+
     def ddp_bucketed_allreduce():
         from apex_tpu.parallel.distributed import DistributedDataParallel
         mesh = Mesh(np.array(jax.devices()[:2]), (ps.DATA_AXIS,))
@@ -360,6 +433,19 @@ def _builders():
                                                    prefetch=0),
                                  "apex_tpu/train_step.py",
                                  (0,), True, True, True, False),
+        # the fused/unfused LM-head+CE twins (ISSUE 9): the env-knob
+        # (APEX_TPU_XENT_CHUNK) selects between these two lowerings, so
+        # BOTH are budgeted — the twin guard compares their APX215
+        # peak-live entries
+        "lm_xent_fused": (functools.partial(lm_xent_step, _LM_CHUNK),
+                          "apex_tpu/ops/fused_lm_xent.py",
+                          (0,), True, True, False, False),
+        "lm_xent_unfused": (functools.partial(lm_xent_step, 0),
+                            "apex_tpu/ops/fused_lm_xent.py",
+                            (0,), True, True, False, False),
+        "tp_fused_lm_xent": (tp_fused_lm_xent,
+                             "apex_tpu/ops/fused_lm_xent.py",
+                             (), False, False, False, False),
         "ddp_allreduce": (ddp_bucketed_allreduce,
                           "apex_tpu/parallel/distributed.py",
                           (), False, False, False, False),

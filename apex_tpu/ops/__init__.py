@@ -26,6 +26,11 @@ from .paged_attention import paged_decode_attention
 from .ring_attention import ring_attention, ring_attention_reference
 from .ulysses_attention import ulysses_attention
 from .xentropy import softmax_cross_entropy_loss, xentropy_reference
+from .fused_lm_xent import (
+    fused_lm_head_cross_entropy,
+    fused_lm_head_vocab_parallel_cross_entropy,
+    lm_head_xentropy_reference,
+)
 
 __all__ = [
     "ring_attention",
@@ -49,4 +54,7 @@ __all__ = [
     "mha_reference",
     "softmax_cross_entropy_loss",
     "xentropy_reference",
+    "fused_lm_head_cross_entropy",
+    "fused_lm_head_vocab_parallel_cross_entropy",
+    "lm_head_xentropy_reference",
 ]

@@ -33,6 +33,11 @@ import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.fused_lm_xent import (
+    fused_lm_head_cross_entropy,
+    fused_lm_head_vocab_parallel_cross_entropy,
+    xent_chunk_default,
+)
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel import (
@@ -74,6 +79,13 @@ class GPTConfig:
     # xentropy kernel's half-precision bprop) — halves the dominant
     # [tokens, vocab] residual
     ce_half_residuals: bool = False
+    # chunked fused LM-head + cross-entropy (ISSUE 9, Liger-style):
+    # token-chunk size for the fused head that never materializes the
+    # [tokens, vocab] logits — the head projection and the softmax-CE
+    # scan together, one chunk at a time, and the backward re-projects
+    # (recompute-over-residual).  None reads APEX_TPU_XENT_CHUNK;
+    # 0 keeps the unfused dense logits (the default)
+    fused_head_xent: Optional[int] = None
     # MoE (beyond reference parity; Megatron-core arg names): replace the
     # dense FFN with num_moe_experts top-k routed experts.  With
     # expert_model_parallel the experts shard over the mesh's 'expert'
@@ -387,9 +399,25 @@ class GPTModel(nn.Module):
         # tied lm head: vocab-parallel logits [s, b, v/tp]
         emb_shard = self.variables["params"]["embedding"][
             "word_embeddings"]["weight"]
-        logits = jnp.einsum("sbh,vh->sbv", h, emb_shard)
         if labels is None:
-            return logits
+            return jnp.einsum("sbh,vh->sbv", h, emb_shard)
+        chunk = cfg.fused_head_xent
+        if chunk is None:
+            chunk = xent_chunk_default()
+        if chunk and chunk > 0:
+            # fused chunked head+CE: projection and softmax-CE scan
+            # token chunks together, so no [s*b, v/tp] logits tensor
+            # (nor its backward residual) ever materializes.  The
+            # vocab-parallel variant keeps the rank-partial dhidden of
+            # the raw-einsum tied head (grad_input_psum=False).
+            if _tp() > 1:
+                loss = fused_lm_head_vocab_parallel_cross_entropy(
+                    h, emb_shard, labels.T, token_chunk=chunk)
+            else:
+                loss = fused_lm_head_cross_entropy(
+                    h, emb_shard, labels.T, token_chunk=chunk)
+            return loss.mean()
+        logits = jnp.einsum("sbh,vh->sbv", h, emb_shard)
         # labels: [b, s] -> [s, b]
         loss = vocab_parallel_cross_entropy(
             logits.astype(jnp.float32), labels.T,

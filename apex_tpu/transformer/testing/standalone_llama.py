@@ -23,6 +23,11 @@ import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedRMSNorm
 from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.fused_lm_xent import (
+    fused_lm_head_cross_entropy,
+    fused_lm_head_vocab_parallel_cross_entropy,
+    xent_chunk_default,
+)
 from apex_tpu.transformer.functional.fused_rope import (
     fused_apply_rotary_pos_emb_cached,
 )
@@ -57,6 +62,13 @@ class LlamaConfig:
     params_dtype: Any = jnp.float32
     remat: bool = False
     embedding_grad_via_matmul: bool = False
+    # chunked fused LM-head + cross-entropy (ISSUE 9): token-chunk size
+    # for the fused head that never materializes the [tokens, vocab/tp]
+    # logits.  None reads APEX_TPU_XENT_CHUNK; 0 keeps the unfused
+    # ColumnParallelLinear head (the default).  The param tree is
+    # identical either way (same lm_head/weight leaf), so fused and
+    # unfused configs interchange checkpoints freely.
+    fused_head_xent: Optional[int] = None
 
     def __post_init__(self):
         if self.num_attention_heads % self.kv_heads:
@@ -207,6 +219,25 @@ class LlamaBlock(nn.Module):
         return x + LlamaMLP(cfg, name="mlp")(h)
 
 
+class _LMHeadWeight(nn.Module):
+    """Declares the lm_head kernel with ColumnParallelLinear's exact
+    name/shape/init/dtype WITHOUT projecting — the fused-CE path
+    consumes the weight directly, so swapping heads changes no param
+    leaf and breaks no checkpoint."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self):
+        from apex_tpu.transformer.tensor_parallel.layers import (
+            _DEFAULT_INIT, _shard_init)
+        cfg, tp = self.cfg, _tp()
+        return self.param(
+            "weight",
+            _shard_init(_DEFAULT_INIT, parallel_state.TENSOR_AXIS, tp),
+            (divide(cfg.vocab_size, tp), cfg.hidden_size),
+            cfg.params_dtype)
+
+
 class LlamaModel(nn.Module):
     """tokens [b, s] -> loss (with labels) or [s, b, vocab/tp] logits."""
     cfg: LlamaConfig
@@ -231,6 +262,22 @@ class LlamaModel(nn.Module):
         h = FusedRMSNorm(normalized_shape=cfg.hidden_size, eps=cfg.rms_eps,
                          name="final_norm")(h)
         # untied LM head (LLaMA convention), vocab rows sharded over TP
+        chunk = cfg.fused_head_xent
+        if chunk is None:
+            chunk = xent_chunk_default()
+        if labels is not None and chunk and chunk > 0:
+            # fused chunked head+CE over the same lm_head/weight leaf;
+            # grad_input_psum matches ColumnParallelLinear's backward
+            # (copy_to's psum of dhidden over the tensor axis)
+            w = _LMHeadWeight(cfg, name="lm_head")()
+            if _tp() > 1:
+                loss = fused_lm_head_vocab_parallel_cross_entropy(
+                    h, w, labels.T, token_chunk=chunk,
+                    grad_input_psum=True)
+            else:
+                loss = fused_lm_head_cross_entropy(
+                    h, w, labels.T, token_chunk=chunk)
+            return loss.mean()
         logits, _ = ColumnParallelLinear(
             cfg.hidden_size, cfg.vocab_size, bias=False,
             gather_output=False, params_dtype=cfg.params_dtype,

@@ -390,6 +390,60 @@ def _microbench_xentropy(rtt: float, on_tpu: bool):
             "xentropy_shape": [tokens, vocab]}
 
 
+def _microbench_xent_fused(rtt: float, on_tpu: bool):
+    """Chunked fused LM-head+CE A/B (ISSUE 9): fwd+bwd wall time of the
+    fused token-chunk scan vs the unfused project-then-CE twin at the
+    same [tokens, hidden] x [vocab, hidden] shape, with the APX215
+    peak-live model of BOTH lowerings stamped next to the measured pair
+    — the modeled memory win and the measured recompute cost land in
+    one artifact.  Knob provenance: ``xent_chunk`` / ``xent_vocab_chunk``
+    (same contract as ``attn_xla_max_seq``)."""
+    from apex_tpu.ops.fused_lm_xent import (fused_lm_head_cross_entropy,
+                                            lm_head_xentropy_reference)
+
+    tokens, hidden, vocab = ((8192, 1024, 51200) if on_tpu
+                             else (256, 64, 1024))
+    chunk = int(_ov("xent_chunk", 512 if on_tpu else 32))
+    vchunk = int(_ov("xent_vocab_chunk", 0))
+    kh, kw, kl = jax.random.split(jax.random.PRNGKey(0), 3)
+    h = jax.random.normal(kh, (tokens, hidden), jnp.bfloat16)
+    w = jax.random.normal(kw, (vocab, hidden), jnp.bfloat16) * 0.02
+    y = jax.random.randint(kl, (tokens,), 0, vocab)
+    iters = 10 if on_tpu else 3
+
+    def fb(loss_fn):
+        def run(h, w):
+            return jax.grad(
+                lambda h, w: jnp.sum(loss_fn(h, w)), argnums=(0, 1))(h, w)
+        return run
+
+    def fused(h, w):
+        return fused_lm_head_cross_entropy(h, w, y, token_chunk=chunk,
+                                           vocab_chunk=vchunk)
+
+    def unfused(h, w):
+        return lm_head_xentropy_reference(h, w, y)
+
+    t_fused = _bench_fn(fb(fused), (h, w), iters, rtt)
+    t_ref = _bench_fn(fb(unfused), (h, w), iters, rtt)
+    out = {"xent_fused_us": round(t_fused.best * 1e6, 1),
+           "xent_fused_us_median": round(t_fused.median * 1e6, 1),
+           "xent_unfused_us": round(t_ref.best * 1e6, 1),
+           "xent_fused_vs_unfused": round(t_ref.best / t_fused.best, 3),
+           "xent_fused_shape": [tokens, hidden, vocab],
+           "xent_chunk": chunk,
+           "xent_vocab_chunk": vchunk}
+    try:
+        from apex_tpu.analysis.comm_model import peak_live_bytes
+        out["xent_fused_peak_live_bytes"] = int(peak_live_bytes(
+            jax.make_jaxpr(fb(fused))(h, w).jaxpr))
+        out["xent_unfused_peak_live_bytes"] = int(peak_live_bytes(
+            jax.make_jaxpr(fb(unfused))(h, w).jaxpr))
+    except Exception:  # noqa: BLE001 — the model stamp is auxiliary
+        traceback.print_exc()
+    return out
+
+
 def _bench_setup(force_cpu: bool):
     """Backend selection + rtt measurement shared by every leg."""
     if force_cpu:
@@ -1023,6 +1077,7 @@ MICRO_LEGS = {
     "ln": _microbench_layernorm,
     "attn": _microbench_attention,
     "xent": _microbench_xentropy,
+    "xent_fused": _microbench_xent_fused,
     "moe": _microbench_moe,
     "bert": _microbench_bert,
     "llama": _microbench_llama,
@@ -1039,6 +1094,9 @@ def _bench_main(force_cpu: bool = False) -> None:
     import apex_tpu.normalization as norm_mod
 
     on_tpu, rtt = _bench_setup(force_cpu)
+    # fused LM-head+CE knob (--override xent_chunk=N): 0 keeps the
+    # unfused dense logits (every r1-r8 capture's lowering)
+    xent_chunk = int(_ov("xent_chunk", 0))
     # shapes sized for the single dev chip; CPU fallback shrinks
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
@@ -1047,13 +1105,15 @@ def _bench_main(force_cpu: bool = False) -> None:
                         hidden_dropout=0.0, attention_dropout=0.0,
                         params_dtype=jnp.bfloat16,
                         embedding_grad_via_matmul=bool(
-                            _ov("emb_matmul_grad", 0)))
+                            _ov("emb_matmul_grad", 0)),
+                        fused_head_xent=xent_chunk)
         batch, seq, iters = (_ov("batch", 8), _ov("seq", 1024),
                              _ov("iters", 8))
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_attention_heads=4, max_seq_length=128,
-                        hidden_dropout=0.0, attention_dropout=0.0)
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        fused_head_xent=xent_chunk)
         batch, seq, iters = 2, 128, 2
 
     parallel_state.destroy_model_parallel()
@@ -1178,6 +1238,11 @@ def _bench_main(force_cpu: bool = False) -> None:
         "sec_per_step_median": round(t_fused.median, 5),
         "chip": jax.devices()[0].device_kind,
         "backend": "tpu" if on_tpu else "cpu",
+        # knob stamp (same contract as attn_xla_max_seq): which LM-head
+        # lowering the TRAIN leg measured (0 = unfused dense logits).
+        # Named train_* so the xent_fused micro leg's own xent_chunk
+        # stamp survives the leg merge beside it.
+        "train_xent_chunk": xent_chunk,
     }
     if zero_dp is not None:
         extras.update(zero_extras)
@@ -1281,6 +1346,7 @@ def _run_leg(mode: str, leg: str, timeout: float, key=None):
 # tunnel; each micro leg pays 1-2 smaller ones
 LEG_TIMEOUTS = [("main", 1500), ("bert", 1200), ("llama", 1200),
                 ("adam", 700), ("ln", 600), ("attn", 700), ("xent", 600),
+                ("xent_fused", 600),
                 ("moe", 900), ("infer", 900), ("tp", 600)]
 
 
@@ -1387,7 +1453,8 @@ def _summarize_capture(name, payload):
            "value_tokens_per_s": payload.get("value"),
            "vs_baseline": payload.get("vs_baseline")}
     for k in ("mfu", "chip", "flash_attn_us", "adam_gbps",
-              "layernorm_gbps", "xentropy_gbps", "moe_tokens_per_s",
+              "layernorm_gbps", "xentropy_gbps", "xent_fused_us",
+              "xent_fused_vs_unfused", "moe_tokens_per_s",
               "bert_mfu", "bert_tokens_per_s",
               "llama_mfu", "llama_tokens_per_s",
               "infer_prefill_tokens_per_s", "infer_decode_tokens_per_s",

@@ -34,6 +34,8 @@ from jax.sharding import PartitionSpec as P
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))               # repo root on sys.path
 
+from apex_tpu.ops.fused_lm_xent import (fused_lm_head_cross_entropy,
+                                        xent_chunk_default)
 from apex_tpu.optimizers import functional
 from apex_tpu.parallel.distributed import flat_allreduce
 from apex_tpu.transformer import parallel_state
@@ -74,6 +76,11 @@ def parse_args(argv=None):
                         "attention part runs IN-KERNEL on the softmax "
                         "probabilities). Toy default 0 so the smoke run "
                         "converges fast")
+    p.add_argument("--xent-chunk", type=int, default=None,
+                   help="token-chunk size for the fused LM-head+CE "
+                        "(the [tokens, vocab] logits never materialize; "
+                        "backward re-projects per chunk). Default reads "
+                        "APEX_TPU_XENT_CHUNK; 0 = unfused dense logits")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO over the data axis: the flat fused-Adam "
                         "master/moments shard 1/dp per rank; the dp "
@@ -160,9 +167,20 @@ def main(argv=None):
         return layer.apply(params["layer"], x, None, False,
                            rngs={"dropout": key})
 
+    xent_chunk = (args.xent_chunk if args.xent_chunk is not None
+                  else xent_chunk_default())
+
     def loss_fn(y, mb, params):
         # TIED head: logits through the same embedding table (3-arg loss
         # contract so the head weight gets gradients)
+        if xent_chunk and xent_chunk > 0:
+            # fused chunked head+CE: the [s*b, vocab] logits never
+            # materialize (forward scans token chunks; backward
+            # re-projects each chunk and accumulates d_embed in the
+            # scan carry)
+            return fused_lm_head_cross_entropy(
+                y, params["embed"], mb["labels"].T,
+                token_chunk=xent_chunk).mean()
         logits = jnp.einsum("sbh,vh->sbv", y, params["embed"])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.mean(jnp.take_along_axis(

@@ -55,6 +55,10 @@ def parse_args(argv=None):
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--xent-chunk", type=int, default=None,
+                   help="token-chunk size for the fused LM-head+CE "
+                        "(no [tokens, vocab/tp] logits transient). "
+                        "Default reads APEX_TPU_XENT_CHUNK; 0 = unfused")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO: shard the fused-Adam master/moments 1/dp "
                         "over the data axis (reduce-scatter grads, "
@@ -90,7 +94,9 @@ def main(argv=None):
     cfg = LlamaConfig(
         vocab_size=args.vocab, hidden_size=args.hidden,
         num_layers=args.layers, num_attention_heads=args.heads,
-        num_kv_heads=args.kv_heads, max_seq_length=args.seq)
+        num_kv_heads=args.kv_heads, max_seq_length=args.seq,
+        # None falls through to APEX_TPU_XENT_CHUNK inside the model
+        fused_head_xent=args.xent_chunk)
     model = llama_model_provider(cfg)
     tx = functional.fused_adam(lr=args.lr, betas=(0.9, 0.999), eps=1e-8,
                                weight_decay=0.0)

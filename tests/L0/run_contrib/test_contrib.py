@@ -60,6 +60,34 @@ class TestXentropy:
         assert np.all(np.asarray(g[::4]) == 0.0)
         assert np.any(np.asarray(g[1::4]) != 0.0)
 
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_backward_scatter_matches_onehot_bitwise(self, smoothing):
+        """ISSUE 9 satellite: run_bwd's subtract-at-index (scatter-add
+        of -(1-s) at safe_labels) is BITWISE the old explicit-one_hot
+        formula — ``p + (-(1-s))`` is IEEE ``p - (1-s)`` at the label
+        column and untouched columns keep ``p`` exactly — while never
+        materializing the second fp32 [tokens, vocab] buffer."""
+        n, v = 48, 512
+        logits = jax.random.normal(jax.random.PRNGKey(10), (n, v)) * 2
+        labels = jax.random.randint(jax.random.PRNGKey(11), (n,), 0, v)
+        labels = labels.at[::6].set(-100)
+        dloss = jax.random.normal(jax.random.PRNGKey(12), (n,))
+
+        _, vjp = jax.vjp(lambda l: softmax_cross_entropy_loss(
+            l, labels, smoothing=smoothing), logits)
+        (got,) = vjp(dloss)
+
+        # the pre-ISSUE-9 formula, verbatim
+        pad = labels == -100
+        safe = jnp.where(pad, 0, labels)
+        x = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(x, axis=-1)
+        p = jnp.exp(x - lse[:, None])
+        onehot = jax.nn.one_hot(safe, v, dtype=jnp.float32)
+        ref = p - (1.0 - smoothing) * onehot - smoothing / v
+        ref = ref * jnp.where(pad, 0.0, dloss)[:, None]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
     def test_class_shim(self):
         logits = jax.random.normal(jax.random.PRNGKey(6), (16, 128))
         labels = jax.random.randint(jax.random.PRNGKey(7), (16,), 0, 128)

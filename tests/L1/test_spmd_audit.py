@@ -11,12 +11,15 @@ from apex_tpu.analysis.spmd_audit import BUDGET_NAME
 
 REPO = repo_root()
 
-# the executables the auditor must cover (ISSUE 5 acceptance: >= 8)
+# the executables the auditor must cover (ISSUE 5 acceptance: >= 8;
+# ISSUE 9 adds the fused/unfused LM-head+CE twins + the TP variant so
+# the env-knob-selected lowering can't ship unbudgeted)
 REQUIRED_EXECS = {
     "train_step_dense", "train_step_zero", "ddp_allreduce",
     "tp_column_row", "pipeline_1f1b", "ring_attention_cp",
     "ulysses_attention_cp", "moe_dispatch", "inference_prefill",
-    "inference_decode",
+    "inference_decode", "lm_xent_fused", "lm_xent_unfused",
+    "tp_fused_lm_xent",
 }
 
 

@@ -52,6 +52,12 @@ SLOW = {
     "tests/L0/run_transformer/test_llama_minimal.py::test_tp2_matches_tp1_exactly",
     "tests/L0/run_transformer/test_llama_minimal.py::test_remat_matches_baseline",
     "tests/L0/run_transformer/test_llama_minimal.py::test_loss_reasonable_and_trains",
+    # r9 fused LM-head+CE model swaps: ~10 s each (two-model compile
+    # per variant); the fast lane keeps the tp=2 sentinels (GPT tied
+    # head + LLaMA GQA untied head — the two backward contracts)
+    "tests/L0/run_transformer/test_fused_lm_xent.py::TestModelSwap::test_gpt_tied_head[1]",
+    "tests/L0/run_transformer/test_fused_lm_xent.py::TestModelSwap::test_llama_untied_head_mha_gqa[1-4]",
+    "tests/L0/run_transformer/test_fused_lm_xent.py::TestModelSwap::test_llama_untied_head_mha_gqa[1-2]",
     # r5 re-lane: measured >5 s in the 2026-07-31 durations run
     "tests/L0/run_transformer/test_gpt_bert_minimal.py::test_scan_layers_dropout_trains",
     "tests/L0/run_transformer/test_moe.py::test_gather_dispatch_matches_onehot",
