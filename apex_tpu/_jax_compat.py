@@ -30,6 +30,43 @@ def _axis_size(axis_name):
     return jax.lax.psum(1, axis_name)
 
 
+def compiled_cost_analysis(compiled):
+    """Normalized ``Compiled.cost_analysis()``: one flat dict or None.
+
+    Old jax (<=0.4.x, this image) returns a LIST with one per-module
+    dict; modern jax returns the dict directly.  Missing method or a
+    backend that raises (some PJRT plugins ship no cost model) -> None
+    — callers must treat None as "unavailable", never as zero.
+    """
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        out = fn()
+    except Exception:  # noqa: BLE001 — unimplemented on this backend
+        return None
+    if isinstance(out, (list, tuple)):
+        out = out[0] if out else None
+    return dict(out) if out else None
+
+
+def compiled_memory_analysis(compiled):
+    """Normalized ``Compiled.memory_analysis()``: the backend's
+    ``CompiledMemoryStats`` (argument/output/alias/temp byte fields) or
+    None when the method is missing, raises, or returns nothing — the
+    degraded-backend case the caller must mark explicitly."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        out = fn()
+    except Exception:  # noqa: BLE001 — unimplemented on this backend
+        return None
+    if out is None or not hasattr(out, "argument_size_in_bytes"):
+        return None
+    return out
+
+
 def install() -> None:
     """Graft modern jax names onto an old jax. Idempotent, no-op on new jax."""
     global _installed

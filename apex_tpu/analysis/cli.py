@@ -139,6 +139,9 @@ def main(argv: Optional[list] = None) -> int:
         print("APX217 comm-not-overlapped         spmd audit: overlapped "
               "executable's compiled HLO has no async start/done pair "
               "(or schedulable compute) between collectives")
+        print("APX218 compiled-drift              spmd audit: compiled-"
+              "stats attribution missing/degraded, or the estimate-vs-"
+              "compiled drift ratio left the committed band")
         return 0
 
     if args.write_budget:

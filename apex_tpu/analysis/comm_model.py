@@ -37,7 +37,7 @@ from typing import Dict, Optional
 
 __all__ = ["COLLECTIVE_PRIMS", "collective_axes", "eqn_comm_bytes",
            "comm_report", "peak_live_bytes", "ring_allreduce_bytes",
-           "step_time_estimate"]
+           "jaxpr_dot_flops", "step_time_estimate"]
 
 # Collective primitive name -> pricing kind.  ``psum_scatter`` traces as
 # ``reduce_scatter`` on current jax; both spellings are kept so the
@@ -218,8 +218,16 @@ def _jaxpr_dot_flops(jaxpr, mult: int = 1) -> int:
     return mult * total
 
 
+def jaxpr_dot_flops(closed_jaxpr) -> int:
+    """Public face of the analytic matmul-FLOP count — what the APX218
+    drift ledger compares against the compiled ``cost_analysis()``
+    truth (which counts EVERY op, so the pinned ratio also records how
+    dot-dominated each executable is)."""
+    return _jaxpr_dot_flops(_open(closed_jaxpr))
+
+
 def step_time_estimate(closed_jaxpr, axis_sizes: Dict[str, int], *,
-                       tflops: float = 197.0,
+                       tflops: Optional[float] = None,
                        ici_gbps: float = 100.0) -> dict:
     """Analytic overlap-aware step-time model for one executable.
 
@@ -238,7 +246,14 @@ def step_time_estimate(closed_jaxpr, axis_sizes: Dict[str, int], *,
     the pair is a MODEL whose job is the ratio (the step-time win a
     bench capture records next to the measured legs as
     ``overlap_step_time_model_us``), not a wall-clock prediction.
+
+    ``tflops=None`` resolves to the :mod:`apex_tpu.chip_specs` default
+    generation's bf16 peak — the one chip-spec table (callers with a
+    live device pass ``find_spec(device_kind).bf16_tflops``).
     """
+    if tflops is None:
+        from apex_tpu.chip_specs import default_spec
+        tflops = default_spec().bf16_tflops
     report = comm_report(closed_jaxpr, axis_sizes)
     flops = _jaxpr_dot_flops(closed_jaxpr)
     t_compute = flops / (tflops * 1e12)

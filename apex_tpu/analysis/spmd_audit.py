@@ -42,6 +42,19 @@ invariants PRs 3–4 proved by hand, per registered executable:
   APX216 machine-checks PERF.md round-6's ZeRO accounting on the zero
   step's own jaxpr: all-gather bytes == reduce-scatter bytes, i.e.
   RS + AG == the ring all-reduce of the same flat buffer.
+* **APX218 — compiled-truth attribution + drift ratchet.**  Every
+  registered executable's budget entry carries XLA's OWN numbers —
+  ``lower().compile()``'s ``cost_analysis()`` FLOPs/bytes and
+  ``memory_analysis()`` buffer sizes (via
+  :mod:`apex_tpu.observability.xla_stats`, provenance-marked when a
+  backend degrades) — next to the analytic estimates, plus the
+  estimate/compiled drift ratios (APX215's linear-scan peak-live vs
+  compiled peak bytes; ``comm_model``'s dot-FLOPs vs compiled FLOPs).
+  :func:`compare_budget` ratchets the drift: an executable whose
+  ratio moved further from 1 than the committed band (x
+  :data:`DRIFT_RATCHET_SLACK`), lost its attribution, or was never
+  pinned with one, fails the run — the estimates can no longer drift
+  silently away from what XLA actually builds.
 * **APX217 — comm/compute overlap (async scheduling).**  For
   executables restructured for overlap (ISSUE 7: the layered-prefetch
   zero step, the chunked TP ring), the COMPILED executable — the same
@@ -71,14 +84,22 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, FrozenSet, List, Optional, Sequence
 
 from apex_tpu.analysis.comm_model import (COLLECTIVE_PRIMS, collective_axes,
-                                          comm_report, peak_live_bytes)
+                                          comm_report, jaxpr_dot_flops,
+                                          peak_live_bytes)
 from apex_tpu.analysis.finding import Finding
 
 __all__ = ["ExecSpec", "exec_specs", "run_spmd_audit", "compare_budget",
            "ensure_devices", "CANONICAL_AXES", "DONATION_FLOOR_BYTES",
-           "BUDGET_NAME"]
+           "BUDGET_NAME", "DRIFT_RATCHET_SLACK"]
 
 BUDGET_NAME = ".analysis_budget.json"
+
+#: APX218 drift ratchet slack: the estimate/compiled ratio's distance
+#: from 1 may grow by at most this factor over the committed band
+#: before the audit fails (identical backends reproduce the ratios
+#: bit-for-bit; the slack only absorbs compiler-version scheduling
+#: jitter, never a real new temporary).
+DRIFT_RATCHET_SLACK = 1.05
 
 #: parallel_state's mesh axis names — the only axes a registered
 #: executable's collectives may ride (APX211).
@@ -970,9 +991,31 @@ def _computation_collectives(text: str) -> dict:
     return out
 
 
-def _check_async_overlap(spec: "ExecSpec", fn, args, emit) -> None:
+def _emit_compile_failed(emit, name: str, err) -> None:
+    emit("APX210", f"compiling {name} for overlap verification "
+                   f"failed: {type(err).__name__}: {err}")
+
+
+def _compile_executable(spec: "ExecSpec", fn, args) -> tuple:
+    """ONE XLA compile per executable, shared by APX217 (schedule
+    inspection) and APX218 (cost/memory attribution) — compilation
+    dominates audit wall time, so it must never run twice for the same
+    spec.  Returns ``(Compiled or None, error or None)``."""
+    import jax
+
+    try:
+        return (jax.jit(fn, donate_argnums=spec.donate_argnums or ())
+                .lower(*args).compile(), None)
+    except Exception as e:  # noqa: BLE001 — callers surface it
+        return None, e
+
+
+def _check_async_overlap(spec: "ExecSpec", fn, args, emit,
+                         compiled=None) -> None:
     """APX217: the compiled executable of an overlap-restructured hot
     path must expose comm/compute overlap to the scheduler.
+    ``compiled`` lets :func:`_audit_exec` share its one compile; when
+    absent (direct callers, tests) this compiles itself.
 
     Async backends (TPU latency-hiding scheduler): find
     ``*-start``/``*-done`` collective pairs — dedicated fused opcodes
@@ -994,16 +1037,12 @@ def _check_async_overlap(spec: "ExecSpec", fn, args, emit) -> None:
     collective's payload (scalar loss pmeans, found_inf pmax) are not
     dominant, and witness compute below 1/8 of the collective's payload
     (scaler bookkeeping) does not count as hiding it."""
-    import jax
-
-    jitted = jax.jit(fn, donate_argnums=spec.donate_argnums or ())
-    try:
-        text = jitted.lower(*args).compile().as_text()
-    except Exception as e:  # noqa: BLE001 — surfaced as a finding
-        emit("APX210", f"compiling {spec.name} for overlap verification "
-                       f"failed: {type(e).__name__}: {e}")
-        return
-    _overlap_findings_from_hlo(spec.name, text, emit)
+    if compiled is None:
+        compiled, err = _compile_executable(spec, fn, args)
+        if compiled is None:
+            _emit_compile_failed(emit, spec.name, err)
+            return
+    _overlap_findings_from_hlo(spec.name, compiled.as_text(), emit)
 
 
 def _overlap_findings_from_hlo(name: str, text: str, emit) -> None:
@@ -1176,9 +1215,16 @@ def _audit_exec(spec: ExecSpec) -> tuple:
     if spec.donate_argnums or spec.flag_undonated:
         _check_donation(spec, fn, args, emit)
 
+    # ONE compile per executable: APX217 reads its schedule, APX218
+    # its cost/memory numbers
+    compiled, compile_err = _compile_executable(spec, fn, args)
+
     # APX217 — comm/compute overlap on the COMPILED executable
     if spec.check_overlap:
-        _check_async_overlap(spec, fn, args, emit)
+        if compiled is None:
+            _emit_compile_failed(emit, spec.name, compile_err)
+        else:
+            _check_async_overlap(spec, fn, args, emit, compiled=compiled)
 
     # comm/HBM ledger entry
     sizes = dict(axis_sizes)
@@ -1192,6 +1238,30 @@ def _audit_exec(spec: ExecSpec) -> tuple:
         "peak_live_bytes": int(peak_live_bytes(closed.jaxpr)),
         "axes": {k: int(v) for k, v in sorted(sizes.items())},
     }
+
+    # APX218 — compiled-truth attribution from the SAME compile the
+    # overlap check read.  XLA's cost/memory numbers (or an explicit
+    # degradation marker — never a silent zero) ride the entry, with
+    # the estimate/compiled drift ratios the budget ratchet watches.
+    from apex_tpu.observability.xla_stats import (
+        CompiledStats, PROVENANCE_UNAVAILABLE_PREFIX,
+        stats_from_compiled)
+    if compiled is None:
+        stats = CompiledStats(
+            provenance=PROVENANCE_UNAVAILABLE_PREFIX
+            + f"compile-failed:{type(compile_err).__name__}")
+    else:
+        stats = stats_from_compiled(compiled)
+    compiled_entry = stats.asdict()
+    est_flops = int(jaxpr_dot_flops(closed))
+    compiled_entry["dot_flops_estimate"] = est_flops
+    if stats.flops and est_flops > 0:
+        compiled_entry["dot_flops_drift"] = round(
+            est_flops / stats.flops, 4)
+    if stats.peak_hbm_bytes:
+        compiled_entry["peak_live_drift"] = round(
+            entry["peak_live_bytes"] / stats.peak_hbm_bytes, 4)
+    entry["compiled"] = compiled_entry
 
     # APX216 — the PERF.md round-6 identity on the zero step's own
     # jaxpr: params all-gather bytes == grad reduce-scatter bytes
@@ -1256,15 +1326,92 @@ def run_spmd_audit(execs: Optional[Sequence[str]] = None) -> tuple:
     return findings, {"version": 1, "executables": executables}
 
 
+def _drift_distance(ratio: float) -> float:
+    """Symmetric distance of an estimate/compiled ratio from 1 (2x over
+    and 2x under are equally far); non-positive ratios are maximally
+    wrong."""
+    if ratio <= 0:
+        return float("inf")
+    return max(ratio, 1.0 / ratio)
+
+
+def _compare_compiled(name: str, path: str, entry: dict, pinned: dict,
+                      emit218) -> None:
+    """APX218 half of the ratchet: compiled-truth attribution must
+    exist (stats or an explicit degradation marker), must not silently
+    degrade, and its drift ratios must stay inside the committed band."""
+    comp = entry.get("compiled")
+    if not isinstance(comp, dict) or "provenance" not in comp:
+        emit218(name, path,
+                f"{name}: budget entry carries no compiled-stats "
+                f"attribution (neither XLA cost/memory numbers nor an "
+                f"explicit degradation marker) — the auditor must "
+                f"always attribute or mark, never skip silently")
+        return
+    pinned_comp = pinned.get("compiled")
+    if not isinstance(pinned_comp, dict):
+        emit218(name, path,
+                f"{name}: executable has no committed compiled-stats "
+                f"entry — run apex-tpu-analyze --spmd --write-budget "
+                f"to pin its APX218 drift ledger")
+        return
+    # full > cost-only > unavailable: ANY slide down the provenance
+    # ladder is a degradation (a full->cost-only slide silently
+    # disables the peak-live drift ratchet, not just the cliff to
+    # unavailable)
+    from apex_tpu.observability.xla_stats import provenance_rank
+    prov = comp["provenance"]
+    pinned_prov = pinned_comp.get("provenance", "")
+    if provenance_rank(prov) < provenance_rank(pinned_prov):
+        emit218(name, path,
+                f"{name}: compiled-stats attribution DEGRADED "
+                f"({pinned_prov!r} -> {prov!r}) — the executable "
+                f"stopped reporting stats it used to on this backend")
+        return
+    for key, est_name, truth_name in (
+            ("peak_live_drift", "APX215 peak-live estimate",
+             "compiled peak bytes"),
+            ("dot_flops_drift", "comm_model dot-FLOPs",
+             "compiled cost_analysis FLOPs")):
+        cur, pin = comp.get(key), pinned_comp.get(key)
+        if pin is not None and cur is None:
+            emit218(name, path,
+                    f"{name}: the {est_name} drift ratio vanished from "
+                    f"the fresh entry (pinned {pin}) — the analytic "
+                    f"estimate degenerated (e.g. to zero) and the "
+                    f"ratchet lost its input; fix the model or re-pin "
+                    f"consciously with --write-budget")
+            continue
+        if cur is None or pin is None:
+            continue
+        if _drift_distance(cur) > \
+                _drift_distance(pin) * DRIFT_RATCHET_SLACK:
+            emit218(name, path,
+                    f"{name}: {est_name} drifted further from the "
+                    f"{truth_name} ({pin} -> {cur}; band "
+                    f"{_drift_distance(pin):.4f} x "
+                    f"{DRIFT_RATCHET_SLACK}) — the analytic model and "
+                    f"the compiled executable disagree more than they "
+                    f"used to; fix the model or justify and re-pin "
+                    f"with --write-budget")
+
+
 def compare_budget(report: dict, committed: Optional[dict]) -> list:
     """Ratchet: findings for every executable whose comm bytes or peak
     estimate GREW vs the committed budget (or that the budget has never
-    seen).  Shrinkage is silent — re-pin with ``--write-budget``."""
+    seen), APX215-coded; plus the APX218 compiled-truth checks — every
+    entry must carry compiled stats (or an explicit degradation
+    marker), and the estimate-vs-compiled drift ratios must stay inside
+    the committed band.  Shrinkage is silent — re-pin with
+    ``--write-budget``."""
     findings: list = []
 
-    def emit(name, path, msg):
-        findings.append(Finding("APX215", path, 0, 0, msg,
-                                line_text=f"{name}:APX215"))
+    def emit(name, path, msg, rule="APX215"):
+        findings.append(Finding(rule, path, 0, 0, msg,
+                                line_text=f"{name}:{rule}"))
+
+    def emit218(name, path, msg):
+        emit(name, path, msg, rule="APX218")
 
     paths = {s.name: s.path for s in exec_specs()}
     base = (committed or {}).get("executables", {})
@@ -1290,4 +1437,5 @@ def compare_budget(report: dict, committed: Optional[dict]) -> list:
                  f"{pinned.get('peak_live_bytes', 0)} -> "
                  f"{entry['peak_live_bytes']} B — a new full-size "
                  f"temporary entered the executable")
+        _compare_compiled(name, path, entry, pinned, emit218)
     return findings

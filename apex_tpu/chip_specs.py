@@ -1,0 +1,79 @@
+"""The single source of truth for TPU chip peak specs.
+
+Every capacity number the repo prices against hardware — bench MFU and
+HBM rooflines, ``comm_model.step_time_estimate``'s compute roofline,
+the ``train_mfu`` telemetry gauge, and the capture-hygiene scrub bound
+on compiled peak-HBM stamps — resolves through this table.  Before
+ISSUE 10 the numbers lived twice (``bench.py::_CHIP_SPECS`` and a bare
+``tflops=197.0`` default inside ``comm_model``) and could drift apart
+silently; ``tests/L1/test_chip_specs.py`` now pins that no second copy
+exists.
+
+Conservative public figures: bf16 matmul peak (TFLOP/s), HBM bandwidth
+(GB/s), and HBM capacity (bytes) per chip generation.  Pure data — this
+module must import without jax so the trace-only analysis engines can
+use it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+__all__ = ["ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "match_spec",
+           "find_spec", "default_spec", "local_spec"]
+
+_GiB = 1024 ** 3
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    key: str                 # substring matched against device_kind
+    bf16_tflops: float       # peak bf16 matmul TFLOP/s per chip
+    hbm_gbps: float          # peak HBM bandwidth GB/s per chip
+    hbm_bytes: int           # HBM capacity per chip
+
+
+CHIP_SPECS: Dict[str, ChipSpec] = {s.key: s for s in [
+    ChipSpec("v4", 275.0, 1228.0, 32 * _GiB),
+    ChipSpec("v5e", 197.0, 819.0, 16 * _GiB),
+    ChipSpec("v5lite", 197.0, 819.0, 16 * _GiB),
+    ChipSpec("v5p", 459.0, 2765.0, 95 * _GiB),
+    ChipSpec("v6e", 918.0, 1640.0, 32 * _GiB),
+    ChipSpec("v6lite", 918.0, 1640.0, 32 * _GiB),
+]}
+
+#: the generation assumed when the device kind matches nothing (CPU
+#: dryruns, unknown tunnels) — the same v5e default the bench always had.
+DEFAULT_CHIP = "v5e"
+
+
+def default_spec() -> ChipSpec:
+    return CHIP_SPECS[DEFAULT_CHIP]
+
+
+def match_spec(device_kind: Optional[str]) -> Optional[ChipSpec]:
+    """The spec whose key substring-matches ``device_kind`` (the
+    ``jax.Device.device_kind`` string, any case/spacing), or ``None``
+    on a miss — the one matching loop; callers choose their own miss
+    policy (:func:`find_spec` defaults, bench's scrub bound takes the
+    largest capacity)."""
+    kind = (device_kind or "").lower().replace(" ", "")
+    for key, spec in CHIP_SPECS.items():
+        if key in kind:
+            return spec
+    return None
+
+
+def find_spec(device_kind: Optional[str]) -> ChipSpec:
+    """Like :func:`match_spec`, but a miss resolves to the
+    :data:`DEFAULT_CHIP` spec."""
+    return match_spec(device_kind) or default_spec()
+
+
+def local_spec() -> ChipSpec:
+    """The spec of the first live jax device (initializes the backend;
+    host loops only — trace-only code passes a device_kind to
+    :func:`find_spec` or takes the default)."""
+    import jax
+
+    return find_spec(jax.devices()[0].device_kind)

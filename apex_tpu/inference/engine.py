@@ -275,6 +275,13 @@ class InferenceEngine:
         self._step += 1
         return np.int32(s)
 
+    def bucket_for(self, n: int) -> int:
+        """The prefill bucket an ``n``-token prompt pads up to — the
+        one place the bucket policy lives (prefill pads with it; the
+        scheduler's padding-badput accounting reads it)."""
+        min_bucket = max(64, self.page_size) if self.paged else 64
+        return prefill_bucket(n, self.max_seq, min_bucket=min_bucket)
+
     def prefill(self, cache, tokens, slot, pages=None):
         """Admit one prompt into ``slot``: returns ``(cache, next_token,
         last_logits)``.  ``tokens`` is the UNPADDED prompt (list/array of
@@ -287,8 +294,7 @@ class InferenceEngine:
         into the pool's trash page by construction."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = tokens.shape[0]
-        min_bucket = max(64, self.page_size) if self.paged else 64
-        bucket = prefill_bucket(n, self.max_seq, min_bucket=min_bucket)
+        bucket = self.bucket_for(n)
         padded = np.zeros((bucket,), np.int32)
         padded[:n] = tokens
         if self.paged:

@@ -228,7 +228,9 @@ class SlotScheduler:
                     pages=len(pages) if pages is not None else None)
                 if pages is not None:
                     tel.pool(self.alloc.free_pages, eng.num_pages)
-                with tel.prefill_step():
+                with tel.prefill_step(
+                        prompt_len=len(req.prompt),
+                        bucket_len=eng.bucket_for(len(req.prompt))):
                     cache, tok, _ = eng.prefill(cache, req.prompt, slot,
                                                 pages=pages)
                     tok = int(np.asarray(tok))
@@ -273,7 +275,7 @@ class SlotScheduler:
             # loop performs anyway, so the histogram sample is the true
             # per-token latency (dispatch + sync), and its recompile
             # flag feeds serve_recompiles_total (pinned 0 by tests)
-            with tel.decode_step(n_active):
+            with tel.decode_step(n_active, capacity=eng.slots):
                 cache, toks, _, truncated = eng.decode(cache, last,
                                                        active)
                 toks = np.asarray(toks)

@@ -15,9 +15,16 @@ code (device scalars resolve one step late via the deferred collector).
     timers     dispatch-aware StepTimer + compile-event counting
     tracing    trace_annotation / named_scope / profile_capture
     serve      ServeTelemetry (SlotScheduler lifecycle: TTFT, decode
-               latency, queue depth, finish reasons, page-pool gauges)
+               latency, queue depth, finish reasons, page-pool gauges,
+               token-goodput decomposition)
     train      TrainTelemetry (step time, tokens/s, overflow skips,
-               loss-scale gauge, exposed-comm residual)
+               loss-scale gauge, exposed-comm residual, MFU gauge,
+               badput decomposition)
+    xla_stats  compiled-truth extractor (ISSUE 10): XLA cost/memory
+               analysis per executable, provenance-marked degradation
+    report     flight recorder: ``python -m apex_tpu.observability.
+               report <run_dir>`` merges events + metrics + compiled
+               stats + comm-model estimates into one run report
 
 Knobs (registered in ``analysis/env_registry.py``):
 
@@ -49,8 +56,14 @@ from apex_tpu.observability.tracing import (named_scope, profile_capture,
                                             stop_profile,
                                             trace_annotation)
 from apex_tpu.observability.train import TrainTelemetry
+from apex_tpu.observability.xla_stats import (CompiledStats,
+                                              compile_and_stats,
+                                              ledger_stats,
+                                              stats_from_compiled)
 
 __all__ = [
+    "CompiledStats", "compile_and_stats", "stats_from_compiled",
+    "ledger_stats",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "global_registry", "reset_global_registry",
     "JsonlSink", "PrometheusSink", "render_prometheus",

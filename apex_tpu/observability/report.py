@@ -1,0 +1,539 @@
+"""Run flight recorder: one report from everything a run left behind.
+
+``python -m apex_tpu.observability.report <run_dir>`` merges the four
+artifacts the stack already writes —
+
+* the JSONL event log (``telemetry.jsonl``: per-step/request lifecycle),
+* the Prometheus snapshot (``metrics.prom``: counters/gauges/histograms
+  at the last export),
+* compiled-truth stats (``xla_stats.json`` from ``python -m
+  apex_tpu.observability.xla_stats``, or the ``compiled`` blocks inside
+  ``.analysis_budget.json``),
+* the comm-model estimates (``.analysis_budget.json``)
+
+— into one markdown (or ``--json``) run report: step-time percentiles,
+MFU, the badput decomposition, exposed-comm residual, TTFT/decode
+percentiles, finish reasons, serve goodput, recompiles, and the
+estimate-vs-compiled attribution table.
+
+Everything is a pure function of the input files — no clocks, no
+device, no environment — so the committed fixture's report reproduces
+byte-for-byte (the golden test in
+``tests/L0/run_observability/test_report.py`` pins it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["parse_prometheus", "percentile", "histogram_quantile",
+           "build_report", "render_markdown", "main"]
+
+
+# ---------------------------------------------------------------------------
+# input parsing
+# ---------------------------------------------------------------------------
+
+_PROM_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+_PROM_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Prometheus text exposition -> ``{family: {"type": kind,
+    "samples": [(series_name, labels_dict, value)]}}``.  Histogram
+    ``_bucket``/``_sum``/``_count`` series file under their base
+    family.  Only the subset our own sink renders is supported."""
+    families: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            families.setdefault(name, {"type": kind, "samples": []})
+            continue
+        if line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        series, labelstr, value = m.group(1), m.group(2), m.group(3)
+        base = series
+        for suffix in ("_bucket", "_sum", "_count"):
+            if series.endswith(suffix) and series[:-len(suffix)] in types:
+                base = series[:-len(suffix)]
+                break
+        labels = dict(_PROM_LABEL_RE.findall(labelstr or ""))
+        families.setdefault(base, {"type": types.get(base, "untyped"),
+                                   "samples": []})
+        families[base]["samples"].append(
+            (series, labels, float(value)))
+    return families
+
+
+def _family_total(families: dict, name: str) -> Optional[float]:
+    fam = families.get(name)
+    if fam is None:
+        return None
+    vals = [v for series, labels, v in fam["samples"]
+            if series == name]
+    return sum(vals) if vals else None
+
+
+def _family_by_label(families: dict, name: str, label: str) \
+        -> Dict[str, float]:
+    fam = families.get(name)
+    if fam is None:
+        return {}
+    return {labels[label]: v for series, labels, v in fam["samples"]
+            if series == name and label in labels}
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over raw samples (None when empty)."""
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = max(math.ceil(q * len(vals)) - 1, 0)
+    return vals[idx]
+
+
+def histogram_quantile(families: dict, name: str, q: float) \
+        -> Optional[float]:
+    """Bucket-resolution quantile from a family's cumulative
+    ``_bucket{le=}`` series (the same semantics as
+    ``Histogram.quantile``: smallest bound covering fraction q)."""
+    fam = families.get(name)
+    if fam is None:
+        return None
+    buckets: List[Tuple[float, float]] = []
+    total = None
+    for series, labels, v in fam["samples"]:
+        if series == name + "_bucket" and "le" in labels:
+            le = labels["le"]
+            buckets.append(
+                (float("inf") if le == "+Inf" else float(le), v))
+        elif series == name + "_count":
+            total = v
+    if not buckets or not total:
+        return None
+    buckets.sort()
+    target = q * total
+    finite = [b for b in buckets if b[0] != float("inf")]
+    for bound, cum in buckets:
+        if cum >= target:
+            if bound == float("inf"):
+                return finite[-1][0] if finite else None
+            return bound
+    return finite[-1][0] if finite else None
+
+
+# ---------------------------------------------------------------------------
+# report assembly
+# ---------------------------------------------------------------------------
+
+def _train_section(events: list, families: dict) -> Optional[dict]:
+    steps = [e for e in events if e.get("kind") == "train_step"]
+    has_metrics = any(k.startswith("train_") for k in families)
+    if not steps and not has_metrics:
+        return None
+    seconds = [e["seconds"] for e in steps
+               if e.get("seconds") is not None]
+    out: dict = {
+        "steps": len(steps) or _family_total(families,
+                                             "train_steps_total"),
+        "recompiled_steps": sum(1 for e in steps if e.get("recompiled")),
+        "step_seconds": {
+            "samples": len(seconds),
+            "p50": percentile(seconds, 0.50),
+            "p90": percentile(seconds, 0.90),
+            "p99": percentile(seconds, 0.99),
+            "max": max(seconds) if seconds else None,
+        },
+    }
+    for key, fam in (("tokens_per_s", "train_tokens_per_s"),
+                     ("mfu", "train_mfu"),
+                     ("model_flops_per_step",
+                      "train_model_flops_per_step"),
+                     ("exposed_comm_residual_us",
+                      "train_exposed_comm_residual_us"),
+                     ("loss", "train_loss"),
+                     ("overflow_skips", "train_overflow_skips_total"),
+                     ("recompiles", "train_recompiles_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            out[key] = v
+    badput = {}
+    for key, fam in (("productive_s",
+                      "train_goodput_productive_seconds"),
+                     ("overflow_s", "train_badput_overflow_seconds"),
+                     ("recompile_s", "train_badput_recompile_seconds"),
+                     ("host_gap_s", "train_badput_host_gap_seconds")):
+        v = _family_total(families, fam)
+        if v is not None:
+            badput[key] = v
+    if badput:
+        wall = sum(badput.values())
+        badput["wall_s"] = wall
+        badput["goodput_fraction"] = (
+            badput.get("productive_s", 0.0) / wall if wall > 0 else None)
+        out["badput"] = badput
+    return out
+
+
+def _serve_section(events: list, families: dict) -> Optional[dict]:
+    firsts = [e for e in events if e.get("kind") == "request_first_token"]
+    finishes = [e for e in events if e.get("kind") == "request_finish"]
+    has_metrics = any(k.startswith("serve_") for k in families)
+    if not (firsts or finishes or has_metrics):
+        return None
+    ttfts = [e["ttft_s"] for e in firsts]
+    out: dict = {
+        "ttft_s": {
+            "samples": len(ttfts),
+            "p50": percentile(ttfts, 0.50),
+            "p99": percentile(ttfts, 0.99),
+        },
+        "decode_token_s": {
+            "p50": histogram_quantile(
+                families, "serve_decode_token_seconds", 0.50),
+            "p99": histogram_quantile(
+                families, "serve_decode_token_seconds", 0.99),
+        },
+        "finish_reasons": dict(sorted(
+            _family_by_label(families, "serve_requests_finished_total",
+                             "reason").items())) or None,
+    }
+    if out["finish_reasons"] is None:
+        reasons: Dict[str, int] = {}
+        for e in finishes:
+            reasons[e.get("reason", "?")] = \
+                reasons.get(e.get("reason", "?"), 0) + 1
+        out["finish_reasons"] = dict(sorted(reasons.items()))
+    for key, fam in (("submitted", "serve_requests_submitted_total"),
+                     ("admitted", "serve_requests_admitted_total"),
+                     ("finished", "serve_requests_finished_total"),
+                     ("backpressure_waits",
+                      "serve_backpressure_waits_total"),
+                     ("recompiles", "serve_recompiles_total"),
+                     ("decode_steps", "serve_decode_steps_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            out[key] = v
+    goodput = {}
+    for key, fam in (("generated_tokens", "serve_tokens_generated_total"),
+                     ("prefill_pad_tokens",
+                      "serve_badput_prefill_pad_tokens_total"),
+                     ("idle_slot_tokens",
+                      "serve_badput_idle_slot_tokens_total"),
+                     ("truncated_tokens",
+                      "serve_badput_truncated_tokens_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            goodput[key] = v
+    if goodput:
+        spent = (goodput.get("generated_tokens", 0.0)
+                 + goodput.get("prefill_pad_tokens", 0.0)
+                 + goodput.get("idle_slot_tokens", 0.0))
+        goodput["goodput_fraction"] = (
+            goodput.get("generated_tokens", 0.0) / spent
+            if spent > 0 else None)
+        out["goodput"] = goodput
+    return out
+
+
+def _attribution_section(stats: Optional[dict],
+                         budget: Optional[dict]) -> Optional[dict]:
+    """Estimate-vs-compiled table: one row per executable, merged from
+    an xla_stats dump and/or the budget ledger's ``compiled`` blocks
+    (the stats dump wins where both exist)."""
+    budget_execs = (budget or {}).get("executables", {})
+    stats_execs = (stats or {}).get("executables", {})
+    names = sorted(set(budget_execs) | set(stats_execs))
+    if not names:
+        return None
+    from apex_tpu.observability.xla_stats import provenance_rank
+
+    def _rank(comp: dict) -> int:
+        return provenance_rank(
+            comp.get("provenance", "unavailable:no-data"))
+
+    rows = {}
+    for name in names:
+        b = budget_execs.get(name, {})
+        ledger = b.get("compiled") or {}
+        dump = stats_execs.get(name) or {}
+        # ONE source per row, the better-provenance one (fresh dump
+        # wins ties) — merging field-by-field would pair one source's
+        # degradation marker with the other's numbers, exactly the
+        # number-next-to-marker the degradation contract forbids.
+        if dump and _rank(dump) >= _rank(ledger):
+            comp = dict(dump)
+            # the analytic estimate rides along (only the audit
+            # computes it), and the drift ratios are RECOMPUTED against
+            # the winning source's numbers — carrying the ledger's
+            # ratios next to the dump's (possibly different-build)
+            # numbers would make the row self-inconsistent
+            est = comp.get("dot_flops_estimate",
+                           ledger.get("dot_flops_estimate"))
+            comp.pop("dot_flops_drift", None)
+            comp.pop("peak_live_drift", None)
+            if est is not None:
+                comp["dot_flops_estimate"] = est
+                if est > 0 and comp.get("flops"):
+                    comp["dot_flops_drift"] = round(
+                        est / comp["flops"], 4)
+            peak_est = b.get("peak_live_bytes")
+            if peak_est and comp.get("peak_hbm_bytes"):
+                comp["peak_live_drift"] = round(
+                    peak_est / comp["peak_hbm_bytes"], 4)
+        else:
+            comp = ledger
+        row = {
+            "provenance": comp.get("provenance", "unavailable:no-data"),
+            "compiled_flops": comp.get("flops"),
+            "dot_flops_estimate": comp.get("dot_flops_estimate"),
+            "dot_flops_drift": comp.get("dot_flops_drift"),
+            "compiled_peak_bytes": comp.get("peak_hbm_bytes"),
+            "peak_live_estimate_bytes": b.get("peak_live_bytes"),
+            "peak_live_drift": comp.get("peak_live_drift"),
+            "comm_bytes_estimate": b.get("comm_bytes"),
+        }
+        rows[name] = row
+    return rows
+
+
+def build_report(events: list, prom_text: str,
+                 stats: Optional[dict] = None,
+                 budget: Optional[dict] = None) -> dict:
+    """The flight record as one JSON-ready dict (``None`` sections are
+    dropped)."""
+    families = parse_prometheus(prom_text)
+    ts = [e["ts"] for e in events if "ts" in e]
+    profile = [e for e in events
+               if e.get("kind") in ("profile_start", "profile_stop")]
+    out = {
+        "run": {
+            "events": len(events),
+            "duration_s": (max(ts) - min(ts)) if ts else None,
+            "profile_captures": sorted(
+                {e.get("tag", "?") for e in profile
+                 if e.get("kind") == "profile_start"}),
+        },
+        "train": _train_section(events, families),
+        "serve": _serve_section(events, families),
+        "compiled_attribution": _attribution_section(stats, budget),
+    }
+    return {k: v for k, v in out.items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# markdown rendering
+# ---------------------------------------------------------------------------
+
+def _f(v, digits: int = 6) -> str:
+    """Deterministic number formatting: ints stay integral, floats get
+    ``digits`` significant digits, None renders an em-dash."""
+    if v is None:
+        return "—"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        v = int(v)
+    if isinstance(v, int):
+        return str(v)
+    return format(float(v), f".{digits}g")
+
+
+def _kv_lines(d: dict, keys) -> List[str]:
+    return [f"- **{k}**: {_f(d[k])}" for k in keys if k in d
+            and not isinstance(d[k], dict)]
+
+
+def render_markdown(report: dict) -> str:
+    lines = ["# apex_tpu run flight record", ""]
+
+    run = report.get("run", {})
+    lines += ["## Run", "",
+              f"- **events**: {_f(run.get('events'))}",
+              f"- **duration_s**: {_f(run.get('duration_s'))}"]
+    caps = run.get("profile_captures") or []
+    lines.append(f"- **profile_captures**: "
+                 f"{', '.join(caps) if caps else '—'}")
+    lines.append("")
+
+    train = report.get("train")
+    if train:
+        lines += ["## Train", ""]
+        lines += _kv_lines(train, (
+            "steps", "recompiles", "recompiled_steps", "overflow_skips",
+            "tokens_per_s", "mfu", "model_flops_per_step",
+            "exposed_comm_residual_us", "loss"))
+        ss = train.get("step_seconds", {})
+        lines += ["",
+                  "| step seconds | value |", "|---|---|",
+                  f"| samples | {_f(ss.get('samples'))} |",
+                  f"| p50 | {_f(ss.get('p50'))} |",
+                  f"| p90 | {_f(ss.get('p90'))} |",
+                  f"| p99 | {_f(ss.get('p99'))} |",
+                  f"| max | {_f(ss.get('max'))} |"]
+        bp = train.get("badput")
+        if bp:
+            lines += ["",
+                      "| badput bucket | seconds |", "|---|---|"]
+            for k in ("productive_s", "overflow_s", "recompile_s",
+                      "host_gap_s", "wall_s"):
+                if k in bp:
+                    lines.append(f"| {k} | {_f(bp[k])} |")
+            lines.append(f"| goodput_fraction | "
+                         f"{_f(bp.get('goodput_fraction'))} |")
+        lines.append("")
+
+    serve = report.get("serve")
+    if serve:
+        lines += ["## Serve", ""]
+        lines += _kv_lines(serve, (
+            "submitted", "admitted", "finished", "backpressure_waits",
+            "decode_steps", "recompiles"))
+        reasons = serve.get("finish_reasons") or {}
+        if reasons:
+            lines.append(f"- **finish_reasons**: " + ", ".join(
+                f"{k}={_f(v)}" for k, v in sorted(reasons.items())))
+        tt, dt = serve.get("ttft_s", {}), serve.get("decode_token_s", {})
+        lines += ["",
+                  "| latency | p50 | p99 |", "|---|---|---|",
+                  f"| ttft_s ({_f(tt.get('samples'))} samples) "
+                  f"| {_f(tt.get('p50'))} | {_f(tt.get('p99'))} |",
+                  f"| decode_token_s | {_f(dt.get('p50'))} "
+                  f"| {_f(dt.get('p99'))} |"]
+        gp = serve.get("goodput")
+        if gp:
+            lines += ["",
+                      "| goodput bucket | tokens |", "|---|---|"]
+            for k in ("generated_tokens", "prefill_pad_tokens",
+                      "idle_slot_tokens", "truncated_tokens"):
+                if k in gp:
+                    lines.append(f"| {k} | {_f(gp[k])} |")
+            lines.append(f"| goodput_fraction | "
+                         f"{_f(gp.get('goodput_fraction'))} |")
+        lines.append("")
+
+    attr = report.get("compiled_attribution")
+    if attr:
+        lines += ["## Compiled truth vs analytic estimates", "",
+                  "| executable | compiled FLOPs | dot-FLOPs est. "
+                  "| drift | compiled peak B | peak-live est. B "
+                  "| drift | provenance |",
+                  "|---|---|---|---|---|---|---|---|"]
+        for name in sorted(attr):
+            r = attr[name]
+            lines.append(
+                f"| {name} | {_f(r.get('compiled_flops'))} "
+                f"| {_f(r.get('dot_flops_estimate'))} "
+                f"| {_f(r.get('dot_flops_drift'))} "
+                f"| {_f(r.get('compiled_peak_bytes'))} "
+                f"| {_f(r.get('peak_live_estimate_bytes'))} "
+                f"| {_f(r.get('peak_live_drift'))} "
+                f"| {r.get('provenance')} |")
+        lines.append("")
+
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _load_json(path: Optional[str]) -> Optional[dict]:
+    if path is None or not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.observability.report",
+        description="merge a run's JSONL events + Prometheus snapshot "
+                    "+ compiled stats + comm-model budget into one "
+                    "flight-recorder report")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="directory holding telemetry.jsonl + "
+                        "metrics.prom (the APEX_TPU_TELEMETRY sink dir)")
+    p.add_argument("--events", default=None,
+                   help="JSONL event log (default <run_dir>/"
+                        "telemetry.jsonl)")
+    p.add_argument("--prom", default=None,
+                   help="Prometheus snapshot (default <run_dir>/"
+                        "metrics.prom)")
+    p.add_argument("--stats", default=None,
+                   help="xla_stats.json compiled-truth dump (optional)")
+    p.add_argument("--budget", default=None,
+                   help=".analysis_budget.json for the comm-model "
+                        "estimates + committed compiled blocks "
+                        "(optional)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the report as JSON instead of markdown")
+    p.add_argument("--out", default=None,
+                   help="write here instead of stdout")
+    args = p.parse_args(argv)
+
+    # explicitly passed paths must exist — a typo'd --stats silently
+    # omitting the attribution section would read as "nothing was
+    # captured", the worst failure mode for a diagnostics tool
+    for flag, path in (("--events", args.events), ("--prom", args.prom),
+                       ("--stats", args.stats),
+                       ("--budget", args.budget)):
+        if path is not None and not os.path.isfile(path):
+            p.error(f"{flag} file not found: {path}")
+    if args.run_dir is not None and not os.path.isdir(args.run_dir):
+        p.error(f"run_dir not found: {args.run_dir}")
+
+    events_path = args.events or (
+        os.path.join(args.run_dir, "telemetry.jsonl")
+        if args.run_dir else None)
+    prom_path = args.prom or (
+        os.path.join(args.run_dir, "metrics.prom")
+        if args.run_dir else None)
+    if events_path is None and prom_path is None:
+        p.error("need a run_dir or --events/--prom")
+    # run_dir-derived artifacts may legitimately be partial (a
+    # serve-only run exports no train events) — warn, don't die
+    for path in (events_path, prom_path):
+        if path and not os.path.isfile(path):
+            print(f"report: warning: {path} missing — section omitted",
+                  file=sys.stderr)
+
+    events: list = []
+    if events_path and os.path.isfile(events_path):
+        with open(events_path, encoding="utf-8") as fh:
+            events = [json.loads(ln) for ln in fh if ln.strip()]
+    prom_text = ""
+    if prom_path and os.path.isfile(prom_path):
+        with open(prom_path, encoding="utf-8") as fh:
+            prom_text = fh.read()
+
+    report = build_report(events, prom_text,
+                          stats=_load_json(args.stats),
+                          budget=_load_json(args.budget))
+    if args.as_json:
+        text = json.dumps(report, indent=1, sort_keys=True) + "\n"
+    else:
+        text = render_markdown(report)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"report written: {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -102,6 +102,17 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
                "one decode step: dispatch + sampled-token host read "
                "(= per-token latency; one token per slot per step)",
                buckets=DECODE_LATENCY_BUCKETS_S),
+    # -- serving goodput (ISSUE 10): where the device's token-slots go --
+    MetricSpec("serve_badput_prefill_pad_tokens_total", "counter",
+               "prefill token positions computed as bucket padding "
+               "(bucket length minus prompt length, per admission)"),
+    MetricSpec("serve_badput_idle_slot_tokens_total", "counter",
+               "decode token-slots computed for INACTIVE slots "
+               "(capacity minus active, per decode step) — masked "
+               "garbage the fixed-shape executable pays for anyway"),
+    MetricSpec("serve_badput_truncated_tokens_total", "counter",
+               "tokens generated by requests that finished 'truncated' "
+               "(slot/page capacity cut the stream short)"),
     # -- engine dispatch (host wrappers around the donated executables) ---
     MetricSpec("infer_prefill_dispatch_total", "counter",
                "InferenceEngine.prefill dispatches"),
@@ -127,6 +138,30 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("train_exposed_comm_residual_us", "gauge",
                "measured step time minus comm_model.step_time_estimate "
                "overlap_us — the un-modeled exposed-comm residual"),
+    # -- training MFU + goodput (ISSUE 10) --------------------------------
+    MetricSpec("train_mfu", "gauge",
+               "model-FLOP utilisation per measured step: armed "
+               "flops-per-step (compiled truth via xla_stats, or the "
+               "analytic model) / step seconds / chip peak FLOPs"),
+    MetricSpec("train_model_flops_per_step", "gauge",
+               "the flops-per-step the mfu gauge is armed with "
+               "(provenance rides the arm_mfu caller: compiled "
+               "cost_analysis or hand-derived)"),
+    MetricSpec("train_goodput_productive_seconds", "counter",
+               "wall seconds attributed to steps that ran and updated "
+               "(attribution lands when the step's deferred scalars "
+               "resolve, or at flush)"),
+    MetricSpec("train_badput_overflow_seconds", "counter",
+               "wall seconds of steps whose update was skipped on grad "
+               "overflow (found_inf, attributed one step late)"),
+    MetricSpec("train_badput_recompile_seconds", "counter",
+               "wall seconds of steps that triggered a post-warmup "
+               "recompile (the stall the ONE-executable invariant "
+               "exists to prevent)"),
+    MetricSpec("train_badput_host_gap_seconds", "counter",
+               "run wall time covered by NO step interval (input "
+               "stalls, eval/checkpoint pauses between flush "
+               "boundaries) — settled at flush()"),
     MetricSpec("train_step_seconds", "histogram",
                "per-step wall time: interval between step completions "
                "(steady state; first step = its own dispatch bracket "

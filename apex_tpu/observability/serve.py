@@ -58,6 +58,14 @@ class ServeTelemetry:
         self.ttft = d("serve_ttft_seconds")
         self.prefill_seconds = d("serve_prefill_seconds")
         self.decode_token_seconds = d("serve_decode_token_seconds")
+        # goodput decomposition (ISSUE 10): where the fixed-shape
+        # executables' token-slots actually went
+        self.prefill_pad_tokens = d(
+            "serve_badput_prefill_pad_tokens_total")
+        self.idle_slot_tokens = d(
+            "serve_badput_idle_slot_tokens_total")
+        self.truncated_tokens = d(
+            "serve_badput_truncated_tokens_total")
         # separate timers: prefill legitimately compiles once per prompt
         # bucket, and must not advance the decode timer past its warmup
         # step (which would mislabel decode's one compile a recompile)
@@ -95,13 +103,22 @@ class ServeTelemetry:
             pages=int(pages) if pages is not None else None)
 
     @contextlib.contextmanager
-    def prefill_step(self):
-        """Bracket one admission's prefill dispatch + first-token read."""
+    def prefill_step(self, prompt_len: Optional[int] = None,
+                     bucket_len: Optional[int] = None):
+        """Bracket one admission's prefill dispatch + first-token read.
+
+        ``prompt_len``/``bucket_len`` (when the scheduler knows them)
+        feed the padding-badput counter: the bucket positions beyond
+        the prompt are compute the fixed-shape executable spends on
+        padding rows."""
         self._prefill_timer.start()
         try:
             yield
         finally:
             self.prefill_seconds.observe(self._prefill_timer.stop().seconds)
+            if prompt_len is not None and bucket_len is not None \
+                    and bucket_len > prompt_len:
+                self.prefill_pad_tokens.inc(bucket_len - prompt_len)
 
     def first_token(self, uid: int) -> None:
         """The request's first token reached the host: observe TTFT."""
@@ -117,9 +134,11 @@ class ServeTelemetry:
                                  ttft_s=round(ttft, 9))
 
     @contextlib.contextmanager
-    def decode_step(self, active: int):
+    def decode_step(self, active: int, capacity: Optional[int] = None):
         """Bracket one batched decode: dispatch + the scheduler's token
-        read.  One sample = one token per active slot."""
+        read.  One sample = one token per active slot.  ``capacity``
+        (the executable's slot width) feeds the idle-slot badput
+        counter: inactive slots compute masked garbage every step."""
         self.active_slots.set(active)
         self.peak_active.set_max(active)
         self._decode_timer.start()
@@ -131,6 +150,8 @@ class ServeTelemetry:
             self.decode_token_seconds.observe(sample.seconds)
             if sample.recompiled:
                 self.recompiles.inc()
+            if capacity is not None and capacity > active:
+                self.idle_slot_tokens.inc(capacity - active)
 
     def backpressured(self) -> None:
         self.backpressure_waits.inc()
@@ -139,6 +160,8 @@ class ServeTelemetry:
                          n_tokens: int) -> None:
         self.finished.inc(reason=reason)
         self.tokens_generated.inc(n_tokens)
+        if reason == "truncated":
+            self.truncated_tokens.inc(n_tokens)
         t0 = self._submit_ts.pop(uid, None)
         self._first_token_seen.discard(uid)
         e2e = (time.perf_counter() - t0) if t0 is not None else 0.0
@@ -152,6 +175,24 @@ class ServeTelemetry:
             self.pool_occupancy.set(1.0 - free / total)
 
     # -- bookkeeping views --------------------------------------------------
+    def goodput(self) -> dict:
+        """Token-level goodput decomposition: generated tokens vs the
+        token-slots the fixed-shape executables spent on bucket padding
+        and idle decode lanes, plus the truncation-wasted share of the
+        generated tokens.  ``goodput_fraction`` = generated / (generated
+        + padding + idle) — the device-work share that became tokens."""
+        gen = float(self.tokens_generated.total())
+        pad = float(self.prefill_pad_tokens.total())
+        idle = float(self.idle_slot_tokens.total())
+        spent = gen + pad + idle
+        return {
+            "generated_tokens": gen,
+            "prefill_pad_tokens": pad,
+            "idle_slot_tokens": idle,
+            "truncated_tokens": float(self.truncated_tokens.total()),
+            "goodput_fraction": gen / spent if spent > 0 else None,
+        }
+
     def conservation(self) -> dict:
         """The lifecycle conservation law the scheduler tests assert:
         ``submitted == finished + active + rejected`` (active = admitted

@@ -16,6 +16,17 @@ overlap-aware step-time model: hand the construction-time
 ``set_comm_model_us`` and every measured step publishes
 ``measured_us - modeled_us`` — the part of the step the model does not
 explain, which is where un-overlapped comm hides.
+
+MFU + goodput (ISSUE 10): :meth:`TrainTelemetry.arm_mfu` prices every
+measured step against a flops-per-step figure — compiled truth from
+:mod:`~apex_tpu.observability.xla_stats` when the caller has it — and
+the chip-spec peak (:mod:`apex_tpu.chip_specs`, the one table).  The
+badput decomposition splits the run's wall clock into four counters
+whose sum equals the wall time between the first step and ``flush()``:
+productive step intervals, overflow-skipped step intervals (attributed
+one step late, when ``found_inf`` resolves through the deferred
+collector — no sync added), recompile-stall intervals, and the host
+gap (wall time no step interval covers), settled at ``flush()``.
 """
 from __future__ import annotations
 
@@ -50,17 +61,56 @@ class TrainTelemetry:
         self.exposed_comm_residual_us = d(
             "train_exposed_comm_residual_us")
         self.step_seconds = d("train_step_seconds")
+        self.mfu = d("train_mfu")
+        self.model_flops_per_step = d("train_model_flops_per_step")
+        self.productive_seconds = d("train_goodput_productive_seconds")
+        self.overflow_seconds = d("train_badput_overflow_seconds")
+        self.recompile_seconds = d("train_badput_recompile_seconds")
+        self.host_gap_seconds = d("train_badput_host_gap_seconds")
         self._timer = StepTimer()
         self._collector = DeferredScalarCollector(
             on_resolve=self._apply_resolved)
         self._step_index = 0
         self._prev_stop: Optional[float] = None
         self._comm_model_us = comm_model_us
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+        # badput bookkeeping: run start, seconds already attributed to a
+        # bucket this run, and step intervals parked until their
+        # deferred scalars say productive-or-overflow
+        self._run_t0: Optional[float] = None
+        self._attributed_s = 0.0
+        self._pending_attr: dict = {}
 
     def set_comm_model_us(self, us: Optional[float]) -> None:
         """Arm the exposed-comm residual gauge with the modeled step
         time (``comm_model.step_time_estimate(...)["overlap_us"]``)."""
         self._comm_model_us = us
+
+    def arm_mfu(self, flops_per_step: float,
+                peak_flops: Optional[float] = None) -> None:
+        """Arm the ``train_mfu`` gauge: every measured step publishes
+        ``flops_per_step / seconds / peak_flops``.
+
+        ``flops_per_step`` should be the compiled truth
+        (``xla_stats.compile_and_stats(step, args).flops``) when the
+        caller has a compiled step — the analytic ``6*N`` model is the
+        fallback, and which one was used is the caller's provenance to
+        record.  ``peak_flops=None`` resolves the LIVE device's chip
+        through :func:`apex_tpu.chip_specs.local_spec` (host loops
+        only; pass explicitly to stay device-free)."""
+        if peak_flops is None:
+            from apex_tpu.chip_specs import local_spec
+            peak_flops = local_spec().bf16_tflops * 1e12
+        self._flops_per_step = float(flops_per_step)
+        self._peak_flops = float(peak_flops)
+        self.model_flops_per_step.set(float(flops_per_step))
+
+    @property
+    def mfu_armed(self) -> bool:
+        """True once :meth:`arm_mfu` has priced the gauge (callers use
+        this instead of probing private state)."""
+        return self._flops_per_step is not None
 
     # -- per-step -----------------------------------------------------------
     @contextlib.contextmanager
@@ -80,6 +130,8 @@ class TrainTelemetry:
         no honest measurement — its bracket is pure dispatch — so it
         publishes no timing sample (its ``train_step`` event carries
         ``seconds: null``)."""
+        if self._run_t0 is None:
+            self._run_t0 = time.perf_counter()
         self._timer.start()
         try:
             yield
@@ -104,6 +156,19 @@ class TrainTelemetry:
                 if self._comm_model_us is not None:
                     self.exposed_comm_residual_us.set(
                         seconds * 1e6 - self._comm_model_us)
+                if self._flops_per_step is not None:
+                    self.mfu.set(self._flops_per_step
+                                 / max(seconds, 1e-12)
+                                 / self._peak_flops)
+                # badput attribution: a recompiled step is a stall by
+                # definition; every other interval parks until its
+                # deferred scalars say productive-or-overflow (or
+                # flush() settles it productive)
+                if sample.recompiled:
+                    self.recompile_seconds.inc(seconds)
+                    self._attributed_s += seconds
+                else:
+                    self._pending_attr[self._step_index] = seconds
             self.registry.emit_event(
                 "train_step", step=self._step_index,
                 seconds=(None if seconds is None
@@ -132,15 +197,50 @@ class TrainTelemetry:
             self.loss_scale.set(scalars["loss_scale"])
         if "grad_norm" in scalars:
             self.grad_norm.set(scalars["grad_norm"])
-        if scalars.get("found_inf"):
+        overflowed = bool(scalars.get("found_inf"))
+        if overflowed:
             self.overflow_skips.inc()
+        seconds = self._pending_attr.pop(step, None)
+        if seconds is not None:
+            (self.overflow_seconds if overflowed
+             else self.productive_seconds).inc(seconds)
+            self._attributed_s += seconds
+
+    def goodput(self) -> dict:
+        """The badput decomposition as one dict.  After ``flush()`` the
+        four buckets sum to the run's wall time (the conservation law
+        the tests assert); ``goodput_fraction`` is productive/wall."""
+        prod = float(self.productive_seconds.total())
+        out = {
+            "productive_s": prod,
+            "overflow_s": float(self.overflow_seconds.total()),
+            "recompile_s": float(self.recompile_seconds.total()),
+            "host_gap_s": float(self.host_gap_seconds.total()),
+        }
+        wall = sum(out.values())
+        out["wall_s"] = wall
+        out["goodput_fraction"] = prod / wall if wall > 0 else None
+        return out
 
     def flush(self) -> None:
         """End-of-run boundary: resolve everything still parked (this
         one intentionally blocks on the final step) and export sinks.
         Also closes the step-interval chain — a later run on the same
         telemetry must not record the idle gap between runs as a
-        step-time sample."""
+        step-time sample — and settles the badput ledger: parked
+        intervals whose steps never produced deferred scalars count
+        productive, and the run wall time no interval covered lands on
+        the host-gap counter."""
         self._collector.drain()
+        for seconds in self._pending_attr.values():
+            self.productive_seconds.inc(seconds)
+            self._attributed_s += seconds
+        self._pending_attr.clear()
+        if self._run_t0 is not None:
+            gap = (time.perf_counter() - self._run_t0
+                   - self._attributed_s)
+            self.host_gap_seconds.inc(max(gap, 0.0))
+        self._run_t0 = None
+        self._attributed_s = 0.0
         self._prev_stop = None
         self.registry.export()

@@ -297,6 +297,7 @@ def train_loop(loss_fn, tx, **step_kwargs):
 
 def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
                             tokens_per_batch: Optional[int] = None,
+                            mfu_from_compiled: bool = False,
                             **step_kwargs):
     """Telemetry-instrumented ``run(state, batches) -> (state, metrics)``
     (ISSUE 8): the same pure step as :func:`train_loop`, jitted ONCE
@@ -318,6 +319,13 @@ def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
     ``telemetry.flush()`` at the boundary).  Step-loop overhead is the
     per-step dispatch the scan amortizes — use :func:`train_loop` when
     nothing needs observing.
+
+    ``mfu_from_compiled=True`` (ISSUE 10) arms the telemetry's
+    ``train_mfu`` gauge from the COMPILED step's own
+    ``cost_analysis()`` FLOPs (one extra AOT compile at run start —
+    outside every step bracket, so the recompile counter still pins 0;
+    the degraded-backend case simply leaves the gauge unarmed, never a
+    fabricated number).
     """
     from apex_tpu.observability import TrainTelemetry
 
@@ -355,6 +363,14 @@ def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
 
     def run(state: TrainState, batches):
         n = jax.tree.leaves(batches)[0].shape[0]
+        if mfu_from_compiled and not telemetry.mfu_armed and n > 0:
+            from apex_tpu.observability.xla_stats import compile_and_stats
+            batch0 = jax.tree.map(lambda x: x[0], batches)
+            stats = compile_and_stats(_step_with_overflow,
+                                      (state, batch0),
+                                      donate_argnums=(0,))
+            if stats.flops:
+                telemetry.arm_mfu(stats.flops)
         metrics = []
         for i in range(n):
             batch = jax.tree.map(lambda x: x[i], batches)

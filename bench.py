@@ -64,24 +64,13 @@ import jax.numpy as jnp
 # on an otherwise idle machine).  Every leg recompiling through the
 # tunnel is the lesser evil.
 
-# bf16 matmul peak (TFLOP/s) and HBM bandwidth (GB/s) per chip generation;
-# conservative public numbers, used only for the mfu/roofline extras.
-_CHIP_SPECS = {
-    "v4": (275.0, 1228.0),
-    "v5e": (197.0, 819.0),
-    "v5lite": (197.0, 819.0),
-    "v5p": (459.0, 2765.0),
-    "v6e": (918.0, 1640.0),
-    "v6lite": (918.0, 1640.0),
-}
-
-
 def _chip_spec():
-    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-    for key, spec in _CHIP_SPECS.items():
-        if key in kind:
-            return spec
-    return _CHIP_SPECS["v5e"]
+    """(bf16 peak TFLOP/s, HBM GB/s) of the live chip — resolved
+    through the ONE chip-spec table (``apex_tpu.chip_specs``; the old
+    ``_CHIP_SPECS`` dict here was a second copy of the numbers)."""
+    from apex_tpu.chip_specs import local_spec
+    spec = local_spec()
+    return spec.bf16_tflops, spec.hbm_gbps
 
 
 # experiment knobs settable from the CLI without editing leg code
@@ -1246,6 +1235,24 @@ def _bench_main(force_cpu: bool = False) -> None:
     }
     if zero_dp is not None:
         extras.update(zero_extras)
+    # compiled-truth stamp (ISSUE 10): XLA's own FLOPs / peak HBM for
+    # the measured step executable, next to the hand-derived mfu —
+    # compile_and_stats degrades to a provenance marker, never a
+    # fabricated number (the zero leg's un-shard_mapped step cannot
+    # compile standalone and stamps exactly that marker).
+    try:
+        from apex_tpu.observability.xla_stats import compile_and_stats
+        stats = compile_and_stats(fused_step, (fused_state, batch_args),
+                                  donate_argnums=(0,))
+        extras["compiled_stats_provenance"] = stats.provenance
+        if stats.flops is not None:
+            extras["compiled_flops"] = int(stats.flops)
+            extras["mfu_compiled"] = round(
+                stats.flops / t_fused.best / (peak_tflops * 1e12), 4)
+        if stats.peak_hbm_bytes is not None:
+            extras["compiled_peak_hbm_bytes"] = int(stats.peak_hbm_bytes)
+    except Exception:  # noqa: BLE001 — the stamp is auxiliary
+        traceback.print_exc()
     if _OVERRIDES:
         extras["overrides"] = dict(_OVERRIDES)   # capture self-describes
     print(json.dumps({
@@ -1410,18 +1417,34 @@ def _is_tokens_per_s_key(key: str) -> bool:
     return key == "tokens_per_s" or key.endswith("_tokens_per_s")
 
 
+def _hbm_capacity_bound(obj: dict) -> int:
+    """Physical ceiling for a ``compiled_peak_hbm_bytes`` field: the
+    capture's own chip's HBM when the ``chip`` stamp matches the spec
+    table, else the LARGEST capacity in the table (the permissive bound
+    — an unknown chip must not scrub a valid value)."""
+    from apex_tpu.chip_specs import CHIP_SPECS, match_spec
+    spec = match_spec(str(obj.get("chip", "")))
+    if spec is not None:
+        return spec.hbm_bytes
+    return max(s.hbm_bytes for s in CHIP_SPECS.values())
+
+
 def _scrub_capture_values(obj):
     """Drop physically impossible values from a capture payload
     (recursively): ``*_us``/``us_*`` latency fields that are
     non-positive (0.0 = the RTT-collapse artifact, negatives =
     clock-skew garbage) or beyond ``_MAX_PLAUSIBLE_LATENCY_US`` (covers
     the telemetry TTFT / decode-latency fields), ``*_speedup`` fields
-    above ``_MAX_PLAUSIBLE_SPEEDUP``, and ``*tokens_per_s`` throughputs
-    that are non-positive or beyond ``_MAX_PLAUSIBLE_TOKENS_PER_S``.
-    Returns a scrubbed copy; containers are preserved, only the corrupt
-    scalar fields vanish."""
+    above ``_MAX_PLAUSIBLE_SPEEDUP``, ``*tokens_per_s`` throughputs
+    that are non-positive or beyond ``_MAX_PLAUSIBLE_TOKENS_PER_S``,
+    and the ISSUE-10 compiled-truth stamps — ``compiled_flops`` must be
+    positive and ``compiled_peak_hbm_bytes`` must be positive and fit
+    the chip's HBM (the ``chip`` field in the same dict selects the
+    bound).  Returns a scrubbed copy; containers are preserved, only
+    the corrupt scalar fields vanish."""
     if isinstance(obj, dict):
         out = {}
+        hbm_bound = None
         for k, v in obj.items():
             if isinstance(v, (dict, list)):
                 out[k] = _scrub_capture_values(v)
@@ -1436,6 +1459,13 @@ def _scrub_capture_values(obj):
                 if _is_tokens_per_s_key(k) \
                         and not 0.0 < v <= _MAX_PLAUSIBLE_TOKENS_PER_S:
                     continue
+                if k == "compiled_flops" and v <= 0:
+                    continue
+                if k == "compiled_peak_hbm_bytes":
+                    if hbm_bound is None:
+                        hbm_bound = _hbm_capacity_bound(obj)
+                    if not 0 < v <= hbm_bound:
+                        continue
             out[k] = v
         return out
     if isinstance(obj, list):
@@ -1452,7 +1482,9 @@ def _summarize_capture(name, payload):
            "date": stamp[:10] if stamp else "2026-07-30",
            "value_tokens_per_s": payload.get("value"),
            "vs_baseline": payload.get("vs_baseline")}
-    for k in ("mfu", "chip", "flash_attn_us", "adam_gbps",
+    for k in ("mfu", "mfu_compiled", "compiled_flops",
+              "compiled_peak_hbm_bytes", "chip", "flash_attn_us",
+              "adam_gbps",
               "layernorm_gbps", "xentropy_gbps", "xent_fused_us",
               "xent_fused_vs_unfused", "moe_tokens_per_s",
               "bert_mfu", "bert_tokens_per_s",

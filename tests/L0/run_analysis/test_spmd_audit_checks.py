@@ -207,10 +207,19 @@ def test_donated_step_is_clean():
 
 # --- APX215: budget ratchet --------------------------------------------------
 
-def _entry(comm, peak):
+def _compiled(peak_drift=1.5, flops_drift=0.5,
+              provenance="xla:cost+memory"):
+    return {"provenance": provenance, "flops": 1000,
+            "peak_hbm_bytes": 6000, "dot_flops_estimate": 500,
+            "dot_flops_drift": flops_drift,
+            "peak_live_drift": peak_drift}
+
+
+def _entry(comm, peak, compiled=None):
     return {"comm_bytes": comm, "by_collective": {"psum@data": comm},
             "collective_counts": {"psum@data": 1},
-            "peak_live_bytes": peak, "axes": {"data": 2}}
+            "peak_live_bytes": peak, "axes": {"data": 2},
+            "compiled": _compiled() if compiled is None else compiled}
 
 
 def test_budget_growth_fires():
@@ -242,6 +251,126 @@ def test_unbudgeted_executable_fires():
                                             _entry(1024, 9000)}}
     f = compare_budget(report, {"version": 1, "executables": {}})
     assert _rules(f) == ["APX215"] and "no committed budget" in f[0].message
+
+
+# --- APX218: compiled-truth attribution + drift ratchet ---------------------
+
+def _budgets(cur_compiled, pinned_compiled):
+    report = {"version": 1, "executables": {
+        "ddp_allreduce": _entry(1024, 9000, compiled=cur_compiled)}}
+    committed = {"version": 1, "executables": {
+        "ddp_allreduce": _entry(1024, 9000, compiled=pinned_compiled)}}
+    return report, committed
+
+
+def test_apx218_missing_attribution_fires():
+    report = {"version": 1, "executables": {"ddp_allreduce": {
+        k: v for k, v in _entry(1024, 9000).items() if k != "compiled"}}}
+    committed = {"version": 1, "executables": {"ddp_allreduce":
+                                               _entry(1024, 9000)}}
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"]
+    assert "no compiled-stats attribution" in f[0].message
+
+
+def test_apx218_unpinned_compiled_entry_fires():
+    report, committed = _budgets(_compiled(), None)
+    del committed["executables"]["ddp_allreduce"]["compiled"]
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"]
+    assert "no committed compiled-stats entry" in f[0].message
+
+
+def test_apx218_drift_growth_fires_equal_and_shrunk_clean():
+    # peak-live drift moved further from 1 than the pinned band
+    report, committed = _budgets(_compiled(peak_drift=2.0),
+                                 _compiled(peak_drift=1.5))
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"] and "drifted further" in f[0].message
+    # drift on the UNDER side counts the same distance: 1/2 vs 1.5
+    report, committed = _budgets(_compiled(peak_drift=0.5),
+                                 _compiled(peak_drift=1.5))
+    assert _rules(compare_budget(report, committed)) == ["APX218"]
+    # identical drift is clean (the bit-for-bit CI case)
+    report, committed = _budgets(_compiled(), _compiled())
+    assert compare_budget(report, committed) == []
+    # drift moving TOWARD 1 is silent improvement
+    report, committed = _budgets(_compiled(peak_drift=1.2),
+                                 _compiled(peak_drift=1.5))
+    assert compare_budget(report, committed) == []
+
+
+def test_apx218_flops_drift_ratchets_too():
+    report, committed = _budgets(_compiled(flops_drift=0.1),
+                                 _compiled(flops_drift=0.5))
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"]
+    assert "comm_model dot-FLOPs" in f[0].message
+
+
+def test_apx218_lost_drift_ratio_fires():
+    # provenance still full but the analytic estimate degenerated (a
+    # Pallas rewrite zeroing jaxpr_dot_flops drops dot_flops_drift):
+    # the ratchet must not lose its input silently
+    lost = _compiled()
+    del lost["dot_flops_drift"]
+    report, committed = _budgets(lost, _compiled())
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"] and "vanished" in f[0].message
+
+
+def test_apx218_degradation_marker_is_explicit_and_accepted():
+    # a backend that NEVER had memory stats: pinned and current both
+    # carry the marker — clean (the marker IS the attribution)
+    marker = {"provenance": "unavailable:no-cost-analysis-on-this-"
+                            "backend"}
+    report, committed = _budgets(marker, marker)
+    assert compare_budget(report, committed) == []
+
+
+def test_apx218_silent_degradation_fires():
+    # pinned full attribution, current suddenly unavailable: the
+    # executable STOPPED compiling for stats — that is a regression
+    marker = {"provenance": "unavailable:compile-failed:RuntimeError"}
+    report, committed = _budgets(marker, _compiled())
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"] and "DEGRADED" in f[0].message
+
+
+def test_apx218_full_to_cost_only_slide_fires():
+    # the sneakier degradation: memory_analysis() lost but cost still
+    # reporting — would silently disable the peak-live drift ratchet
+    cost_only = {"provenance": "xla:cost-only:memory_analysis-"
+                               "unavailable",
+                 "flops": 1000, "dot_flops_estimate": 500,
+                 "dot_flops_drift": 0.5}
+    report, committed = _budgets(cost_only, _compiled())
+    f = compare_budget(report, committed)
+    assert _rules(f) == ["APX218"] and "DEGRADED" in f[0].message
+    # cost-only pinned AND current: no degradation, flops drift still
+    # ratchets
+    report, committed = _budgets(cost_only, dict(cost_only))
+    assert compare_budget(report, committed) == []
+    # recovering upward (cost-only pinned, full current) is clean
+    report, committed = _budgets(_compiled(), cost_only)
+    assert compare_budget(report, committed) == []
+
+
+def test_audit_entry_always_carries_compiled_attribution():
+    """The auditor itself must attribute or mark — a real executable's
+    fresh entry carries a compiled block with provenance + drift."""
+    mesh = _mesh()
+    fn = shard_map(lambda x: jax.lax.psum(x @ x.T, "data"), mesh=mesh,
+                   in_specs=(P("data"),), out_specs=P())
+    f, entry = _audit_exec(_spec("seeded_compiled", fn,
+                                 (jnp.ones((8, 4)),), {"data": 2}))
+    assert f == [], _rules(f)
+    comp = entry["compiled"]
+    assert comp["provenance"].startswith(("xla:", "unavailable:"))
+    assert comp["dot_flops_estimate"] > 0
+    if comp["provenance"] == "xla:cost+memory":
+        assert comp["flops"] > 0 and comp["peak_hbm_bytes"] > 0
+        assert comp["peak_live_drift"] > 0
 
 
 # --- APX216: the ZeRO RS+AG==AR machine check -------------------------------

@@ -1,0 +1,144 @@
+"""MFU + goodput accounting (ISSUE 10): the train badput buckets
+conserve the run's wall clock, the MFU gauge prices measured steps
+against the armed flops, and the serve counters decompose token work.
+Pure host-side — no engine, no device beyond trivial scalars."""
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.observability import MetricsRegistry, ServeTelemetry, \
+    TrainTelemetry
+
+
+# -- train: MFU -------------------------------------------------------------
+
+def test_mfu_gauge_prices_measured_steps():
+    tel = TrainTelemetry(MetricsRegistry())
+    tel.arm_mfu(flops_per_step=1e9, peak_flops=1e12)
+    assert tel.model_flops_per_step.value() == 1e9
+    for _ in range(3):
+        with tel.step():
+            time.sleep(0.01)
+    mfu = tel.mfu.value()
+    assert mfu is not None and 0 < mfu < 1
+    # ~1e9 flops in >=10ms against a 1e12 peak => mfu <= ~0.1
+    assert mfu == pytest.approx(1e9 / tel._peak_flops
+                                / tel._timer.last.seconds, rel=5.0)
+
+
+def test_mfu_unarmed_publishes_nothing():
+    tel = TrainTelemetry(MetricsRegistry())
+    with tel.step():
+        pass
+    assert tel.mfu.value() is None
+    assert tel.model_flops_per_step.value() is None
+
+
+# -- train: badput conservation ---------------------------------------------
+
+def test_badput_buckets_conserve_wall_time():
+    tel = TrainTelemetry(MetricsRegistry())
+    t0 = time.perf_counter()
+    for i in range(4):
+        with tel.step():
+            time.sleep(0.005)
+        tel.observe_device(loss=jnp.float32(float(i)))
+    time.sleep(0.02)               # host gap the steps don't cover
+    tel.flush()
+    wall = time.perf_counter() - t0
+    g = tel.goodput()
+    assert g["overflow_s"] == 0.0 and g["recompile_s"] == 0.0
+    assert g["productive_s"] > 0
+    assert g["host_gap_s"] > 0     # the sleep before flush
+    # conservation: the four buckets sum to the run wall time
+    assert g["wall_s"] == pytest.approx(wall, abs=0.05)
+    assert 0 < g["goodput_fraction"] <= 1
+
+
+def test_overflow_step_lands_in_overflow_bucket():
+    tel = TrainTelemetry(MetricsRegistry())
+    with tel.step():
+        time.sleep(0.002)
+    tel.observe_device(found_inf=jnp.asarray(True))
+    with tel.step():
+        time.sleep(0.002)
+    tel.observe_device(found_inf=jnp.asarray(False))
+    tel.flush()
+    g = tel.goodput()
+    assert int(tel.overflow_skips.total()) == 1
+    assert g["overflow_s"] > 0
+    assert g["productive_s"] > 0
+    assert g["overflow_s"] < g["wall_s"]
+
+
+def test_steps_without_deferred_scalars_settle_productive_at_flush():
+    tel = TrainTelemetry(MetricsRegistry())
+    for _ in range(3):
+        with tel.step():
+            pass
+    assert tel.productive_seconds.total() == 0.0   # still parked
+    tel.flush()
+    assert tel.productive_seconds.total() > 0
+
+
+def test_flush_resets_run_so_two_runs_both_conserve():
+    tel = TrainTelemetry(MetricsRegistry())
+    for _ in range(2):
+        with tel.step():
+            time.sleep(0.002)
+    tel.flush()
+    g1 = tel.goodput()
+    time.sleep(0.02)               # inter-run idle: NOT part of any run
+    for _ in range(2):
+        with tel.step():
+            time.sleep(0.002)
+    tel.flush()
+    g2 = tel.goodput()
+    # the inter-run idle gap must not land in any bucket
+    assert g2["wall_s"] - g1["wall_s"] < 0.015
+
+
+# -- serve: token goodput ---------------------------------------------------
+
+def test_prefill_padding_counter():
+    tel = ServeTelemetry(MetricsRegistry())
+    with tel.prefill_step(prompt_len=33, bucket_len=64):
+        pass
+    with tel.prefill_step(prompt_len=64, bucket_len=64):
+        pass                       # exact fit: no padding
+    with tel.prefill_step():
+        pass                       # legacy caller: no accounting
+    assert int(tel.prefill_pad_tokens.total()) == 31
+
+
+def test_decode_idle_slot_counter():
+    tel = ServeTelemetry(MetricsRegistry())
+    with tel.decode_step(3, capacity=8):
+        pass
+    with tel.decode_step(8, capacity=8):
+        pass
+    with tel.decode_step(2):
+        pass                       # legacy caller: no accounting
+    assert int(tel.idle_slot_tokens.total()) == 5
+
+
+def test_truncation_waste_counter_and_goodput_view():
+    tel = ServeTelemetry(MetricsRegistry())
+    with tel.prefill_step(prompt_len=10, bucket_len=64):
+        pass
+    with tel.decode_step(1, capacity=2):
+        pass
+    tel.request_finished(0, "length", 8)
+    tel.request_finished(1, "truncated", 3)
+    g = tel.goodput()
+    assert g["generated_tokens"] == 11
+    assert g["prefill_pad_tokens"] == 54
+    assert g["idle_slot_tokens"] == 1
+    assert g["truncated_tokens"] == 3
+    assert g["goodput_fraction"] == pytest.approx(11 / (11 + 54 + 1))
+
+
+def test_goodput_empty_is_none_fraction():
+    tel = ServeTelemetry(MetricsRegistry())
+    assert tel.goodput()["goodput_fraction"] is None

@@ -1,0 +1,139 @@
+"""Flight recorder (ISSUE 10): the report is a pure function of the
+run artifacts — the committed fixture reproduces the committed markdown
+byte-for-byte, the JSON view parses, and the prom/percentile helpers
+hold on their own."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from apex_tpu.observability.report import (build_report,
+                                           histogram_quantile, main,
+                                           parse_prometheus, percentile)
+
+FIXTURE = Path(__file__).parent / "fixtures" / "flight_run"
+
+
+def _fixture_args(extra=()):
+    return [str(FIXTURE),
+            "--stats", str(FIXTURE / "xla_stats.json"),
+            "--budget", str(FIXTURE / "budget.json"), *extra]
+
+
+def test_golden_markdown_byte_stable(tmp_path, capsys):
+    out = tmp_path / "report.md"
+    assert main(_fixture_args(["--out", str(out)])) == 0
+    capsys.readouterr()
+    expected = (FIXTURE / "expected_report.md").read_text(
+        encoding="utf-8")
+    assert out.read_text(encoding="utf-8") == expected, (
+        "the flight-recorder markdown drifted from the committed "
+        "golden — if intentional, regenerate expected_report.md with "
+        "the report CLI and commit it")
+
+
+def test_golden_reproduces_twice_identically(capsys):
+    main(_fixture_args())
+    first = capsys.readouterr().out
+    main(_fixture_args())
+    second = capsys.readouterr().out
+    assert first == second
+
+
+def test_cli_module_entrypoint(tmp_path):
+    """``python -m apex_tpu.observability.report`` — the documented
+    invocation — produces the same golden bytes."""
+    out = tmp_path / "cli.md"
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.observability.report",
+         *_fixture_args(["--out", str(out)])],
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr
+    assert out.read_text(encoding="utf-8") == \
+        (FIXTURE / "expected_report.md").read_text(encoding="utf-8")
+
+
+def test_json_view_parses_and_matches_sections(capsys):
+    assert main(_fixture_args(["--json"])) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert set(report) == {"run", "train", "serve",
+                           "compiled_attribution"}
+    assert report["train"]["steps"] == 6
+    assert report["train"]["badput"]["goodput_fraction"] > 0.5
+    assert report["serve"]["finish_reasons"] == {"length": 1,
+                                                 "truncated": 1}
+    attr = report["compiled_attribution"]
+    assert attr["train_step_dense"]["provenance"] == "xla:cost+memory"
+    # the degraded executable reports NO compiled peak — the marker
+    # rides instead of a fabricated number
+    assert attr["inference_decode"]["compiled_peak_bytes"] is None
+    assert attr["inference_decode"]["provenance"].startswith(
+        "xla:cost-only")
+
+
+def test_degraded_stats_dump_never_pairs_with_ledger_numbers():
+    """A degraded dump entry must not have its 'unavailable:' marker
+    rendered next to the ledger's numbers (or vice versa): one source
+    per row, the better-provenance one wins."""
+    budget = {"executables": {"x": {
+        "comm_bytes": 0, "peak_live_bytes": 100,
+        "compiled": {"provenance": "xla:cost+memory", "flops": 7,
+                     "peak_hbm_bytes": 50, "peak_live_drift": 2.0}}}}
+    stats = {"executables": {"x": {
+        "provenance": "unavailable:no-cost-analysis-on-this-backend"}}}
+    row = build_report([], "", stats=stats,
+                       budget=budget)["compiled_attribution"]["x"]
+    # the committed full-provenance ledger block wins wholesale
+    assert row["provenance"] == "xla:cost+memory"
+    assert row["compiled_flops"] == 7
+    # and a fresh full dump wins over the ledger, with the drift
+    # RECOMPUTED against the dump's numbers (the ledger's 2.0 was
+    # est/50; carrying it next to the dump's 60 would be inconsistent)
+    stats_full = {"executables": {"x": {
+        "provenance": "xla:cost+memory", "flops": 9,
+        "peak_hbm_bytes": 60}}}
+    row = build_report([], "", stats=stats_full,
+                       budget=budget)["compiled_attribution"]["x"]
+    assert row["compiled_flops"] == 9
+    assert row["peak_live_drift"] == round(100 / 60, 4)
+
+
+def test_report_without_stats_uses_budget_compiled_blocks():
+    events = []
+    budget = {"executables": {"x": {
+        "comm_bytes": 0, "peak_live_bytes": 100,
+        "compiled": {"provenance": "xla:cost+memory", "flops": 7,
+                     "peak_hbm_bytes": 50, "peak_live_drift": 2.0}}}}
+    report = build_report(events, "", budget=budget)
+    row = report["compiled_attribution"]["x"]
+    assert row["compiled_flops"] == 7
+    assert row["peak_live_drift"] == 2.0
+
+
+def test_prom_parser_roundtrips_own_sink():
+    from apex_tpu.observability import MetricsRegistry, render_prometheus
+    reg = MetricsRegistry()
+    reg.declared("train_steps_total").inc(3)
+    reg.declared("serve_requests_finished_total").inc(2, reason="eos")
+    h = reg.declared("train_step_seconds")
+    for s in (0.01, 0.02, 0.2):
+        h.observe(s)
+    fams = parse_prometheus(render_prometheus(reg))
+    assert fams["train_steps_total"]["type"] == "counter"
+    assert ("train_steps_total", {}, 3.0) in \
+        fams["train_steps_total"]["samples"]
+    assert ("serve_requests_finished_total", {"reason": "eos"}, 2.0) in \
+        fams["serve_requests_finished_total"]["samples"]
+    # histogram suffixes file under the base family
+    series = {s for s, _, _ in fams["train_step_seconds"]["samples"]}
+    assert {"train_step_seconds_sum", "train_step_seconds_count"} <= \
+        series
+    assert histogram_quantile(fams, "train_step_seconds", 0.5) == 0.025
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 0.5) is None
+    assert percentile([3.0], 0.99) == 3.0
+    vals = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(vals, 0.5) == 2.0
+    assert percentile(vals, 0.99) == 4.0

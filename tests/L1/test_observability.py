@@ -192,6 +192,33 @@ def test_instrumented_train_loop_zero_recompiles_and_parity():
                                rtol=1e-6)
 
 
+def test_instrumented_loop_arms_mfu_from_compiled_flops():
+    """mfu_from_compiled=True (ISSUE 10): the gauge is priced from the
+    COMPILED step's cost_analysis() FLOPs, and the one AOT compile at
+    run start lands outside every step bracket — the recompile counter
+    still pins 0."""
+    params = _make_params()
+    tx = functional.fused_adam(lr=1e-2)
+    tel = TrainTelemetry(MetricsRegistry())
+    run = train_step.instrumented_train_loop(
+        _loss_fn, tx, telemetry=tel, tokens_per_batch=16,
+        mfu_from_compiled=True)
+    state = train_step.init_train_state(tx, params, loss_scale="dynamic")
+    run(state, _batches(4))
+    assert int(tel.recompiles.total()) == 0
+    flops = tel.model_flops_per_step.value()
+    assert flops is not None and flops > 0
+    assert tel.mfu.value() is not None and tel.mfu.value() > 0
+    # the badput decomposition settled at flush: buckets conserve the
+    # run's wall clock (everything productive here — no overflow, no
+    # recompile)
+    g = tel.goodput()
+    assert g["overflow_s"] == 0.0 and g["recompile_s"] == 0.0
+    assert g["productive_s"] > 0 and g["wall_s"] > 0
+    assert g["goodput_fraction"] == pytest.approx(
+        g["productive_s"] / g["wall_s"])
+
+
 def test_instrumented_loop_counts_overflow_skips():
     """found_inf reaches the overflow-skip counter one step late,
     through the deferred collector — never through a blocking read."""

@@ -92,6 +92,32 @@ def test_budget_covers_every_registered_executable_exactly():
         f"run apex-tpu-analyze --spmd --write-budget and commit")
 
 
+def test_every_budget_entry_has_compiled_attribution():
+    """CI guard (ISSUE 10 satellite): every executable in the committed
+    ledger carries either real compiled stats or an EXPLICIT
+    degradation marker — an entry with neither means the APX218
+    attribution silently skipped, and a numeric field on a degraded
+    entry would be a fabricated number."""
+    committed = json.loads((REPO / BUDGET_NAME).read_text())
+    for name, entry in committed["executables"].items():
+        comp = entry.get("compiled")
+        assert isinstance(comp, dict) and "provenance" in comp, (
+            f"{name}: no compiled-stats attribution in {BUDGET_NAME} — "
+            f"re-pin with apex-tpu-analyze --spmd --write-budget")
+        prov = comp["provenance"]
+        if prov.startswith("unavailable:"):
+            # the marker IS the attribution; it must not smuggle numbers
+            assert "flops" not in comp and "peak_hbm_bytes" not in comp, \
+                f"{name}: degraded entry carries fabricated numbers"
+        else:
+            assert prov.startswith("xla:"), (name, prov)
+            assert comp.get("flops", 0) > 0, name
+            assert comp.get("dot_flops_estimate") is not None, name
+            if prov == "xla:cost+memory":
+                assert comp.get("peak_hbm_bytes", 0) > 0, name
+                assert comp.get("peak_live_drift", 0) > 0, name
+
+
 def test_budget_ratchet_fires_on_growth(tmp_path, capsys):
     """A budget pinned BELOW the current ledger fails the run (comm
     growth detected); re-pinning with --write-budget clears it."""
