@@ -20,8 +20,8 @@ from apex_tpu.ops.fused_update import fused_scale
 from apex_tpu.utils import tree_ravel
 
 __all__ = ["LossScaleState", "init_loss_scale", "scale_loss_value",
-           "unscale_grads", "unscale_flat_grads", "update_scale",
-           "LossScaler"]
+           "unscale_grads", "unscale_flat_grads",
+           "nonfinite_leaf_counts", "update_scale", "LossScaler"]
 
 # Reference constants (apex/amp/scaler.py)
 DEFAULT_INIT_SCALE = 2.0 ** 16
@@ -86,6 +86,49 @@ def unscale_flat_grads(flat_grads, state: LossScaleState, axis_name=None):
     if axis_name is not None:
         flag = jax.lax.pmax(flag, axis_name)
     return out, state.replace(found_inf=flag)
+
+
+def nonfinite_leaf_counts(flat_grads, sizes, *, axis_name=None, dp=1,
+                          shard_len=None, rank=None, spans=None):
+    """Per-leaf counts of nonfinite (inf/nan) elements of a flat grad
+    buffer — WHICH parameter overflowed, next to
+    :func:`unscale_flat_grads`'s scalar ``found_inf`` that only says
+    THAT one did.  This is the overflow autopsy's attribution signal
+    (ISSUE 11): computed in-program as one more scalar-vector output of
+    the donated step, resolved one step late by the telemetry, so the
+    attribution costs no host sync and no recompile.
+
+    Dense (``dp == 1``): ``flat_grads`` is the full flat buffer and
+    ``sizes`` its per-leaf layout.  Under ZeRO pass the grad SHARD with
+    the state's static layout (``dp``/``shard_len``/``spans``) and
+    ``rank = lax.axis_index(axis_name)``; ``axis_name`` psums the
+    partial counts replica-uniform — every rank reports the same
+    autopsy, the same APX213 discipline as ``found_inf``'s pmax.
+
+    Returns an ``[n_leaves]`` f32 count vector (0.0 everywhere on a
+    clean step)."""
+    from apex_tpu.optimizers.base import sharded_leaf_nonfinite_counts
+    if axis_name is not None and int(dp) <= 1:
+        # psum of per-rank counts is only correct over SHARDS; on
+        # replicated grads every rank already holds the global counts
+        # and the psum would overcount by the replica count (found_inf
+        # sidesteps the same hazard with pmax)
+        raise ValueError(
+            "axis_name without a sharded layout (dp <= 1): replicated "
+            "grads would psum to replica_count x the true counts — "
+            "drop axis_name (every rank already holds the global "
+            "counts) or pass the shard layout (dp/shard_len/rank)")
+    sizes = tuple(int(s) for s in sizes)
+    if shard_len is None:
+        shard_len = int(flat_grads.shape[0])
+    if rank is None:
+        rank = jnp.int32(0)
+    counts = sharded_leaf_nonfinite_counts(
+        (flat_grads,), sizes, dp=int(dp), shard_len=int(shard_len),
+        rank=rank, spans=spans)[0]
+    if axis_name is not None:
+        counts = jax.lax.psum(counts, axis_name)
+    return counts
 
 
 def update_scale(state: LossScaleState,

@@ -123,6 +123,29 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "disables capture (the context manager is a no-op)",
         read_by="apex_tpu/observability/tracing.py"),
     EnvKnob(
+        name="APEX_TPU_NUMERICS",
+        default="0",
+        effect="numerics observability mode (grad/param/update-norm "
+               "probes + overflow autopsy) for instrumented_train_loop "
+               "when numerics= is not passed: 1 computes the in-program "
+               "probes as extra outputs of the same ONE donated step "
+               "and arms the numerics metric families + JSONL events "
+               "(zero added syncs, zero recompiles); 0 (default) off; "
+               "stamped into train bench captures as numerics",
+        read_by="apex_tpu/observability/numerics.py"),
+    EnvKnob(
+        name="APEX_TPU_NUMERICS_EVERY",
+        default="1",
+        effect="numerics NORM-probe sampling interval: observe the "
+               "norm probes every Nth step (host-side choice of what "
+               "the deferred collector enqueues — the compiled step is "
+               "identical at every value, so flipping it can never "
+               "recompile); the overflow autopsy's per-leaf nonfinite "
+               "vector and loss-scale backoff/growth tracking ride "
+               "every step regardless; stamped into train bench "
+               "captures as numerics_every",
+        read_by="apex_tpu/observability/numerics.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

@@ -204,7 +204,7 @@ def _builders():
         step = train_step.make_train_step(_mlp_loss, tx)
         return step, (state, _mlp_batch()), {}
 
-    def train_step_zero(prefetch=8):
+    def train_step_zero(prefetch=8, numerics=False):
         from apex_tpu import train_step
         from apex_tpu.optimizers import functional
         tx = functional.fused_adam(lr=1e-2)
@@ -215,11 +215,15 @@ def _builders():
         # bytes identical to the monolithic gather (APX215 pins it).
         # The prefetch=0 twin (train_step_zero_mono) keeps the
         # production default — APEX_TPU_ZERO_PREFETCH=0, monolithic
-        # gather — under APX211-APX216.
+        # gather — under APX211-APX216.  The numerics=True twin
+        # (train_step_zero_numerics, ISSUE 11) pins that the numerics
+        # probes add exactly one scalar-vector psum of comm and keep
+        # donation + replica-uniformity intact.
         state, specs = train_step.init_zero_train_state(
             tx, _mlp_params(), ps.DATA_AXIS, 2, loss_scale="dynamic",
             prefetch=prefetch)
-        step = train_step.make_train_step(_mlp_loss, tx, zero=True)
+        step = train_step.make_train_step(_mlp_loss, tx, zero=True,
+                                          numerics=numerics)
         fn = shard_map(step, mesh=mesh, in_specs=(specs, P()),
                        out_specs=(specs, P()))
         return fn, (state, _mlp_batch()), dict(mesh.shape)
@@ -454,6 +458,17 @@ def _builders():
                                                    prefetch=0),
                                  "apex_tpu/train_step.py",
                                  (0,), True, True, True, False),
+        # the numerics-probed zero step (ISSUE 11): same lowering as
+        # train_step_zero plus compute_probes' single packed psum —
+        # its APX215 ledger entry minus train_step_zero's IS the
+        # mode's entire comm cost (the tier-1 twin guard asserts it),
+        # and APX213/214 pin that the probes stay replica-uniform and
+        # donation-intact
+        "train_step_zero_numerics": (functools.partial(train_step_zero,
+                                                       numerics=True),
+                                     "apex_tpu/observability/"
+                                     "numerics.py",
+                                     (0,), True, True, True, False),
         # the fused/unfused LM-head+CE twins (ISSUE 9): the env-knob
         # (APEX_TPU_XENT_CHUNK) selects between these two lowerings, so
         # BOTH are budgeted — the twin guard compares their APX215

@@ -20,6 +20,11 @@ code (device scalars resolve one step late via the deferred collector).
     train      TrainTelemetry (step time, tokens/s, overflow skips,
                loss-scale gauge, exposed-comm residual, MFU gauge,
                badput decomposition)
+    numerics   numerics health (ISSUE 11): in-program grad/param/
+               update-norm probes as extra outputs of the ONE donated
+               step, per-leaf nonfinite attribution, and the overflow
+               autopsy that names WHICH parameter's grads went
+               nonfinite — resolved one step late, zero added syncs
     xla_stats  compiled-truth extractor (ISSUE 10): XLA cost/memory
                analysis per executable, provenance-marked degradation
     report     flight recorder: ``python -m apex_tpu.observability.
@@ -35,6 +40,10 @@ Knobs (registered in ``analysis/env_registry.py``):
   nothing is written.
 * ``APEX_TPU_PROFILE_DIR=<dir>`` arms :func:`profile_capture` (bench
   legs, ``examples/generate.py``) to drop ``jax.profiler`` traces.
+* ``APEX_TPU_NUMERICS=1`` turns the numerics mode on for
+  ``instrumented_train_loop`` when ``numerics=`` is not passed;
+  ``APEX_TPU_NUMERICS_EVERY=N`` samples the probes every N steps
+  (host-side only — the compiled step is identical at every value).
 """
 from __future__ import annotations
 
@@ -46,6 +55,10 @@ from apex_tpu.observability.registry import (Counter, Gauge, Histogram,
                                              global_metrics,
                                              global_registry,
                                              reset_global_registry)
+from apex_tpu.observability.numerics import (NumericsAccountant,
+                                             NumericsProbes,
+                                             compute_probes,
+                                             flat_leaf_names)
 from apex_tpu.observability.serve import ServeTelemetry
 from apex_tpu.observability.sinks import (JsonlSink, PrometheusSink,
                                           render_prometheus)
@@ -72,6 +85,8 @@ __all__ = [
     "trace_annotation", "named_scope", "profile_capture", "profile_dir",
     "start_profile", "stop_profile",
     "ServeTelemetry", "TrainTelemetry",
+    "NumericsProbes", "NumericsAccountant", "compute_probes",
+    "flat_leaf_names",
     "telemetry_enabled", "configure_from_env",
     "Metrics", "global_metrics",
 ]

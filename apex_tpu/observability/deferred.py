@@ -24,10 +24,14 @@ import numpy as np
 __all__ = ["DeferredScalarCollector"]
 
 
-def _materialize(value) -> float:
+def _materialize(value):
     # np.asarray on a jax array blocks until the producing step is done
-    # — which is why this only ever runs on completed prior steps
-    return float(np.asarray(value))
+    # — which is why this only ever runs on completed prior steps.
+    # Scalars resolve to float; small vectors (the ISSUE 11 per-leaf
+    # numerics probes) resolve to a numpy array, same one-step-late
+    # contract.
+    arr = np.asarray(value)
+    return float(arr) if arr.ndim == 0 else arr
 
 
 class DeferredScalarCollector:

@@ -1,0 +1,307 @@
+"""Numerics observability: in-program gradient/update health probes +
+the host-side overflow autopsy (ISSUE 11).
+
+Apex's AMP core handles bf16 overflow *correctly* but silently:
+``found_inf`` skips the step and backs the scale off with no record of
+WHICH parameter produced nonfinite grads, and nothing reports grad
+norms, param norms, or update ratios at runtime.  PRs 8 and 10 built
+the time leg and the memory/FLOPs leg of observability; this module is
+the third leg — numerics health, the dominant failure mode at
+production scale (loss spikes, divergence, dead loss scale).
+
+Two halves, split exactly like the rest of the telemetry stack:
+
+* :func:`compute_probes` runs INSIDE the donated train step
+  (``make_train_step(numerics=True)``) and returns
+  :class:`NumericsProbes` — global flat-grad sq-norm, per-leaf grad
+  sq-norms over the static ``FlatState`` leaf/span layout (the PR 7
+  ``sharded_leaf_sq_norms`` machinery), master-param and update
+  sq-norms, and the per-leaf nonfinite counts that power the overflow
+  autopsy.  Under ZeRO every vector is reduced with ONE ``psum`` over
+  the dp axis, so the probes are replica-uniform (the same APX213
+  discipline as ``found_inf``'s pmax) and the only added comm is that
+  scalar-vector psum — machine-pinned by the ``train_step_zero_
+  numerics`` budget twin.
+
+* :class:`NumericsAccountant` runs on the HOST, fed one step late by
+  the :class:`~apex_tpu.observability.deferred.DeferredScalarCollector`
+  (zero added syncs, zero recompiles — the sacred invariants, re-proven
+  under the new mode by ``tests/L1/test_numerics_train_step.py``): it
+  lands the grad-norm gauge + histogram, per-leaf norm gauges, the
+  update-ratio gauge, loss-scale backoff/growth counters, a
+  ``train_numerics`` JSONL event per observed step, and — when any
+  per-leaf nonfinite count is positive — the ``overflow_autopsy``
+  event naming the parameter leaves whose grads went nonfinite.
+
+Knobs (registered in ``analysis/env_registry.py``):
+``APEX_TPU_NUMERICS=1`` turns the mode on for
+``instrumented_train_loop`` when ``numerics=`` is not passed;
+``APEX_TPU_NUMERICS_EVERY=N`` samples the probes every N steps (the
+step's executable is IDENTICAL either way — sampling only decides
+which steps' device probes the telemetry enqueues).
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Sequence
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["NumericsProbes", "compute_probes", "flat_leaf_names",
+           "numerics_default", "numerics_every_default",
+           "NumericsAccountant", "NUMERICS_METRIC_FAMILIES",
+           "NUMERICS_EVENT_KINDS"]
+
+#: the metric families this mode emits — all pinned in
+#: ``schema.METRIC_SPECS`` (the tier-1 guard asserts the subset).
+NUMERICS_METRIC_FAMILIES = (
+    "train_grad_norm",
+    "train_grad_norm_hist",
+    "train_param_norm",
+    "train_update_ratio",
+    "train_leaf_grad_norm",
+    "train_overflow_leaf_total",
+    "train_nonfinite_grad_elems_total",
+    "train_loss_scale_backoffs_total",
+    "train_loss_scale_growths_total",
+)
+
+#: the JSONL event kinds this mode emits — pinned in
+#: ``schema.EVENT_FIELDS``.
+NUMERICS_EVENT_KINDS = ("train_numerics", "overflow_autopsy")
+
+
+def numerics_default() -> bool:
+    """Effective ``APEX_TPU_NUMERICS``: whether
+    ``instrumented_train_loop`` builds the numerics-probed step when
+    ``numerics=`` is not passed.  Stamped into train bench captures."""
+    return os.environ.get("APEX_TPU_NUMERICS", "0") not in ("", "0")
+
+
+def numerics_every_default() -> int:
+    """Effective ``APEX_TPU_NUMERICS_EVERY``: observe the NORM probes
+    on every Nth step (1 = every step).  The per-leaf nonfinite vector
+    — the autopsy's attribution signal — and loss-scale tracking ride
+    every step regardless: an overflow must never be sampled away.
+    Sampling is host-side only — the compiled step is identical at
+    every value, so flipping it can never recompile."""
+    return max(1, int(os.environ.get("APEX_TPU_NUMERICS_EVERY", "1")))
+
+
+@flax.struct.dataclass
+class NumericsProbes:
+    """Per-step numerics health scalars, computed in-program.
+
+    All f32; ``leaf_*`` vectors are ``[n_leaves]`` in ``FlatState.sizes``
+    order.  Replica-uniform under ZeRO (psum'd).  These ride the step's
+    METRICS output position — never the donated carry — so the
+    telemetry can hold them across the next dispatch without a copy."""
+    grad_sq: jax.Array        # global flat-grad sum of squares
+    param_sq: jax.Array       # master-param sum of squares
+    update_sq: jax.Array      # ||new_master - old_master||^2
+    leaf_grad_sq: jax.Array   # [n_leaves] per-leaf grad sums of squares
+    leaf_nonfinite: jax.Array  # [n_leaves] per-leaf nonfinite counts
+
+
+def compute_probes(opt, new_master: jax.Array, flat_grads: jax.Array,
+                   *, axis_name: Optional[str] = None) -> NumericsProbes:
+    """Build the in-program probes for one step.
+
+    ``opt`` is the PRE-update :class:`~apex_tpu.optimizers.functional.
+    FlatState` (its ``master`` is the old params, its static
+    ``sizes``/``spans``/shard layout locate the leaves inside the flat
+    buffer); ``new_master`` the post-update master; ``flat_grads`` the
+    unscaled flat grads the update consumed — each a SHARD under ZeRO,
+    where ``axis_name`` must be the dp axis so the partial sums psum
+    replica-uniform.  All probes compose into the step's ONE donated
+    executable; the only comm added is a single ``(2*n_leaves+2)``-
+    element f32 psum.
+
+    The per-leaf nonfinite counts are computed on the same unscaled
+    grads ``found_inf`` was derived from (``fused_scale`` flags its
+    OUTPUT), so a step that trips ``found_inf`` always has a nonzero
+    autopsy row and vice versa."""
+    from apex_tpu.optimizers.base import (_nonfinite_f32, _sq_f32,
+                                          sharded_leaf_reduce)
+
+    sizes = tuple(int(s) for s in opt.sizes)
+    g32 = flat_grads.astype(jnp.float32)
+    p32 = opt.master.astype(jnp.float32)
+    d32 = new_master.astype(jnp.float32) - p32
+    sharded = axis_name is not None
+    if sharded:
+        rank = jax.lax.axis_index(axis_name)
+        dp, shard_len, spans = opt.shard_dp, opt.shard_len, opt.spans
+    else:
+        rank = jnp.int32(0)
+        dp, shard_len, spans = 1, int(flat_grads.shape[0]), opt.spans
+
+    # both per-leaf reductions in ONE pass over the span layout (a
+    # second call would re-expand the O(dp * n_leaves) switch tree)
+    leaf_g, leaf_nf = sharded_leaf_reduce(
+        (g32, g32), sizes, dp=dp, shard_len=shard_len, rank=rank,
+        spans=spans, elem_fn=(_sq_f32, _nonfinite_f32))
+    # whole-buffer sums: ZeRO padding carries zero grads / zero master /
+    # zero update (autodiff's unpad transpose zero-fills; the kernels
+    # keep zeros at zero), so the shard sums need no leaf masking
+    scalars = jnp.stack([jnp.sum(p32 * p32), jnp.sum(d32 * d32)])
+    if sharded:
+        # ONE psum for everything — the entire comm cost of the mode
+        packed = jax.lax.psum(
+            jnp.concatenate([leaf_g, leaf_nf, scalars]), axis_name)
+        n = len(sizes)
+        leaf_g, leaf_nf, scalars = (packed[:n], packed[n:2 * n],
+                                    packed[2 * n:])
+    return NumericsProbes(
+        grad_sq=jnp.sum(leaf_g),
+        param_sq=scalars[0],
+        update_sq=scalars[1],
+        leaf_grad_sq=leaf_g,
+        leaf_nonfinite=leaf_nf)
+
+
+def flat_leaf_names(opt) -> tuple:
+    """Leaf names (``tree_util.keystr`` paths, ``FlatState.sizes``
+    order) for a flat state — what the autopsy prints.  Derived via
+    ``jax.eval_shape`` on the state's ``unravel``, so no device compute
+    happens; a tree-less state (built from a flat buffer) falls back to
+    positional names."""
+    if opt.unravel is None:
+        return tuple(f"flat[{i}]" for i in range(len(opt.sizes)))
+    tree = jax.eval_shape(
+        opt.unravel,
+        jax.ShapeDtypeStruct((int(opt.global_numel),),
+                             jnp.dtype(opt.flat_dtype)))
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return tuple(jax.tree_util.keystr(path) for path, _ in flat)
+
+
+def _finite(v) -> bool:
+    return v is not None and math.isfinite(float(v))
+
+
+class NumericsAccountant:
+    """Host-side half of the numerics mode: turns the one-step-late
+    resolved probe scalars into gauges/histograms/counters and the
+    ``train_numerics`` / ``overflow_autopsy`` JSONL events.
+
+    Created by :meth:`~apex_tpu.observability.train.TrainTelemetry.
+    arm_numerics`; every instrument is a schema-declared family, so a
+    run without numerics creates none of them (the flight-recorder
+    report's back-compat contract: pre-PR-11 run dirs render
+    byte-identically)."""
+
+    def __init__(self, registry, leaf_names: Sequence[str],
+                 every: int = 1):
+        d = registry.declared
+        self.registry = registry
+        self.leaf_names = tuple(str(n) for n in leaf_names)
+        self.every = max(1, int(every))
+        self.grad_norm = d("train_grad_norm")
+        self.grad_norm_hist = d("train_grad_norm_hist")
+        self.param_norm = d("train_param_norm")
+        self.update_ratio = d("train_update_ratio")
+        self.leaf_grad_norm = d("train_leaf_grad_norm")
+        self.overflow_leaf = d("train_overflow_leaf_total")
+        self.nonfinite_elems = d("train_nonfinite_grad_elems_total")
+        self.backoffs = d("train_loss_scale_backoffs_total")
+        self.growths = d("train_loss_scale_growths_total")
+        self._prev_scale: Optional[float] = None
+
+    def reset_run(self) -> None:
+        """Run boundary (``TrainTelemetry.flush``): drop the loss-scale
+        chain so run B's fresh scaler starting above/below run A's
+        final scale is never counted as a growth/backoff that never
+        happened (counters and gauges persist — they are cumulative
+        across the telemetry's lifetime by design)."""
+        self._prev_scale = None
+
+    # -- resolution (fires from TrainTelemetry._apply_resolved) ---------
+    def observe_scale(self, scale: Optional[float]) -> None:
+        """Track the resolved loss-scale series: a decrease is an
+        overflow backoff, an increase a growth-interval growth (the
+        classic dynamic schedule moves in no other way)."""
+        if scale is None:
+            return
+        scale = float(scale)
+        prev = self._prev_scale
+        self._prev_scale = scale
+        if prev is None:
+            return
+        if scale < prev:
+            self.backoffs.inc()
+        elif scale > prev:
+            self.growths.inc()
+
+    def resolve(self, step: int, scalars: dict) -> None:
+        """Land one resolved step's probes.  Loss-scale tracking rides
+        every step; the autopsy block fires on any entry carrying a
+        positive per-leaf nonfinite count — including the
+        nonfinite-only entries unsampled steps enqueue under
+        ``APEX_TPU_NUMERICS_EVERY`` (an overflow must never be sampled
+        away); the norm gauges/events land only on sampled steps."""
+        self.observe_scale(scalars.get("loss_scale"))
+        loss_scale = scalars.get("loss_scale")
+        leaf_nf = np.asarray(scalars.get("nx_leaf_nonfinite", ()),
+                             dtype=np.float64).ravel()
+        g_sq = scalars.get("nx_grad_sq")
+        if g_sq is not None:
+            grad_norm = math.sqrt(g_sq) if _finite(g_sq) and g_sq >= 0 \
+                else None
+            param_norm = None
+            p_sq = scalars.get("nx_param_sq")
+            if _finite(p_sq) and p_sq >= 0:
+                param_norm = math.sqrt(p_sq)
+                self.param_norm.set(param_norm)
+            update_ratio = None
+            u_sq = scalars.get("nx_update_sq")
+            if _finite(u_sq) and u_sq >= 0 and param_norm:
+                update_ratio = math.sqrt(u_sq) / param_norm
+                self.update_ratio.set(update_ratio)
+            if grad_norm is not None:
+                # a nonfinite grad norm never lands on the gauge/
+                # histogram — the overflow autopsy below is its record;
+                # a fabricated inf sample would poison every percentile
+                # after it
+                self.grad_norm.set(grad_norm)
+                self.grad_norm_hist.observe(grad_norm)
+
+            leaf_g = np.asarray(scalars.get("nx_leaf_grad_sq", ()),
+                                dtype=np.float64).ravel()
+            for i, name in enumerate(self.leaf_names[:leaf_g.size]):
+                v = leaf_g[i]
+                if np.isfinite(v) and v >= 0:
+                    self.leaf_grad_norm.set(math.sqrt(v), leaf=name)
+
+            self.registry.emit_event(
+                "train_numerics", step=int(step),
+                grad_norm=(None if grad_norm is None
+                           else float(grad_norm)),
+                param_norm=(None if param_norm is None
+                            else float(param_norm)),
+                update_ratio=(None if update_ratio is None
+                              else float(update_ratio)),
+                loss_scale=(None if loss_scale is None
+                            else float(loss_scale)),
+                nonfinite_elems=float(leaf_nf.sum()))
+
+        nf_total = float(leaf_nf.sum())
+        if nf_total > 0:
+            # the autopsy: found_inf fired on this step (fused_scale
+            # flags exactly these nonfinite elements) — name the leaves
+            self.nonfinite_elems.inc(nf_total)
+            leaves = []
+            for i, name in enumerate(self.leaf_names[:leaf_nf.size]):
+                c = leaf_nf[i]
+                if c > 0:
+                    self.overflow_leaf.inc(c, leaf=name)
+                    leaves.append({"leaf": name, "nonfinite": int(c)})
+            self.registry.emit_event(
+                "overflow_autopsy", step=int(step),
+                loss_scale=(None if loss_scale is None
+                            else float(loss_scale)),
+                nonfinite_elems=nf_total, leaves=leaves)

@@ -189,6 +189,77 @@ def _train_section(events: list, families: dict) -> Optional[dict]:
     return out
 
 
+def _numerics_section(events: list, families: dict) -> Optional[dict]:
+    """The ISSUE 11 numerics leg: grad-norm trajectory percentiles,
+    the loss-scale timeline, and the overflow-autopsy table.  Returns
+    None when the run carried no numerics signal at all — a pre-PR-11
+    run dir renders byte-identically (the back-compat golden pins
+    it)."""
+    nx = [e for e in events if e.get("kind") == "train_numerics"]
+    autopsies = [e for e in events
+                 if e.get("kind") == "overflow_autopsy"]
+    has_fams = any(f in families for f in
+                   ("train_grad_norm_hist", "train_param_norm",
+                    "train_update_ratio"))
+    if not (nx or autopsies or has_fams):
+        return None
+    grad_norms = [e["grad_norm"] for e in nx
+                  if e.get("grad_norm") is not None]
+    out: dict = {
+        "observed_steps": len(nx),
+        "grad_norm": {
+            "samples": len(grad_norms),
+            "p50": percentile(grad_norms, 0.50),
+            "p90": percentile(grad_norms, 0.90),
+            "p99": percentile(grad_norms, 0.99),
+            "max": max(grad_norms) if grad_norms else None,
+        },
+    }
+    if not grad_norms:
+        # prom-snapshot-only run (no JSONL survived): bucket-resolution
+        # percentiles from the histogram family
+        for key, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+            v = histogram_quantile(families, "train_grad_norm_hist", q)
+            if v is not None:
+                out["grad_norm"][key] = v
+    for key, fam in (("param_norm", "train_param_norm"),
+                     ("update_ratio", "train_update_ratio"),
+                     ("nonfinite_grad_elems",
+                      "train_nonfinite_grad_elems_total"),
+                     ("loss_scale_backoffs",
+                      "train_loss_scale_backoffs_total"),
+                     ("loss_scale_growths",
+                      "train_loss_scale_growths_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            out[key] = v
+    scales = [(e["step"], e["loss_scale"]) for e in nx
+              if e.get("loss_scale") is not None]
+    if scales:
+        changes = []
+        for step, s in scales[1:]:
+            prev = changes[-1][1] if changes else scales[0][1]
+            if s != prev:
+                changes.append([int(step), float(s)])
+        out["loss_scale"] = {
+            "initial": scales[0][1],
+            "final": scales[-1][1],
+            "min": min(s for _, s in scales),
+            "changes": changes,
+        }
+    if autopsies:
+        out["autopsies"] = [
+            {"step": e.get("step"), "loss_scale": e.get("loss_scale"),
+             "nonfinite_elems": e.get("nonfinite_elems"),
+             "leaves": e.get("leaves") or []}
+            for e in autopsies]
+    leaf_counts = _family_by_label(families,
+                                   "train_overflow_leaf_total", "leaf")
+    if leaf_counts:
+        out["overflow_leaves"] = dict(sorted(leaf_counts.items()))
+    return out
+
+
 def _serve_section(events: list, families: dict) -> Optional[dict]:
     firsts = [e for e in events if e.get("kind") == "request_first_token"]
     finishes = [e for e in events if e.get("kind") == "request_finish"]
@@ -329,6 +400,7 @@ def build_report(events: list, prom_text: str,
                  if e.get("kind") == "profile_start"}),
         },
         "train": _train_section(events, families),
+        "numerics": _numerics_section(events, families),
         "serve": _serve_section(events, families),
         "compiled_attribution": _attribution_section(stats, budget),
     }
@@ -393,6 +465,48 @@ def render_markdown(report: dict) -> str:
                     lines.append(f"| {k} | {_f(bp[k])} |")
             lines.append(f"| goodput_fraction | "
                          f"{_f(bp.get('goodput_fraction'))} |")
+        lines.append("")
+
+    nx = report.get("numerics")
+    if nx:
+        lines += ["## Numerics", ""]
+        lines += _kv_lines(nx, (
+            "observed_steps", "param_norm", "update_ratio",
+            "nonfinite_grad_elems", "loss_scale_backoffs",
+            "loss_scale_growths"))
+        ls = nx.get("loss_scale")
+        if ls:
+            line = (f"- **loss_scale**: initial {_f(ls.get('initial'))}"
+                    f", final {_f(ls.get('final'))}"
+                    f", min {_f(ls.get('min'))}")
+            changes = ls.get("changes") or []
+            if changes:
+                line += " — " + ", ".join(
+                    f"step {_f(s)} → {_f(v)}" for s, v in changes)
+            lines.append(line)
+        gn = nx.get("grad_norm", {})
+        lines += ["",
+                  "| grad norm | value |", "|---|---|",
+                  f"| samples | {_f(gn.get('samples'))} |",
+                  f"| p50 | {_f(gn.get('p50'))} |",
+                  f"| p90 | {_f(gn.get('p90'))} |",
+                  f"| p99 | {_f(gn.get('p99'))} |",
+                  f"| max | {_f(gn.get('max'))} |"]
+        autopsies = nx.get("autopsies")
+        if autopsies:
+            lines += ["",
+                      "| overflow autopsy step | loss scale "
+                      "| nonfinite elems | leaves |",
+                      "|---|---|---|---|"]
+            for a in autopsies:
+                leaves = ", ".join(
+                    f"{l.get('leaf')} ({_f(l.get('nonfinite'))})"
+                    for l in (a.get("leaves") or [])) or "—"
+                lines.append(
+                    f"| {_f(a.get('step'))} "
+                    f"| {_f(a.get('loss_scale'))} "
+                    f"| {_f(a.get('nonfinite_elems'))} "
+                    f"| {leaves} |")
         lines.append("")
 
     serve = report.get("serve")

@@ -44,6 +44,13 @@ REQUEST_LATENCY_BUCKETS_S: Tuple[float, ...] = (
 STEP_TIME_BUCKETS_S: Tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
     5.0, 15.0, 60.0)
+#: global grad-norm histogram bounds (ISSUE 11 numerics mode):
+#: log-spaced over the 7 decades a healthy-to-diverging LLM run spans —
+#: a loss spike is a mass shift rightward across these, visible at
+#: bucket resolution without storing per-step samples.
+GRAD_NORM_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0,
+    10.0, 30.0, 100.0, 1000.0)
 
 
 @dataclass(frozen=True)
@@ -167,6 +174,35 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
                "(steady state; first step = its own dispatch bracket "
                "incl. warmup compile)",
                buckets=STEP_TIME_BUCKETS_S),
+    # -- training numerics health (ISSUE 11; created only when the
+    #    numerics mode is armed, so pre-PR-11 runs expose none of these)
+    MetricSpec("train_grad_norm_hist", "histogram",
+               "global unscaled flat-grad L2 norm per observed step "
+               "(in-program probe, resolved one step late; nonfinite "
+               "norms land on the overflow autopsy, never here)",
+               buckets=GRAD_NORM_BUCKETS),
+    MetricSpec("train_param_norm", "gauge",
+               "fp32 master-param L2 norm (deferred: previous step)"),
+    MetricSpec("train_update_ratio", "gauge",
+               "||delta w|| / ||w|| of the applied update (deferred: "
+               "previous step; 0 on overflow-skipped steps)"),
+    MetricSpec("train_leaf_grad_norm", "gauge",
+               "per-parameter-leaf unscaled grad L2 norm over the "
+               "FlatState leaf layout (deferred: previous step)",
+               labels=("leaf",)),
+    MetricSpec("train_overflow_leaf_total", "counter",
+               "nonfinite grad elements attributed to each parameter "
+               "leaf by the overflow autopsy (one step late)",
+               labels=("leaf",)),
+    MetricSpec("train_nonfinite_grad_elems_total", "counter",
+               "total nonfinite grad elements the numerics probes "
+               "observed (sum of the per-leaf autopsy counts)"),
+    MetricSpec("train_loss_scale_backoffs_total", "counter",
+               "dynamic loss-scale halvings (overflow backoffs) seen "
+               "in the resolved loss-scale series"),
+    MetricSpec("train_loss_scale_growths_total", "counter",
+               "dynamic loss-scale doublings (growth-interval growths) "
+               "seen in the resolved loss-scale series"),
 ]}
 
 #: JSONL event stream: ``{"ts": float, "kind": str, ...kind fields}``.
@@ -182,6 +218,16 @@ EVENT_FIELDS: Dict[str, Dict[str, str]] = {
                        "e2e_s": "float"},
     "train_step": {"step": "int", "seconds": "float|null",
                    "recompiled": "bool"},
+    "train_numerics": {"step": "int", "grad_norm": "float|null",
+                       "param_norm": "float|null",
+                       "update_ratio": "float|null",
+                       "loss_scale": "float|null",
+                       "nonfinite_elems": "float"},
+    # the overflow autopsy (ISSUE 11): WHICH parameter leaves went
+    # nonfinite on a found_inf step, attributed one step late.
+    # ``leaves`` is a list of {"leaf": str, "nonfinite": int} objects.
+    "overflow_autopsy": {"step": "int", "loss_scale": "float|null",
+                         "nonfinite_elems": "float", "leaves": "list"},
     "profile_start": {"dir": "str", "tag": "str"},
     "profile_stop": {"dir": "str", "tag": "str"},
 }

@@ -81,6 +81,9 @@ class TrainTelemetry:
         self._run_t0: Optional[float] = None
         self._attributed_s = 0.0
         self._pending_attr: dict = {}
+        # numerics mode (ISSUE 11): armed lazily so a run without it
+        # creates none of the numerics metric families
+        self._numerics = None
 
     def set_comm_model_us(self, us: Optional[float]) -> None:
         """Arm the exposed-comm residual gauge with the modeled step
@@ -111,6 +114,31 @@ class TrainTelemetry:
         """True once :meth:`arm_mfu` has priced the gauge (callers use
         this instead of probing private state)."""
         return self._flops_per_step is not None
+
+    def arm_numerics(self, leaf_names, every: int = 1):
+        """Arm the numerics mode (ISSUE 11): create the numerics metric
+        families and the :class:`~apex_tpu.observability.numerics.
+        NumericsAccountant` that resolves the in-program probes one
+        step late — grad/param-norm gauges, the grad-norm histogram,
+        update ratio, per-leaf norms, loss-scale backoff/growth
+        counters, and the overflow autopsy naming the parameter leaves
+        whose grads went nonfinite.  ``leaf_names`` is the FlatState
+        leaf-name tuple (:func:`~apex_tpu.observability.numerics.
+        flat_leaf_names`).  Returns the accountant."""
+        from apex_tpu.observability.numerics import NumericsAccountant
+        self._numerics = NumericsAccountant(self.registry, leaf_names,
+                                            every=every)
+        return self._numerics
+
+    @property
+    def numerics_armed(self) -> bool:
+        return self._numerics is not None
+
+    @property
+    def numerics(self):
+        """The armed :class:`NumericsAccountant` (None before
+        :meth:`arm_numerics`)."""
+        return self._numerics
 
     # -- per-step -----------------------------------------------------------
     @contextlib.contextmanager
@@ -177,17 +205,35 @@ class TrainTelemetry:
             self._step_index += 1
 
     def observe_device(self, loss=None, found_inf=None, loss_scale=None,
-                       grad_norm=None) -> None:
+                       grad_norm=None, probes=None,
+                       leaf_nonfinite=None) -> None:
         """Enqueue this step's device scalars, then poll — landing the
         PREVIOUS step's scalars on the gauges.  The poll sits here,
         AFTER this step's enqueue, so it resolves exactly one step
         back (this step's executable has been dispatched, so blocking
         on the previous step's outputs costs nothing — the contract
-        :mod:`~apex_tpu.observability.deferred` documents)."""
+        :mod:`~apex_tpu.observability.deferred` documents).
+
+        ``probes`` is the step's :class:`~apex_tpu.observability.
+        numerics.NumericsProbes` (ISSUE 11) — its device arrays ride
+        the same deferred entry, so the numerics gauges and the
+        overflow autopsy resolve one step late like everything else.
+        ``leaf_nonfinite`` enqueues ONLY the per-leaf nonfinite vector
+        (the autopsy signal) for steps the sampling interval skips —
+        an overflow on an unsampled step must still name its leaf."""
+        extra = {}
+        if probes is not None:
+            extra = {"nx_grad_sq": probes.grad_sq,
+                     "nx_param_sq": probes.param_sq,
+                     "nx_update_sq": probes.update_sq,
+                     "nx_leaf_grad_sq": probes.leaf_grad_sq,
+                     "nx_leaf_nonfinite": probes.leaf_nonfinite}
+        elif leaf_nonfinite is not None:
+            extra = {"nx_leaf_nonfinite": leaf_nonfinite}
         self._collector.enqueue(self._step_index - 1, loss=loss,
                                 found_inf=found_inf,
                                 loss_scale=loss_scale,
-                                grad_norm=grad_norm)
+                                grad_norm=grad_norm, **extra)
         self._collector.poll()
 
     def _apply_resolved(self, step: int, scalars: dict) -> None:
@@ -205,6 +251,8 @@ class TrainTelemetry:
             (self.overflow_seconds if overflowed
              else self.productive_seconds).inc(seconds)
             self._attributed_s += seconds
+        if self._numerics is not None:
+            self._numerics.resolve(step, scalars)
 
     def goodput(self) -> dict:
         """The badput decomposition as one dict.  After ``flush()`` the
@@ -243,4 +291,6 @@ class TrainTelemetry:
         self._run_t0 = None
         self._attributed_s = 0.0
         self._prev_stop = None
+        if self._numerics is not None:
+            self._numerics.reset_run()
         self.registry.export()

@@ -28,7 +28,8 @@ from apex_tpu.utils import tree_ravel
 
 __all__ = ["FusedOptimizerBase", "broadcast_leaf_scalars",
            "shard_leaf_spans", "prefetch_leaf_spans",
-           "sharded_leaf_sq_norms", "sharded_leaf_broadcast"]
+           "sharded_leaf_reduce", "sharded_leaf_sq_norms",
+           "sharded_leaf_nonfinite_counts", "sharded_leaf_broadcast"]
 
 #: above this DP width the lax.switch-over-ranks static-span paths
 #: (O(dp * n_leaves) compiled branches) give way to the global-buffer
@@ -115,12 +116,25 @@ def prefetch_leaf_spans(sizes: Sequence[int], span_leaves: Sequence[int],
     return out
 
 
-def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
-                          *, dp: int, shard_len: int,
-                          rank: jax.Array, spans=None) -> jax.Array:
-    """``[len(vecs), n_leaves]`` per-tensor partial sums of squares of MY
-    shard of each flat vector, over the static leaf-span layout.  The
-    caller ``psum``s the result over the dp axis to get global norms.
+def sharded_leaf_reduce(vecs: Sequence[jax.Array], sizes: Sequence[int],
+                        *, dp: int, shard_len: int, rank: jax.Array,
+                        spans=None, elem_fn) -> jax.Array:
+    """``[len(vecs), n_leaves]`` per-tensor partial SUMS of
+    ``elem_fn(shard slice)`` of MY shard of each flat vector, over the
+    static leaf-span layout.  The caller ``psum``s the result over the
+    dp axis to get global per-leaf reductions.
+
+    ``elem_fn`` maps a 1-D slice to same-shape f32 values that are
+    summed per leaf — ``jnp.square`` (after an f32 cast) gives the
+    classic sq-norms; a nonfinite indicator gives the overflow-autopsy
+    per-leaf counts (ISSUE 11).  A sequence of callables (one per
+    entry of ``vecs``) applies a different reduction per vector in the
+    SAME pass — the numerics probes hand the grad buffer twice with
+    (square, nonfinite) so both reductions share one slice/switch
+    tree instead of compiling the span machinery twice.  Every fn must
+    map values elementwise and send 0 -> 0: the bounded-compile
+    fallback sums over a zero-elsewhere global buffer, so a nonzero
+    image of zero would count padding.
 
     ``spans`` overrides the contiguous-block layout with the ZeRO
     layered-prefetch shard layout: the per-span leaf-count tuple
@@ -137,6 +151,12 @@ def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
     for BOTH layouts — at the cost of O(n) extra HBM traffic."""
     sizes = [int(s) for s in sizes]
     n_tensors = len(sizes)
+    fns = (tuple(elem_fn) if isinstance(elem_fn, (list, tuple))
+           else (elem_fn,) * len(vecs))
+    if len(fns) != len(vecs):
+        raise ValueError(
+            f"elem_fn sequence has {len(fns)} entries for "
+            f"{len(vecs)} vectors")
     spans = tuple(spans) if spans else None
     if dp > _SWITCH_MAX_DP:
         if spans is None:
@@ -158,13 +178,13 @@ def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
                 leaf0 += count
                 off += lk
 
-        def global_sq_norms(vec):
-            sq = jnp.square(vec.astype(jnp.float32))
+        def global_reduce(vec, fn):
+            mapped = fn(vec).astype(jnp.float32)
             row = [jnp.float32(0.0)] * n_tensors
             for off, lk, leaf0, group in groups:
                 buf = jax.lax.dynamic_update_slice_in_dim(
                     jnp.zeros((dp * lk,), jnp.float32),
-                    jax.lax.slice_in_dim(sq, off, off + lk),
+                    jax.lax.slice_in_dim(mapped, off, off + lk),
                     rank * lk, axis=0)
                 o = 0
                 for j, s in enumerate(group):
@@ -172,7 +192,8 @@ def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
                         jax.lax.dynamic_slice_in_dim(buf, o, s))
                     o += s
             return jnp.stack(row)
-        return jnp.stack([global_sq_norms(v) for v in vecs])
+        return jnp.stack([global_reduce(v, fn)
+                          for v, fn in zip(vecs, fns)])
 
     spans = (shard_leaf_spans(sizes, dp, shard_len) if spans is None
              else prefetch_leaf_spans(sizes, spans, dp))
@@ -180,12 +201,15 @@ def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
     def branch(rs):
         def f(vs):
             out = []
-            for vec in vs:
+            for vec, fn in zip(vs, fns):
                 row = [jnp.float32(0.0)] * n_tensors
                 for i, lo, hi in rs:
-                    row[i] = jnp.sum(jnp.square(
+                    # one slice per (vec, leaf-window) — a multi-fn
+                    # call shares this tree instead of re-expanding
+                    # the span layout per reduction
+                    row[i] = jnp.sum(fn(
                         jax.lax.dynamic_slice_in_dim(
-                            vec, lo, hi - lo).astype(jnp.float32)))
+                            vec, lo, hi - lo)).astype(jnp.float32))
                 out.append(jnp.stack(row))
             return jnp.stack(out)
         return f
@@ -193,6 +217,40 @@ def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
     if dp == 1:
         return branch(spans[0])(tuple(vecs))
     return jax.lax.switch(rank, [branch(rs) for rs in spans], tuple(vecs))
+
+
+def _sq_f32(x):
+    return jnp.square(x.astype(jnp.float32))
+
+
+def _nonfinite_f32(x):
+    return (~jnp.isfinite(x)).astype(jnp.float32)
+
+
+def sharded_leaf_sq_norms(vecs: Sequence[jax.Array], sizes: Sequence[int],
+                          *, dp: int, shard_len: int,
+                          rank: jax.Array, spans=None) -> jax.Array:
+    """``[len(vecs), n_leaves]`` per-tensor partial sums of squares of MY
+    shard of each flat vector (see :func:`sharded_leaf_reduce` for the
+    layout/compile-cost contract).  The caller ``psum``s the result
+    over the dp axis to get global norms."""
+    return sharded_leaf_reduce(vecs, sizes, dp=dp, shard_len=shard_len,
+                               rank=rank, spans=spans, elem_fn=_sq_f32)
+
+
+def sharded_leaf_nonfinite_counts(vecs: Sequence[jax.Array],
+                                  sizes: Sequence[int], *, dp: int,
+                                  shard_len: int, rank: jax.Array,
+                                  spans=None) -> jax.Array:
+    """``[len(vecs), n_leaves]`` per-tensor partial COUNTS of nonfinite
+    (inf/nan) elements of MY shard of each flat vector — the overflow
+    autopsy's attribution signal (ISSUE 11).  Padding is zero (finite),
+    so it never counts; the caller ``psum``s over the dp axis for the
+    global per-leaf counts.  Same static-span machinery as
+    :func:`sharded_leaf_sq_norms`."""
+    return sharded_leaf_reduce(vecs, sizes, dp=dp, shard_len=shard_len,
+                               rank=rank, spans=spans,
+                               elem_fn=_nonfinite_f32)
 
 
 def sharded_leaf_broadcast(scalars: jax.Array, sizes: Sequence[int], *,

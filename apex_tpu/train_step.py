@@ -158,7 +158,7 @@ def _pmean_float_leaves(aux, axis):
 
 def make_train_step(loss_fn, tx, *, has_aux: bool = False,
                     grad_transform: Optional[Callable] = None,
-                    zero: bool = False):
+                    zero: bool = False, numerics: bool = False):
     """Build a pure ``step(state, batch) -> (state, metrics)``.
 
     ``loss_fn(params, batch)`` takes the MATERIALIZED params pytree (the
@@ -190,6 +190,17 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
     — same bytes, same ONE executable.  The reported loss — and
     every float leaf of ``aux`` — is ``pmean``'d over the axis (the
     global-batch metric); integer/bool aux diagnostics stay rank-local.
+
+    ``numerics=True`` (ISSUE 11) adds the in-program numerics health
+    probes: the step returns ``(state, (metrics, probes))`` where
+    ``probes`` is a :class:`~apex_tpu.observability.numerics.
+    NumericsProbes` — global flat-grad/param/update sq-norms plus the
+    per-leaf grad sq-norms and nonfinite counts that power the overflow
+    autopsy, computed over the unscaled grads the update consumed.
+    Everything still composes into the same ONE donated executable;
+    under ZeRO the probes add exactly one ``(2*n_leaves+2)``-element
+    f32 ``psum`` (replica-uniform, APX213-clean — pinned by the
+    ``train_step_zero_numerics`` budget twin).
 
     The result is a valid ``lax.scan`` body; jit it (or the scan around
     it) with ``donate_argnums=(0,)`` — the whole state is donation-safe.
@@ -262,11 +273,20 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
             flat_g, scaler = unscale_flat_grads(
                 flat_g, scaler,
                 axis_name=axis if zero and dp > 1 else None)
-            opt = tx.update(opt, flat_g, noop_flag=scaler.found_inf)
+            new_opt = tx.update(opt, flat_g, noop_flag=scaler.found_inf)
             scaler = update_scale(scaler)
         else:
-            opt = tx.update(opt, flat_g)
-        new_state = state.replace(opt=opt, scaler=scaler)
+            new_opt = tx.update(opt, flat_g)
+        probes = None
+        if numerics:
+            # in-program numerics probes over the UNSCALED grads the
+            # update consumed and the pre/post masters — extra scalar
+            # outputs of the same ONE donated executable
+            from apex_tpu.observability.numerics import compute_probes
+            probes = compute_probes(
+                opt, new_opt.master, flat_g,
+                axis_name=axis if zero and dp > 1 else None)
+        new_state = state.replace(opt=new_opt, scaler=scaler)
         if zero and dp > 1:
             loss = jax.lax.pmean(loss, axis)
             # aux floats get the same global-batch semantics as the
@@ -276,7 +296,8 @@ def make_train_step(loss_fn, tx, *, has_aux: bool = False,
             # their dtype/meaning
             if aux is not None:
                 aux = _pmean_float_leaves(aux, axis)
-        return new_state, ((loss, aux) if has_aux else loss)
+        metrics = (loss, aux) if has_aux else loss
+        return new_state, ((metrics, probes) if numerics else metrics)
 
     return step
 
@@ -298,6 +319,8 @@ def train_loop(loss_fn, tx, **step_kwargs):
 def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
                             tokens_per_batch: Optional[int] = None,
                             mfu_from_compiled: bool = False,
+                            numerics: Optional[bool] = None,
+                            numerics_every: Optional[int] = None,
                             **step_kwargs):
     """Telemetry-instrumented ``run(state, batches) -> (state, metrics)``
     (ISSUE 8): the same pure step as :func:`train_loop`, jitted ONCE
@@ -326,15 +349,39 @@ def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
     outside every step bracket, so the recompile counter still pins 0;
     the degraded-backend case simply leaves the gauge unarmed, never a
     fabricated number).
+
+    ``numerics`` (ISSUE 11) builds the numerics-probed step
+    (``make_train_step(numerics=True)``) and arms the telemetry's
+    :class:`~apex_tpu.observability.numerics.NumericsAccountant` —
+    grad/param-norm and update-ratio gauges, the grad-norm histogram,
+    loss-scale backoff/growth counters, and the overflow autopsy that
+    names the parameter leaves whose grads went nonfinite, all
+    resolved one step late (zero added syncs, zero recompiles, the
+    step still ONE donated executable).  ``None`` reads
+    ``APEX_TPU_NUMERICS`` (default off).  ``numerics_every`` samples
+    the NORM probes every Nth step (``None`` reads
+    ``APEX_TPU_NUMERICS_EVERY``, default 1) — the per-leaf nonfinite
+    vector rides every step so an overflow is never sampled away; the
+    compiled step is identical at every sampling value.
     """
     from apex_tpu.observability import TrainTelemetry
+    from apex_tpu.observability.numerics import (numerics_default,
+                                                 numerics_every_default)
 
     if telemetry is None:
         telemetry = TrainTelemetry()
-    step = make_train_step(loss_fn, tx, **step_kwargs)
+    if numerics is None:
+        numerics = numerics_default()
+    numerics = bool(numerics)
+    if numerics_every is None:
+        numerics_every = numerics_every_default()
+    numerics_every = max(1, int(numerics_every))
+    step = make_train_step(loss_fn, tx, numerics=numerics,
+                           **step_kwargs)
 
     def _step_with_overflow(state, batch):
-        new_state, m = step(state, batch)
+        new_state, out = step(state, batch)
+        m, probes = out if numerics else (out, None)
         sc_in, sc_out = state.scaler, new_state.scaler
         overflow = None
         if sc_out is not None:
@@ -348,7 +395,7 @@ def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
             # always-False for fixed scales — both already-broken or
             # skip-free regimes.
             overflow = sc_out.loss_scale < sc_in.loss_scale
-        return new_state, (m, overflow)
+        return new_state, (m, overflow, probes)
 
     jstep = jax.jit(_step_with_overflow, donate_argnums=(0,))
 
@@ -363,6 +410,10 @@ def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
 
     def run(state: TrainState, batches):
         n = jax.tree.leaves(batches)[0].shape[0]
+        if numerics and not telemetry.numerics_armed:
+            from apex_tpu.observability.numerics import flat_leaf_names
+            telemetry.arm_numerics(flat_leaf_names(state.opt),
+                                   every=numerics_every)
         if mfu_from_compiled and not telemetry.mfu_armed and n > 0:
             from apex_tpu.observability.xla_stats import compile_and_stats
             batch0 = jax.tree.map(lambda x: x[0], batches)
@@ -375,13 +426,24 @@ def instrumented_train_loop(loss_fn, tx, *, telemetry=None,
         for i in range(n):
             batch = jax.tree.map(lambda x: x[i], batches)
             with telemetry.step(tokens=tokens_per_batch):
-                state, (m, overflow) = jstep(state, batch)
+                state, (m, overflow, probes) = jstep(state, batch)
             loss = m[0] if isinstance(m, tuple) else m
             sc = state.scaler
+            # probe sampling is a host-side choice of what to ENQUEUE —
+            # the executable computed them either way, so no recompile
+            # can ride the interval knob.  The per-leaf nonfinite
+            # vector (the autopsy's attribution signal) rides EVERY
+            # step regardless: an overflow on an unsampled step must
+            # still name its leaf
+            sampled = i % numerics_every == 0
             telemetry.observe_device(
                 loss=loss,
                 found_inf=overflow,
-                loss_scale=None if sc is None else snap(sc.loss_scale))
+                loss_scale=None if sc is None else snap(sc.loss_scale),
+                probes=probes if sampled else None,
+                leaf_nonfinite=(probes.leaf_nonfinite
+                                if probes is not None and not sampled
+                                else None))
             metrics.append(m)
         telemetry.flush()          # end-of-run boundary: blocking is fine
         return state, metrics

@@ -1123,6 +1123,17 @@ def _bench_main(force_cpu: bool = False) -> None:
     tx = fopt.fused_adam(lr=1e-4, betas=(0.9, 0.999), eps=1e-8,
                          weight_decay=0.0)
 
+    # numerics-mode knob (ISSUE 11): when on, the measured fused step
+    # GENUINELY computes the in-program probes — carried through the
+    # timing scan so DCE can't strip them — so the capture's `numerics`
+    # stamp describes the measured executable, never just the
+    # environment.  Only the default fused leg honors it (the
+    # split-state and zero legs measure other structural questions).
+    from apex_tpu.observability.numerics import (numerics_default,
+                                                 numerics_every_default)
+    numerics_on = (numerics_default() and not _ov("split_state", 0)
+                   and not _ov("zero", 0))
+
     if _ov("split_state", 0):
         # two-buffer structure: fwd+bwd on the bf16 tree, grads raveled
         # as a forward op, fused update on the flat fp32 master (no
@@ -1137,14 +1148,19 @@ def _bench_main(force_cpu: bool = False) -> None:
             return (unravel(st.master), st)
     else:
         def fused_step(state, batch):
-            st = state
+            st = state[0] if numerics_on else state
             tokens, labels = batch
             def loss_fn(fp):
                 # unravel restores each leaf's original dtype (bf16
                 # weights)
                 return model.apply(unravel(fp), tokens, labels)
             loss, g = jax.value_and_grad(loss_fn)(st.master)
-            return tx.update(st, g.astype(jnp.float32))
+            g32 = g.astype(jnp.float32)
+            new_st = tx.update(st, g32)
+            if not numerics_on:
+                return new_st
+            from apex_tpu.observability.numerics import compute_probes
+            return new_st, compute_probes(st, new_st.master, g32)
 
     def naive_adam(flatp, g, m, v):
         # unfused elementwise update chain (eager-style baseline)
@@ -1182,6 +1198,13 @@ def _bench_main(force_cpu: bool = False) -> None:
     state = (flat_params, m, v)               # naive-baseline leg state
     fused_state = ((unravel(flat_params), tx.init(flat_params))
                    if _ov("split_state", 0) else tx.init(flat_params))
+    if numerics_on:
+        # probes ride the scan carry (one leaf: the whole flat buffer)
+        from apex_tpu.observability.numerics import NumericsProbes
+        z = jnp.zeros((), jnp.float32)
+        zl = jnp.zeros((len(fused_state.sizes),), jnp.float32)
+        fused_state = (fused_state,
+                       NumericsProbes(z, z, z, zl, zl))
     batch_args = (tokens, labels)
 
     zero_shard = zero_dp = None
@@ -1233,6 +1256,14 @@ def _bench_main(force_cpu: bool = False) -> None:
         # stamp survives the leg merge beside it.
         "train_xent_chunk": xent_chunk,
     }
+    # numerics-mode knob stamp (ISSUE 11): whether the MEASURED fused
+    # step computed the in-program numerics probes (the split-state and
+    # zero legs never do — the stamp says so instead of echoing the
+    # env), plus the sampling interval as env provenance (host-side
+    # only; the executable is identical at every value by design) —
+    # same contract as zero_prefetch/train_xent_chunk
+    extras["numerics"] = int(numerics_on)
+    extras["numerics_every"] = numerics_every_default()
     if zero_dp is not None:
         extras.update(zero_extras)
     # compiled-truth stamp (ISSUE 10): XLA's own FLOPs / peak HBM for
@@ -1431,7 +1462,10 @@ def _hbm_capacity_bound(obj: dict) -> int:
 
 def _scrub_capture_values(obj):
     """Drop physically impossible values from a capture payload
-    (recursively): ``*_us``/``us_*`` latency fields that are
+    (recursively): NaN/Inf in ANY numeric field (NaN passes every
+    range comparison below as False, so without this gate a poisoned
+    measurement sails through checks written as rejections — ISSUE 11
+    satellite), ``*_us``/``us_*`` latency fields that are
     non-positive (0.0 = the RTT-collapse artifact, negatives =
     clock-skew garbage) or beyond ``_MAX_PLAUSIBLE_LATENCY_US`` (covers
     the telemetry TTFT / decode-latency fields), ``*_speedup`` fields
@@ -1442,6 +1476,7 @@ def _scrub_capture_values(obj):
     the chip's HBM (the ``chip`` field in the same dict selects the
     bound).  Returns a scrubbed copy; containers are preserved, only
     the corrupt scalar fields vanish."""
+    import math as _math
     if isinstance(obj, dict):
         out = {}
         hbm_bound = None
@@ -1450,6 +1485,8 @@ def _scrub_capture_values(obj):
                 out[k] = _scrub_capture_values(v)
                 continue
             if isinstance(v, (int, float)) and not isinstance(v, bool):
+                if not _math.isfinite(v):
+                    continue
                 if _is_us_key(k) and \
                         not 0.0 < v <= _MAX_PLAUSIBLE_LATENCY_US:
                     continue

@@ -63,11 +63,27 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=None,
                    help="data-parallel width for --zero (default: all "
                         "local devices)")
+    p.add_argument("--numerics", action="store_true",
+                   help="drive the run host-side through "
+                        "instrumented_train_loop(numerics=True): the "
+                        "step gains in-program grad/param-norm + "
+                        "update-ratio probes and the overflow autopsy "
+                        "names any parameter leaf whose grads go "
+                        "nonfinite (same ONE donated executable; "
+                        "APEX_TPU_TELEMETRY=<dir> writes the JSONL + "
+                        "Prometheus artifacts). Not combinable with "
+                        "--zero here (the scanned zero run stays one "
+                        "opaque executable)")
     p.add_argument("--platform", type=str, default=None,
                    help="force a jax platform (e.g. cpu); the axon TPU "
                         "plugin ignores JAX_PLATFORMS, so this calls "
                         "jax.config.update before any device query")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.zero and args.numerics:
+        p.error("--numerics drives a host-side step loop; the --zero "
+                "run here is one scanned executable — run them "
+                "separately")
+    return args
 
 
 def synthetic_mlm_batch(rng, args):
@@ -186,6 +202,14 @@ def main(argv=None):
             lambda st, bs: jax.lax.scan(zstep, st, bs), mesh=mesh,
             in_specs=(state_specs, batch_specs),
             out_specs=(state_specs, P())), donate_argnums=(0,))
+    elif args.numerics:
+        # ISSUE 11: host-driven loop so the numerics probes have
+        # somewhere to land between steps — same step math (parity
+        # pinned by tests/L1/test_numerics_train_step.py), grad/param
+        # norms + overflow autopsy resolved one step late
+        run = train_step.instrumented_train_loop(
+            loss_fn, tx, tokens_per_batch=args.batch_size * args.seq,
+            numerics=True)
     else:
         run = train_step.train_loop(loss_fn, tx)
     state, losses = run(state, batches)
@@ -202,6 +226,14 @@ def main(argv=None):
     print(f"final loss {losses[-1]:.4f} (first {losses[0]:.4f}) "
           f"held-out {heldout_loss:.4f} "
           f"scale {float(state.scaler.loss_scale):.0f}")
+    if args.numerics:                  # parse_args forbids it with --zero
+        acc = run.telemetry.numerics
+        fmt = lambda v: "—" if v is None else f"{v:.4g}"  # noqa: E731
+        print(f"numerics: grad_norm {fmt(acc.grad_norm.value())} "
+              f"param_norm {fmt(acc.param_norm.value())} "
+              f"update_ratio {fmt(acc.update_ratio.value())} "
+              f"backoffs {int(acc.backoffs.total())} "
+              f"nonfinite_elems {int(acc.nonfinite_elems.total())}")
     return losses, heldout_loss
 
 

@@ -1,0 +1,244 @@
+"""ISSUE 11 unit level: the in-program probe math against numpy
+oracles (dense and sharded/span layouts), the per-leaf nonfinite
+attribution, the host-side NumericsAccountant's gauges/counters/
+events, and the deferred collector's vector extension."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp.scaler import nonfinite_leaf_counts
+from apex_tpu.observability import (DeferredScalarCollector, JsonlSink,
+                                    MetricsRegistry)
+from apex_tpu.observability.numerics import (NUMERICS_EVENT_KINDS,
+                                             NUMERICS_METRIC_FAMILIES,
+                                             NumericsAccountant,
+                                             compute_probes,
+                                             flat_leaf_names)
+from apex_tpu.observability import schema
+from apex_tpu.optimizers import functional
+from apex_tpu.optimizers.base import (sharded_leaf_nonfinite_counts,
+                                      sharded_leaf_reduce)
+
+
+def _params():
+    return {"b": jnp.asarray(np.linspace(-0.5, 0.5, 4),
+                             jnp.float32),
+            "w": jnp.asarray(
+                np.linspace(-1.0, 1.0, 12).reshape(3, 4),
+                jnp.float32)}
+
+
+# -- in-program probes ------------------------------------------------------
+
+def test_compute_probes_dense_matches_numpy_oracle():
+    tx = functional.fused_adam(lr=1e-2)
+    params = _params()
+    opt = tx.init(params)
+    g = np.linspace(-2.0, 2.0, 16).astype(np.float32)
+    new = tx.update(opt, jnp.asarray(g))
+    probes = compute_probes(opt, new.master, jnp.asarray(g))
+
+    np.testing.assert_allclose(float(probes.grad_sq),
+                               float(np.sum(g.astype(np.float64) ** 2)),
+                               rtol=1e-6)
+    master = np.asarray(opt.master)
+    np.testing.assert_allclose(float(probes.param_sq),
+                               float(np.sum(master ** 2)), rtol=1e-6)
+    delta = np.asarray(new.master) - master
+    np.testing.assert_allclose(float(probes.update_sq),
+                               float(np.sum(delta ** 2)), rtol=1e-5)
+    # leaf order == tree_leaves order (b before w); their sum is the
+    # global grad sq-norm
+    np.testing.assert_allclose(np.asarray(probes.leaf_grad_sq),
+                               [np.sum(g[:4] ** 2), np.sum(g[4:] ** 2)],
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(jnp.sum(probes.leaf_grad_sq)),
+                               float(probes.grad_sq), rtol=1e-6)
+    assert np.asarray(probes.leaf_nonfinite).tolist() == [0.0, 0.0]
+
+
+def test_nonfinite_attribution_names_the_poisoned_leaf():
+    g = np.linspace(-1.0, 1.0, 16).astype(np.float32)
+    g[5] = np.inf
+    g[7] = np.nan
+    counts = nonfinite_leaf_counts(jnp.asarray(g), (4, 12))
+    assert counts.tolist() == [0.0, 2.0]   # both poisons live in leaf 1
+    g[0] = -np.inf
+    counts = nonfinite_leaf_counts(jnp.asarray(g), (4, 12))
+    assert counts.tolist() == [1.0, 2.0]
+
+
+@pytest.mark.parametrize("spans", [None, (1, 1)])
+def test_sharded_leaf_nonfinite_counts_match_dense(spans):
+    """Sharded partial counts summed over ranks == the dense count,
+    on both the contiguous-block and the prefetch span layout."""
+    from apex_tpu.optimizers.functional import _layout_master
+    sizes = (4, 12)
+    dp = 2
+    g = np.linspace(-1.0, 1.0, 16).astype(np.float32)
+    g[2] = np.inf
+    g[9] = np.nan
+    g[15] = np.inf
+    dense = nonfinite_leaf_counts(jnp.asarray(g), sizes)
+    laid = _layout_master(jnp.asarray(g), sizes=sizes,
+                          spans=spans or (), dp=dp)
+    shard_len = int(laid.shape[0]) // dp
+    total = np.zeros(2)
+    for r in range(dp):
+        shard = laid[r * shard_len:(r + 1) * shard_len]
+        total += np.asarray(sharded_leaf_nonfinite_counts(
+            (shard,), sizes, dp=dp, shard_len=shard_len,
+            rank=jnp.int32(r), spans=spans)[0])
+    np.testing.assert_array_equal(total, np.asarray(dense))
+
+
+def test_sharded_leaf_reduce_general_elem_fn():
+    """The generalized reduce underlying both sq-norms and nonfinite
+    counts: an arbitrary zero-preserving elem_fn sums per leaf."""
+    sizes = (3, 5)
+    v = jnp.asarray(np.arange(8, dtype=np.float32))
+    out = sharded_leaf_reduce((v,), sizes, dp=1, shard_len=8,
+                              rank=jnp.int32(0),
+                              elem_fn=lambda x: jnp.abs(x))
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               [0 + 1 + 2, 3 + 4 + 5 + 6 + 7])
+
+
+def test_flat_leaf_names_are_keystr_paths_without_compute():
+    tx = functional.fused_adam(lr=1e-2)
+    opt = tx.init(_params())
+    assert flat_leaf_names(opt) == ("['b']", "['w']")
+    flat_only = tx.init(jnp.zeros((8,), jnp.float32))
+    assert flat_leaf_names(flat_only) == ("flat[0]",)
+
+
+# -- deferred vector extension ---------------------------------------------
+
+def test_deferred_collector_resolves_vectors():
+    col = DeferredScalarCollector()
+    col.enqueue(0, leaf=jnp.asarray([1.0, 2.0]), scalar=jnp.float32(3.0))
+    col.enqueue(1, leaf=jnp.asarray([4.0, 5.0]))
+    [(step, resolved)] = col.poll()
+    assert step == 0 and resolved["scalar"] == 3.0
+    np.testing.assert_array_equal(resolved["leaf"], [1.0, 2.0])
+
+
+# -- host-side accountant ---------------------------------------------------
+
+def _resolved(grad_sq=4.0, param_sq=9.0, update_sq=0.09,
+              leaf_g=(1.0, 3.0), leaf_nf=(0.0, 0.0), loss_scale=None):
+    return {"nx_grad_sq": grad_sq, "nx_param_sq": param_sq,
+            "nx_update_sq": update_sq,
+            "nx_leaf_grad_sq": np.asarray(leaf_g),
+            "nx_leaf_nonfinite": np.asarray(leaf_nf),
+            **({} if loss_scale is None else {"loss_scale": loss_scale})}
+
+
+def test_accountant_lands_gauges_and_events(tmp_path):
+    reg = MetricsRegistry()
+    jsonl = tmp_path / "t.jsonl"
+    reg.add_sink(JsonlSink(str(jsonl)))
+    acc = NumericsAccountant(reg, ("['b']", "['w']"))
+    acc.resolve(0, _resolved(loss_scale=65536.0))
+    assert acc.grad_norm.value() == pytest.approx(2.0)
+    assert acc.param_norm.value() == pytest.approx(3.0)
+    assert acc.update_ratio.value() == pytest.approx(0.1)
+    assert acc.grad_norm_hist.count() == 1
+    assert acc.leaf_grad_norm.value(leaf="['b']") == pytest.approx(1.0)
+    assert acc.leaf_grad_norm.value(leaf="['w']") == pytest.approx(
+        np.sqrt(3.0))
+    [ev] = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    assert ev["kind"] == "train_numerics" and ev["step"] == 0
+    assert ev["grad_norm"] == pytest.approx(2.0)
+    assert ev["loss_scale"] == 65536.0
+    assert ev["nonfinite_elems"] == 0.0
+
+
+def test_accountant_autopsy_names_leaves_and_counts(tmp_path):
+    reg = MetricsRegistry()
+    jsonl = tmp_path / "t.jsonl"
+    reg.add_sink(JsonlSink(str(jsonl)))
+    acc = NumericsAccountant(reg, ("['b']", "['w']"))
+    acc.resolve(3, _resolved(grad_sq=float("inf"),
+                             leaf_g=(float("inf"), 1.0),
+                             leaf_nf=(5.0, 0.0), loss_scale=32768.0))
+    # nonfinite values never land on gauges/histogram
+    assert acc.grad_norm.value() is None
+    assert acc.grad_norm_hist.count() == 0
+    assert acc.leaf_grad_norm.value(leaf="['b']") is None
+    assert acc.leaf_grad_norm.value(leaf="['w']") == pytest.approx(1.0)
+    # counters attribute per leaf
+    assert acc.overflow_leaf.value(leaf="['b']") == 5.0
+    assert acc.overflow_leaf.value(leaf="['w']") == 0.0
+    assert acc.nonfinite_elems.total() == 5.0
+    events = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    [autopsy] = [e for e in events if e["kind"] == "overflow_autopsy"]
+    assert autopsy["step"] == 3
+    assert autopsy["leaves"] == [{"leaf": "['b']", "nonfinite": 5}]
+    assert autopsy["nonfinite_elems"] == 5.0
+
+
+def test_accountant_tracks_backoffs_and_growths():
+    acc = NumericsAccountant(MetricsRegistry(), ("x",))
+    for scale in (65536.0, 65536.0, 32768.0, 32768.0, 65536.0):
+        acc.observe_scale(scale)
+    assert acc.backoffs.total() == 1.0
+    assert acc.growths.total() == 1.0
+
+
+def test_flush_resets_scale_chain_across_runs():
+    """Reusing one telemetry across runs (the flush() contract): run
+    B's fresh scaler starting above run A's decayed final scale must
+    not count as a growth that never happened."""
+    from apex_tpu.observability import TrainTelemetry
+    import jax.numpy as jnp
+    tel = TrainTelemetry(MetricsRegistry())
+    acc = tel.arm_numerics(("x",))
+    for scale in (65536.0, 16384.0):           # run A decays
+        with tel.step():
+            pass
+        tel.observe_device(loss_scale=jnp.float32(scale))
+    tel.flush()                                # run boundary
+    with tel.step():
+        pass
+    tel.observe_device(loss_scale=jnp.float32(65536.0))  # run B fresh
+    tel.flush()
+    assert acc.backoffs.total() == 1.0         # run A's real backoff
+    assert acc.growths.total() == 0.0, \
+        "the cross-run scale jump was counted as a growth"
+
+
+def test_accountant_unsampled_step_is_noop_beyond_scale_tracking():
+    """APEX_TPU_NUMERICS_EVERY: an unsampled step resolves with no
+    nx_* keys — nothing lands except the loss-scale series."""
+    acc = NumericsAccountant(MetricsRegistry(), ("x",), every=2)
+    acc.resolve(0, {"loss_scale": 65536.0, "loss": 1.0})
+    acc.resolve(1, {"loss_scale": 32768.0, "loss": 1.0})
+    assert acc.grad_norm.value() is None
+    assert acc.grad_norm_hist.count() == 0
+    assert acc.backoffs.total() == 1.0
+    assert acc.every == 2
+
+
+# -- schema guard (tier-1 satellite) ----------------------------------------
+
+def test_every_numerics_family_and_event_is_schema_pinned():
+    """The conscious-re-pin guard: every numerics metric family and
+    JSONL event kind the mode emits is declared in the schema (and so
+    in the committed .telemetry_schema.json, bit-for-bit via
+    test_schema_guard)."""
+    for fam in NUMERICS_METRIC_FAMILIES:
+        assert fam in schema.METRIC_SPECS, fam
+    for kind in NUMERICS_EVENT_KINDS:
+        assert kind in schema.EVENT_FIELDS, kind
+    # the histogram family carries the pinned grad-norm buckets
+    assert schema.METRIC_SPECS["train_grad_norm_hist"].buckets == \
+        schema.GRAD_NORM_BUCKETS
+    # labeled families declare the leaf label (per-leaf attribution)
+    assert schema.METRIC_SPECS["train_leaf_grad_norm"].labels == \
+        ("leaf",)
+    assert schema.METRIC_SPECS["train_overflow_leaf_total"].labels == \
+        ("leaf",)

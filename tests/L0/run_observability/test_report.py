@@ -137,3 +137,74 @@ def test_percentile_nearest_rank():
     vals = [1.0, 2.0, 3.0, 4.0]
     assert percentile(vals, 0.5) == 2.0
     assert percentile(vals, 0.99) == 4.0
+
+
+# -- numerics section (ISSUE 11) -------------------------------------------
+
+NUMERICS_FIXTURE = Path(__file__).parent / "fixtures" / \
+    "flight_run_numerics"
+
+
+def test_numerics_golden_markdown_byte_stable(tmp_path, capsys):
+    """A run WITH numerics events renders the Numerics section —
+    autopsy table, grad-norm percentiles, loss-scale timeline — and
+    the committed golden reproduces byte-for-byte."""
+    out = tmp_path / "report.md"
+    assert main([str(NUMERICS_FIXTURE), "--out", str(out)]) == 0
+    capsys.readouterr()
+    expected = (NUMERICS_FIXTURE / "expected_report.md").read_text(
+        encoding="utf-8")
+    got = out.read_text(encoding="utf-8")
+    assert got == expected, (
+        "the numerics flight-recorder markdown drifted from the "
+        "committed golden — if intentional, regenerate "
+        "expected_report.md with the report CLI and commit it")
+    assert "## Numerics" in got
+    assert "overflow autopsy step" in got
+    assert "['w1'] (64)" in got
+
+
+def test_numerics_json_section_shape(capsys):
+    assert main([str(NUMERICS_FIXTURE), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    nx = report["numerics"]
+    assert nx["observed_steps"] == 8
+    assert nx["grad_norm"]["samples"] == 7    # the poisoned step is null
+    assert nx["loss_scale_backoffs"] == 1
+    assert nx["loss_scale"]["initial"] == 2 * nx["loss_scale"]["final"]
+    assert nx["loss_scale"]["changes"] == [[3, 32768.0]]
+    [autopsy] = nx["autopsies"]
+    assert autopsy["leaves"] == [{"leaf": "['w1']", "nonfinite": 64}]
+    assert nx["overflow_leaves"] == {"['w1']": 64.0}
+
+
+def test_report_without_numerics_stays_byte_stable(capsys):
+    """Back-compat (ISSUE 11 satellite): a pre-PR-11 run dir — the
+    ISSUE 10 fixture, committed before numerics existed — renders NO
+    Numerics section and still reproduces its committed golden
+    byte-for-byte (the section predicate never fires on absent
+    signals)."""
+    main(_fixture_args())
+    got = capsys.readouterr().out
+    assert "## Numerics" not in got
+    assert "numerics" not in build_report(
+        [], (FIXTURE / "metrics.prom").read_text(encoding="utf-8"))
+    assert got == (FIXTURE / "expected_report.md").read_text(
+        encoding="utf-8")
+
+
+def test_numerics_section_histogram_fallback_from_prom_only():
+    """A run whose JSONL was lost but whose prom snapshot survived:
+    grad-norm percentiles fall back to bucket resolution from
+    train_grad_norm_hist."""
+    from apex_tpu.observability import MetricsRegistry, render_prometheus
+    reg = MetricsRegistry()
+    h = reg.declared("train_grad_norm_hist")
+    for v in (0.02, 0.25, 0.26, 0.9):
+        h.observe(v)
+    reg.declared("train_param_norm").set(3.5)
+    report = build_report([], render_prometheus(reg))
+    nx = report["numerics"]
+    assert nx["grad_norm"]["samples"] == 0
+    assert nx["grad_norm"]["p50"] == 0.3      # bucket bound covering 2/4
+    assert nx["param_norm"] == 3.5

@@ -114,3 +114,33 @@ def test_scrub_existing_rules_still_hold():
     payload = {"flash_attn_us": 0.0, "adam_speedup": 1e9,
                "tokens_per_s": -3.0, "mfu": 0.48}
     assert bench._scrub_capture_values(payload) == {"mfu": 0.48}
+
+
+def test_scrub_rejects_nan_and_inf_in_any_numeric_field():
+    """ISSUE 11 satellite: NaN evaluates False against EVERY range
+    comparison, so before the finite gate a poisoned capture sailed
+    through checks written as rejections (``speedup > MAX`` is False
+    for NaN; ``flops <= 0`` is False for NaN) — now nonfinite values
+    vanish from any numeric field, range-checked or not."""
+    import math
+
+    import bench
+
+    nan, inf = float("nan"), float("inf")
+    poisoned = {
+        "mfu": nan,                       # no range rule at all
+        "adam_speedup": nan,              # rule is `> MAX` — False for NaN
+        "compiled_flops": nan,            # rule is `<= 0` — False for NaN
+        "tokens_per_s": inf,
+        "flash_attn_us": inf,
+        "loss": -inf,
+        "value": 42.0,
+        "label": "kept",
+        "nested": {"bert_mfu": nan, "bert_tokens_per_s": 10.0},
+    }
+    out = bench._scrub_capture_values(poisoned)
+    assert out == {"value": 42.0, "label": "kept",
+                   "nested": {"bert_tokens_per_s": 10.0}}
+    for v in [v for d in (out, out["nested"]) for v in d.values()
+              if isinstance(v, float)]:
+        assert math.isfinite(v)

@@ -1,0 +1,292 @@
+"""ISSUE 11 acceptance: the numerics mode adds ZERO host syncs and
+ZERO recompiles, keeps the step ONE donated executable, attributes a
+seeded nonfinite grad to the correct parameter leaf, and its registered
+SPMD/budget twin pins that the probes' entire comm cost is one packed
+scalar psum.
+
+Integration-level: real flat-native train steps through
+``instrumented_train_loop(numerics=True)``, the real deferred
+collector, real sinks on disk, and the real auditor ledger."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import train_step
+from apex_tpu.observability import (JsonlSink, MetricsRegistry,
+                                    NumericsProbes, TrainTelemetry)
+from apex_tpu.optimizers import functional
+
+N_LAYERS = 2
+
+
+def _make_params(seed=0, n_layers=N_LAYERS):
+    rng = np.random.RandomState(seed)
+    return {k: jnp.asarray(v, jnp.float32)
+            for i in range(n_layers)
+            for k, v in ((f"w{i}", rng.randn(8, 8) * 0.3),
+                         (f"b{i}", rng.randn(8) * 0.01))}
+
+
+def _loss_fn(params, batch):
+    h = batch["x"]
+    for i in range(len(params) // 2):
+        h = jnp.tanh(h @ params[f"w{i}"] + params[f"b{i}"])
+    # poison = 0 -> clean loss; huge -> inf grads ONLY in w0 (the term
+    # touches no other leaf), the seeded-failure fixture the autopsy
+    # must attribute
+    return jnp.mean((h - batch["y"]) ** 2) \
+        + jnp.sum(params["w0"]) * batch["poison"]
+
+
+def _batches(n, poison_step=None, seed=1):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 16, 8).astype(np.float32)
+    poison = np.zeros((n,), np.float32)
+    if poison_step is not None:
+        poison[poison_step] = 1e38
+    return {"x": jnp.asarray(x),
+            "y": jnp.tanh(jnp.asarray(x) @ jnp.ones((8, 8)) * 0.1),
+            "poison": jnp.asarray(poison)}
+
+
+def test_seeded_failure_autopsy_names_exactly_the_poisoned_leaf(
+        tmp_path):
+    """The headline acceptance: poison ONE leaf's grads on one step —
+    the autopsy names exactly that leaf (all 64 elements of the 8x8
+    w0), the overflow-skip counter increments, the loss scale backs
+    off, and the recompile counter stays 0."""
+    reg = MetricsRegistry()
+    jsonl = tmp_path / "t.jsonl"
+    reg.add_sink(JsonlSink(str(jsonl)))
+    tel = TrainTelemetry(reg)
+    tx = functional.fused_adam(lr=1e-2)
+    run = train_step.instrumented_train_loop(_loss_fn, tx,
+                                             telemetry=tel,
+                                             numerics=True)
+    state = train_step.init_train_state(tx, _make_params(),
+                                        loss_scale="dynamic")
+    scale0 = float(state.scaler.loss_scale)
+    state, _ = run(state, _batches(4, poison_step=1))
+
+    assert int(tel.overflow_skips.total()) == 1
+    assert int(tel.recompiles.total()) == 0
+    assert float(state.scaler.loss_scale) == scale0 * 0.5
+    acc = tel.numerics
+    assert acc is not None and tel.numerics_armed
+    assert acc.backoffs.total() == 1.0
+    assert acc.overflow_leaf.value(leaf="['w0']") == 64.0
+    for leaf in ("['b0']", "['b1']", "['w1']"):
+        assert acc.overflow_leaf.value(leaf=leaf) == 0.0, leaf
+
+    events = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    [autopsy] = [e for e in events if e["kind"] == "overflow_autopsy"]
+    assert autopsy["step"] == 1
+    assert autopsy["leaves"] == [{"leaf": "['w0']", "nonfinite": 64}]
+    assert autopsy["nonfinite_elems"] == 64.0
+    nx = [e for e in events if e["kind"] == "train_numerics"]
+    assert [e["step"] for e in nx] == [0, 1, 2, 3]
+    # the poisoned step's grad norm is null (nonfinite), never a number
+    assert nx[1]["grad_norm"] is None
+    assert all(e["grad_norm"] > 0 for i, e in enumerate(nx) if i != 1)
+
+
+def test_clean_run_parity_with_uninstrumented_step_is_bitwise():
+    """On clean steps the numerics-probed step must be the SAME
+    program math: post-run params bitwise equal to the uninstrumented
+    scanned loop's."""
+    tx = functional.fused_adam(lr=1e-2)
+    run = train_step.instrumented_train_loop(
+        _loss_fn, tx, telemetry=TrainTelemetry(MetricsRegistry()),
+        numerics=True)
+    state = train_step.init_train_state(tx, _make_params(),
+                                        loss_scale="dynamic")
+    state, _ = run(state, _batches(4))
+    ref = train_step.init_train_state(tx, _make_params(),
+                                      loss_scale="dynamic")
+    ref, _ = train_step.train_loop(_loss_fn, tx)(ref, _batches(4))
+    for a, b in zip(jax.tree.leaves(state.params()),
+                    jax.tree.leaves(ref.params())):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_numerics_step_is_one_compiled_executable():
+    """The probes compose into the SAME one donated executable — not a
+    second program riding beside the step."""
+    tx = functional.fused_adam(lr=1e-2)
+    state = train_step.init_train_state(tx, _make_params(),
+                                        loss_scale="dynamic")
+    step = jax.jit(train_step.make_train_step(_loss_fn, tx,
+                                              numerics=True))
+    batch = jax.tree.map(lambda x: x[0], _batches(1))
+
+    events = []
+    from jax._src import monitoring as _mon
+    saved = {attr: list(getattr(_mon, attr))
+             for attr in dir(_mon)
+             if attr.endswith("_listeners")
+             and isinstance(getattr(_mon, attr), list)}
+    jax.monitoring.register_event_listener(
+        lambda name, **kw: events.append(name))
+    try:
+        jax.jit(lambda x: x * 2)(jnp.ones(3)).block_until_ready()
+        jax.clear_caches()
+        events.clear()
+        jax.block_until_ready(step(state, batch))
+        n = sum(1 for e in events if "compile_requests" in e)
+        assert n == 1, n
+    finally:
+        for attr, listeners in saved.items():
+            getattr(_mon, attr)[:] = listeners
+
+
+def test_probes_resolve_one_step_late_never_touching_newest():
+    """The zero-host-sync proof, applied to the new mode: probe
+    vectors enqueued via observe_device(probes=) are materialized only
+    after the NEXT step's enqueue — the __array__-probe harness from
+    the deferred tests, end to end through TrainTelemetry."""
+
+    class _Probe:
+        def __init__(self, value):
+            self.value = value
+            self.materialized = False
+
+        def __array__(self, dtype=None, copy=None):
+            self.materialized = True
+            return np.asarray(self.value, dtype=dtype)
+
+    def probes():
+        return NumericsProbes(
+            grad_sq=_Probe(4.0), param_sq=_Probe(9.0),
+            update_sq=_Probe(0.09), leaf_grad_sq=_Probe([4.0]),
+            leaf_nonfinite=_Probe([0.0]))
+
+    tel = TrainTelemetry(MetricsRegistry())
+    tel.arm_numerics(("['w']",))
+    p0, p1 = probes(), probes()
+    with tel.step():
+        pass
+    tel.observe_device(loss=jnp.float32(1.0), probes=p0)
+    assert not p0.grad_sq.materialized       # newest step: parked
+    with tel.step():
+        pass
+    tel.observe_device(loss=jnp.float32(2.0), probes=p1)
+    # previous step resolved, gauges live mid-run; newest untouched
+    assert p0.grad_sq.materialized and p0.leaf_nonfinite.materialized
+    assert not p1.grad_sq.materialized
+    assert tel.numerics.grad_norm.value() == pytest.approx(2.0)
+    assert tel.numerics.param_norm.value() == pytest.approx(3.0)
+
+
+def test_numerics_every_samples_without_recompiling():
+    """APEX_TPU_NUMERICS_EVERY=2 observes every other step — half the
+    train_numerics events — while the step executable is identical
+    (recompile counter still 0) and loss-scale tracking rides every
+    step."""
+    tx = functional.fused_adam(lr=1e-2)
+    tel = TrainTelemetry(MetricsRegistry())
+    reg_events = []
+    tel.registry.add_sink(type("S", (), {
+        "event": lambda self, obj: reg_events.append(obj)})())
+    run = train_step.instrumented_train_loop(
+        _loss_fn, tx, telemetry=tel, numerics=True, numerics_every=2)
+    state = train_step.init_train_state(tx, _make_params(),
+                                        loss_scale="dynamic")
+    run(state, _batches(4))
+    nx = [e for e in reg_events if e["kind"] == "train_numerics"]
+    assert [e["step"] for e in nx] == [0, 2]
+    assert int(tel.recompiles.total()) == 0
+    assert tel.numerics.every == 2
+
+
+def test_overflow_on_unsampled_step_still_gets_an_autopsy():
+    """The sampling interval thins the NORM probes, never the autopsy:
+    the per-leaf nonfinite vector rides every step, so an overflow on
+    an unsampled step is still attributed to its leaf."""
+    tx = functional.fused_adam(lr=1e-2)
+    tel = TrainTelemetry(MetricsRegistry())
+    reg_events = []
+    tel.registry.add_sink(type("S", (), {
+        "event": lambda self, obj: reg_events.append(obj)})())
+    run = train_step.instrumented_train_loop(
+        _loss_fn, tx, telemetry=tel, numerics=True, numerics_every=4)
+    state = train_step.init_train_state(tx, _make_params(),
+                                        loss_scale="dynamic")
+    run(state, _batches(4, poison_step=1))   # step 1 is NOT sampled
+    nx = [e for e in reg_events if e["kind"] == "train_numerics"]
+    assert [e["step"] for e in nx] == [0]    # norms thinned as asked
+    [autopsy] = [e for e in reg_events
+                 if e["kind"] == "overflow_autopsy"]
+    assert autopsy["step"] == 1
+    assert autopsy["leaves"] == [{"leaf": "['w0']", "nonfinite": 64}]
+    assert tel.numerics.overflow_leaf.value(leaf="['w0']") == 64.0
+    assert int(tel.overflow_skips.total()) == 1
+
+
+def test_nonfinite_leaf_counts_rejects_axis_on_replicated_grads():
+    """axis_name without a sharded layout would psum replicated full
+    buffers into replica_count x the true counts — loud, not silent."""
+    from apex_tpu.amp.scaler import nonfinite_leaf_counts
+    g = jnp.asarray(np.ones(8, np.float32))
+    with pytest.raises(ValueError, match="replicated"):
+        nonfinite_leaf_counts(g, (8,), axis_name="data")
+
+
+def test_numerics_env_knobs_drive_the_loop(monkeypatch):
+    """numerics=None reads APEX_TPU_NUMERICS / APEX_TPU_NUMERICS_EVERY
+    (the registered knobs)."""
+    monkeypatch.setenv("APEX_TPU_NUMERICS", "1")
+    monkeypatch.setenv("APEX_TPU_NUMERICS_EVERY", "3")
+    tx = functional.fused_adam(lr=1e-2)
+    tel = TrainTelemetry(MetricsRegistry())
+    run = train_step.instrumented_train_loop(_loss_fn, tx,
+                                             telemetry=tel)
+    state = train_step.init_train_state(tx, _make_params(),
+                                        loss_scale="dynamic")
+    run(state, _batches(3))
+    assert tel.numerics_armed and tel.numerics.every == 3
+    assert tel.numerics.grad_norm_hist.count() == 1   # step 0 only
+
+
+def test_registered_twin_pins_probe_comm_to_one_packed_psum():
+    """The committed ledger's train_step_zero_numerics entry vs
+    train_step_zero: identical gather/scatter/pmax bytes, and the ONLY
+    delta is compute_probes' single packed psum — (2*n_leaves+2) f32 at
+    the 16-leaf MLP fixture = 136 ring bytes at dp=2.  APX211-218 run
+    on the twin through the tier-1 --spmd gate (test_spmd_audit), which
+    would fail on any donation/uniformity/budget regression."""
+    from apex_tpu.analysis.cli import repo_root
+    from apex_tpu.analysis.spmd_audit import BUDGET_NAME
+    committed = json.loads(
+        (repo_root() / BUDGET_NAME).read_text())["executables"]
+    zero = committed["train_step_zero"]
+    numerics = committed["train_step_zero_numerics"]
+    n_leaves = 16                        # 8 layers x (w, b)
+    packed_psum_bytes = (2 * n_leaves + 2) * 4
+    assert numerics["comm_bytes"] - zero["comm_bytes"] == \
+        packed_psum_bytes
+    for coll in ("all_gather@data", "reduce_scatter@data",
+                 "pmax@data"):
+        assert numerics["by_collective"][coll] == \
+            zero["by_collective"][coll], coll
+    assert numerics["by_collective"]["psum@data"] - \
+        zero["by_collective"]["psum@data"] == packed_psum_bytes
+    assert numerics["rs_ag_equals_ar"] is True
+    # compiled truth attributed, never a fabricated number
+    assert numerics["compiled"]["provenance"].startswith("xla:")
+
+
+def test_numerics_twin_audits_clean_against_committed_ledger():
+    """A fresh audit of the twin reproduces the committed entry
+    bit-for-bit (the conscious-re-pin contract)."""
+    from apex_tpu.analysis.cli import repo_root
+    from apex_tpu.analysis.spmd_audit import (BUDGET_NAME,
+                                              run_spmd_audit)
+    committed = json.loads((repo_root() / BUDGET_NAME).read_text())
+    findings, report = run_spmd_audit(
+        execs=["train_step_zero_numerics"])
+    assert findings == []
+    assert report["executables"]["train_step_zero_numerics"] == \
+        committed["executables"]["train_step_zero_numerics"]

@@ -13,13 +13,14 @@ REPO = repo_root()
 
 # the executables the auditor must cover (ISSUE 5 acceptance: >= 8;
 # ISSUE 9 adds the fused/unfused LM-head+CE twins + the TP variant so
-# the env-knob-selected lowering can't ship unbudgeted)
+# the env-knob-selected lowering can't ship unbudgeted; ISSUE 11 adds
+# the numerics-probed zero-step twin for the same reason)
 REQUIRED_EXECS = {
     "train_step_dense", "train_step_zero", "ddp_allreduce",
     "tp_column_row", "pipeline_1f1b", "ring_attention_cp",
     "ulysses_attention_cp", "moe_dispatch", "inference_prefill",
     "inference_decode", "lm_xent_fused", "lm_xent_unfused",
-    "tp_fused_lm_xent",
+    "tp_fused_lm_xent", "train_step_zero_numerics",
 }
 
 
