@@ -146,6 +146,43 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "captures as numerics_every",
         read_by="apex_tpu/observability/numerics.py"),
     EnvKnob(
+        name="APEX_TPU_PREFIX_CACHE",
+        default="1",
+        effect="shared-prefix KV page sharing for paged schedulers: 1 "
+               "(default) matches each prompt against the host radix "
+               "prefix cache and maps cached prefix pages into the "
+               "slot's page-table row at one reference each "
+               "(refcount + copy-on-write; only the uncached tail "
+               "prefills); 0 disables matching and insertion (every "
+               "admission prefills cold); per-scheduler override: "
+               "SlotScheduler(prefix_cache=); stamped into paged "
+               "infer bench captures as infer_prefix_cache",
+        read_by="apex_tpu/inference/prefix_cache.py"),
+    EnvKnob(
+        name="APEX_TPU_PREFILL_CHUNK",
+        default="0",
+        effect="chunked-prefill chunk size in tokens for paged "
+               "schedulers (must be a multiple of the page size): "
+               "prompts longer than this prefill in chunks interleaved "
+               "with decode steps so a long-prompt burst cannot stall "
+               "in-flight decode tokens for a whole monolithic "
+               "prefill; 0 (default) keeps monolithic prefill; "
+               "per-scheduler override: SlotScheduler(prefill_chunk=); "
+               "stamped into paged infer bench captures as "
+               "infer_prefill_chunk",
+        read_by="apex_tpu/inference/scheduler.py"),
+    EnvKnob(
+        name="APEX_TPU_TENANT_PRIORITY",
+        default="0",
+        effect="per-tenant admission-priority overrides for the "
+               "SLO-aware scheduler, as 'tenantA=10,tenantB=-1' "
+               "(added to each request's own priority when picking "
+               "the next admission; ties go to the least recently "
+               "admitted tenant, then FIFO); 0/empty (default) = no "
+               "overrides; per-scheduler override: "
+               "SlotScheduler(tenant_priority=)",
+        read_by="apex_tpu/inference/scheduler.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

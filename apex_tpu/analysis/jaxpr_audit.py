@@ -221,12 +221,17 @@ def _builders():
         return cfg, SamplingConfig(), params, cache, key
 
     def inference_prefill_paged():
+        # operand order: cache, params, tokens, slot, length, row,
+        # prefill_from (ISSUE 12: the suffix-prefill position — 0 for
+        # a cold prefill; the cond'd prefix-window path is part of the
+        # ONE audited executable), key, step
         from apex_tpu.inference.engine import make_prefill_fn
         cfg, sampling, params, cache, key = _paged_engine_audit_pieces()
         fn = make_prefill_fn("gpt", cfg, sampling, paged=True)
         return (fn, (cache, params, s((64,), jnp.int32),
                      s((), jnp.int32), s((), jnp.int32),
-                     s((16,), jnp.int32), key, s((), jnp.int32)))
+                     s((16,), jnp.int32), s((), jnp.int32), key,
+                     s((), jnp.int32)))
 
     def inference_decode_paged():
         from apex_tpu.inference.engine import make_decode_fn
@@ -234,6 +239,16 @@ def _builders():
         fn = make_decode_fn("gpt", cfg, sampling)
         return (fn, (cache, params, s((4,), jnp.int32), s((4,), bool),
                      key, s((), jnp.int32)))
+
+    def inference_cow_page():
+        # the ISSUE 12 copy-on-write barrier: one page duplicated
+        # inside the donated pool — audited for precision/transfer
+        # discipline like every serving program (it moves exactly one
+        # page and adds no collectives, so it carries no budget entry)
+        from apex_tpu.inference import kv_cache as kvc
+        _, _, _, cache, _ = _paged_engine_audit_pieces()
+        return (kvc.cow_page, (cache, s((), jnp.int32),
+                               s((), jnp.int32)))
 
     return {
         # budgets are the measured entry upcasts (γ/β applied in fp32 by
@@ -289,6 +304,10 @@ def _builders():
                                    ("bfloat16", "bfloat16", "int32",
                                     "int32", "int32", "int32",
                                     "float32", "bool"), None),
+        "inference_cow_page": (inference_cow_page,
+                               "apex_tpu/inference/kv_cache.py",
+                               ("bfloat16", "bfloat16", "int32",
+                                "int32", "int32"), 0),
     }
 
 

@@ -12,11 +12,14 @@ continuous-batching scheduler.  Two cache layouts (ISSUE 6):
            the scheduler admits by free PAGES, so concurrency scales
            with the mean sequence, not the straggler
 
-    engine     prefill/decode executables, weight export boundaries
-    kv_cache   donated slot cache + paged pool / host PageAllocator
-    models     pure cache-aware forwards over the flax param trees
-    sampling   greedy / temperature / top-k with explicit key threading
-    scheduler  static-bucket continuous batching (host-side slots+pages)
+    engine        prefill/decode (+COW copy) executables, weight export
+    kv_cache      donated slot cache + paged pool / refcounted host
+                  PageAllocator (acquire / share / release)
+    models        pure cache-aware forwards over the flax param trees
+    sampling      greedy / temperature / top-k with explicit keys
+    scheduler     SLO-aware continuous batching: shared-prefix
+                  admission, chunked prefill, tenant fairness
+    prefix_cache  host radix tree token ids -> KV page lists (ISSUE 12)
 
 Quick start (see README "Inference")::
 
@@ -41,6 +44,7 @@ from apex_tpu.inference.kv_cache import (
     init_cache,
     init_paged_cache,
 )
+from apex_tpu.inference.prefix_cache import PrefixCache
 from apex_tpu.inference.sampling import SamplingConfig, greedy, sample_token
 from apex_tpu.inference.scheduler import Request, SlotScheduler, generate
 
@@ -51,6 +55,7 @@ __all__ = [
     "PagedKVCache",
     "init_paged_cache",
     "PageAllocator",
+    "PrefixCache",
     "default_page_size",
     "SamplingConfig",
     "greedy",

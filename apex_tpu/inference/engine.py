@@ -61,10 +61,19 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
                     paged: bool = False):
     """Pure prefill step.  Dense: ``(cache, params, tokens [s], slot,
     length, key, step) -> (cache, next_token, last_logits)``; paged
-    takes an extra ``row`` operand (the slot's ``[max_pages_per_slot]``
-    page-table row) after ``length``, parking the prompt's pages
-    instead of a contiguous slab.  ``length`` is the real prompt length
-    inside the bucket-padded ``tokens``."""
+    takes extra ``row`` (the slot's ``[max_pages_per_slot]`` page-table
+    row) and ``prefill_from`` operands after ``length``.
+
+    ``prefill_from`` (ISSUE 12) is the number of prompt tokens already
+    sitting in the slot's pages: ``tokens`` is then the bucket-padded
+    UNCACHED TAIL, the forward attends to the cached prefix through the
+    page window, and the insert scatters only the tail's rows —
+    ``prefill_from == 0`` is the cold path (bitwise the original math).
+    ``length`` is the slot's TOTAL live length after this step (real
+    prefix + real tail inside the padded bucket).  Both operands are
+    traced, so ONE compiled executable per bucket serves cold
+    prefills, prefix-cache hits, and chunked-prefill chunks alike —
+    sharing changes page-table rows, never device programs."""
 
     def prefill_fn(cache, params, tokens, slot, length, key, step):
         # named_scope = metadata-only xprof regions (no prims added, so
@@ -82,14 +91,15 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
                                sampling)
         return cache, tok, last
 
-    def prefill_paged_fn(cache, params, tokens, slot, length, row, key,
-                         step):
+    def prefill_paged_fn(cache, params, tokens, slot, length, row,
+                         prefill_from, key, step):
         with obs.named_scope("apex_prefill_forward"):
-            logits, ks, vs = models.prefill_forward(kind, cfg, params,
-                                                    tokens[None], length)
+            logits, ks, vs = models.prefill_forward(
+                kind, cfg, params, tokens[None], length, cache=cache,
+                row=row, prefill_from=prefill_from)
         with obs.named_scope("apex_prefill_cache_insert"):
-            cache = kv_cache.insert_pages(cache, slot, ks, vs, length,
-                                          row)
+            cache = kv_cache.insert_tokens(cache, slot, ks, vs, length,
+                                           row, prefill_from)
         with obs.named_scope("apex_prefill_sample"):
             last = logits[0].astype(jnp.float32)            # [vocab]
             tok = sample_token(last, jax.random.fold_in(key, step),
@@ -218,6 +228,12 @@ class InferenceEngine:
                 donate_argnums=(0,))
             self._decode = jax.jit(
                 make_decode_fn(kind, cfg, sampling), donate_argnums=(0,))
+            if self.paged:
+                # the COW write barrier (ISSUE 12): one donated page
+                # copy, compiled once, dispatched only when a slot must
+                # privatize a page it still shares
+                self._cow = jax.jit(kv_cache.cow_page,
+                                    donate_argnums=(0,))
 
     def _refresh_dispatch_counters(self) -> None:
         reg = obs.global_registry()
@@ -227,6 +243,8 @@ class InferenceEngine:
                 "infer_prefill_dispatch_total")
             self._decode_dispatches = reg.declared(
                 "infer_decode_dispatch_total")
+            self._cow_dispatches = reg.declared(
+                "infer_cow_dispatch_total")
 
     # -- cache ---------------------------------------------------------------
     def init_cache(self):
@@ -282,27 +300,46 @@ class InferenceEngine:
         min_bucket = max(64, self.page_size) if self.paged else 64
         return prefill_bucket(n, self.max_seq, min_bucket=min_bucket)
 
-    def prefill(self, cache, tokens, slot, pages=None):
+    def prefill(self, cache, tokens, slot, pages=None, prefill_from=0):
         """Admit one prompt into ``slot``: returns ``(cache, next_token,
         last_logits)``.  ``tokens`` is the UNPADDED prompt (list/array of
         ints); padding to the executable bucket happens here.
 
-        Paged mode additionally takes ``pages`` — the page-ID list the
-        :class:`~apex_tpu.inference.kv_cache.PageAllocator` reserved
-        for this request (prompt + decode headroom); the bucket rounds
-        up to whole pages, and bucket pages beyond the reservation spill
-        into the pool's trash page by construction."""
+        Paged mode additionally takes ``pages`` — the FULL ordered
+        page-ID list backing the prompt + decode headroom (shared
+        prefix pages first on a prefix-cache hit, then the privately
+        acquired suffix pages) — and ``prefill_from`` (ISSUE 12): how
+        many leading prompt tokens are already cached in those pages.
+        Only ``tokens[prefill_from:]`` runs the forward (padded to ITS
+        bucket, so a short uncached tail rides a small executable),
+        attending to the cached prefix through the page window; the
+        bucket rounds up freely, positions beyond the reservation spill
+        into the pool's trash page by construction.  ``prefill_from``
+        is a traced operand — a hit admits with zero new compiles once
+        the tail's bucket is warm."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = tokens.shape[0]
-        bucket = self.bucket_for(n)
+        start = int(prefill_from)
+        if start < 0 or start >= n:
+            raise ValueError(
+                f"prefill_from ({start}) must be in [0, prompt length "
+                f"{n}) — at least the last prompt token is always "
+                f"prefilled (its logits seed the first sampled token)")
+        if start and not self.paged:
+            raise ValueError(
+                "prefill_from needs the paged cache (prefix sharing is "
+                "a page-table edit); this engine runs the dense slot "
+                "cache")
+        suffix = tokens[start:]
+        bucket = self.bucket_for(suffix.shape[0])
         padded = np.zeros((bucket,), np.int32)
-        padded[:n] = tokens
+        padded[:suffix.shape[0]] = suffix
         if self.paged:
             if pages is None:
                 raise ValueError(
                     "paged prefill needs the slot's reserved page IDs "
-                    "(engine.new_allocator().alloc(...)); the scheduler "
-                    "threads them automatically")
+                    "(engine.new_allocator().acquire(...)); the "
+                    "scheduler threads them automatically")
             if len(pages) * self.page_size < n:
                 raise ValueError(
                     f"reservation of {len(pages)} page(s) x "
@@ -313,7 +350,7 @@ class InferenceEngine:
             row = kv_cache.page_row(pages, self.max_pages_per_slot,
                                     self.num_pages)
             args = (cache, self.params, padded, np.int32(slot),
-                    np.int32(n), row)
+                    np.int32(n), row, np.int32(start))
         else:
             args = (cache, self.params, padded, np.int32(slot),
                     np.int32(n))
@@ -323,6 +360,23 @@ class InferenceEngine:
         self._prefill_dispatches.inc()
         with obs.trace_annotation("apex_tpu.inference.prefill"):
             return self._prefill(*args, self._key, self._next_step())
+
+    def cow_page(self, cache, src, dst):
+        """Copy-on-write page duplication (paged mode): copy physical
+        page ``src`` into ``dst`` and return the cache.  The write
+        barrier of the sharing contract — the scheduler calls this
+        before a slot writes into a page it still shares (the partial
+        boundary page of an unaligned prefix-cache hit), pointing the
+        slot's row at ``dst`` in the prefill that follows.  ``src`` and
+        ``dst`` are traced int32, so every COW rides ONE compiled copy
+        program for the engine's lifetime."""
+        if not self.paged:
+            raise ValueError("cow_page is the paged-mode write barrier; "
+                             "this engine runs the dense slot cache")
+        self._refresh_dispatch_counters()
+        self._cow_dispatches.inc()
+        with obs.trace_annotation("apex_tpu.inference.cow_page"):
+            return self._cow(cache, np.int32(src), np.int32(dst))
 
     def decode(self, cache, last_tokens, active=None):
         """One token for every slot: returns ``(cache, next_tokens,

@@ -63,7 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["KVCache", "init_cache", "PagedKVCache", "init_paged_cache",
-           "PageAllocator", "default_page_size"]
+           "PageAllocator", "default_page_size", "insert_tokens",
+           "cow_page"]
 
 _PAGE_SIZE_ENV = "APEX_TPU_PAGE_SIZE"
 _DEFAULT_PAGE_SIZE = 64
@@ -409,6 +410,111 @@ def insert_pages(cache: PagedKVCache, slot, k, v, length,
             cache.capacity, (owned * ps)[None], (slot,)))
 
 
+def insert_tokens(cache: PagedKVCache, slot, k, v, length, row,
+                  start) -> PagedKVCache:
+    """Suffix prefill write (ISSUE 12): scatter a bucket-padded slab of
+    ``s`` token rows into the slot's pages at positions ``[start,
+    start + s)`` — ANY alignment, so a prefix-cache hit can resume
+    mid-page after its boundary COW.
+
+    ``k``/``v``: ``[layers, kv_heads, s, head_dim]``; ``start`` (traced
+    OK) is the first virtual position the slab covers — ``0`` for a
+    cold prefill, the shared-prefix coverage for a hit, a chunk
+    boundary for chunked prefill.  ``length`` is the slot's TOTAL live
+    length after this write (prefix + real suffix tokens).  Unlike
+    :func:`insert_pages`' page-granular slab scatter, every token row
+    targets ``(row[pos // page_size], pos % page_size)`` individually
+    (the :func:`_append_layer_paged` addressing, vectorized over the
+    slab) — positions past the reservation clamp into the trash page
+    exactly like the slab insert's bucket overhang, and rows mapping
+    into SHARED prefix pages never occur by contract (the scheduler
+    COWs the boundary page before admitting a mid-page suffix).
+
+    The page-table row, lengths, and capacity update exactly as in
+    :func:`insert_pages` (capacity derived in-program from the owned
+    entries), so one compiled insert serves every page assignment and
+    every ``start``.
+    """
+    ps, mpps, s = cache.page_size, cache.max_pages_per_slot, k.shape[2]
+    if k.shape != v.shape or k.shape[0] != cache.layers \
+            or k.shape[1] != cache.kv_heads \
+            or k.shape[3] != cache.head_dim:
+        raise ValueError(
+            f"prefill k/v must be [layers={cache.layers}, "
+            f"kv_heads={cache.kv_heads}, s, head_dim={cache.head_dim}], "
+            f"got k {tuple(k.shape)} v {tuple(v.shape)}")
+    if s < 1 or s > cache.max_seq:
+        raise ValueError(
+            f"suffix slab length {s} must be in [1, max_seq "
+            f"{cache.max_seq}]")
+    row = jnp.asarray(row, jnp.int32)
+    if row.shape != (cache.max_pages_per_slot,):
+        raise ValueError(
+            f"page row must be [{cache.max_pages_per_slot}], got "
+            f"{tuple(row.shape)}")
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    pos = start + jnp.arange(s, dtype=jnp.int32)            # [s]
+    ordinal = jnp.minimum(pos // ps, jnp.int32(mpps - 1))
+    pages = jnp.take(row, ordinal)                          # [s]
+    # rows past the virtual window get an OUT-OF-BOUNDS page index so
+    # mode="drop" discards them — clamping them onto the last owned
+    # position would collide with (and clobber) the real last token
+    # whenever the prompt fills the whole window
+    pages = jnp.where(pos < jnp.int32(mpps * ps), pages,
+                      jnp.int32(cache.pages))
+    offs = jnp.minimum(pos - ordinal * ps, jnp.int32(ps - 1))
+    # [layers, kvh, s, d] -> [s, layers, kvh, d]: the advanced indices
+    # (pages, offs) lead, interior layer/head slices follow — one
+    # vectorized scatter per buffer, donation-safe like every .at[].set
+    rows_k = jnp.moveaxis(k, 2, 0).astype(cache.k.dtype)
+    rows_v = jnp.moveaxis(v, 2, 0).astype(cache.v.dtype)
+    new_k = cache.k.at[pages, :, :, offs, :].set(rows_k, mode="drop")
+    new_v = cache.v.at[pages, :, :, offs, :].set(rows_v, mode="drop")
+    owned = jnp.sum((row != cache.null_page).astype(jnp.int32))
+    zero = jnp.int32(0)
+    return cache.replace(
+        k=new_k, v=new_v,
+        page_table=jax.lax.dynamic_update_slice(
+            cache.page_table, row[None], (slot, zero)),
+        lengths=jax.lax.dynamic_update_slice(
+            cache.lengths, jnp.asarray(length, jnp.int32)[None], (slot,)),
+        capacity=jax.lax.dynamic_update_slice(
+            cache.capacity, (owned * ps)[None], (slot,)))
+
+
+def cow_page(cache: PagedKVCache, src, dst) -> PagedKVCache:
+    """Copy-on-write page duplication: copy physical page ``src``'s k/v
+    rows into page ``dst`` (both traced int32 — ONE compiled copy
+    serves every page pair).
+
+    The sharing contract's write barrier: a slot about to write into a
+    page whose refcount is above one (a prefix-cache boundary page
+    shared mid-fill, or any future fork) first duplicates it into a
+    freshly acquired page and points its table row at the copy, so the
+    other owners' reads stay bitwise untouched.  The table-row swap is
+    NOT performed here — the suffix prefill that follows writes the
+    slot's full row (with ``dst`` at the boundary ordinal) through
+    :func:`insert_tokens`, so the copy plus the row write stay two
+    dispatches of already-compiled programs.  Pure donated update like
+    every other cache mutation.
+    """
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    zero = jnp.int32(0)
+    page_k = jax.lax.dynamic_slice(
+        cache.k, (src, zero, zero, zero, zero),
+        (1,) + cache.k.shape[1:])
+    page_v = jax.lax.dynamic_slice(
+        cache.v, (src, zero, zero, zero, zero),
+        (1,) + cache.v.shape[1:])
+    new_k = jax.lax.dynamic_update_slice(
+        cache.k, page_k, (dst, zero, zero, zero, zero))
+    new_v = jax.lax.dynamic_update_slice(
+        cache.v, page_v, (dst, zero, zero, zero, zero))
+    return cache.replace(k=new_k, v=new_v)
+
+
 def _append_layer_paged(cache: PagedKVCache, layer: int, k_tok,
                         v_tok) -> PagedKVCache:
     """Paged decode write for ONE layer: slot ``i``'s token row lands in
@@ -439,16 +545,30 @@ def _append_layer_paged(cache: PagedKVCache, layer: int, k_tok,
 
 
 class PageAllocator:
-    """Host-side free-list allocator over the pool's allocatable pages.
+    """Host-side reference-counted free-list allocator over the pool's
+    allocatable pages (ISSUE 12: refcounts make shared-prefix page
+    sharing and copy-on-write a bookkeeping operation).
 
     The scheduler's admission-control arm: a request is admitted only
-    if :meth:`alloc` can hand it every page it may need (prompt +
-    token budget, rounded up to whole pages) — out-of-pages is
-    BACKPRESSURE (the request waits), never a mid-decode failure,
-    because reservations are made in full before prefill.  LIFO reuse
-    keeps recently-touched pages hot.  Double-free and foreign-page
-    frees raise — a leaked page is a capacity leak forever, so the
-    bookkeeping is strict.
+    if :meth:`acquire` can hand it every PRIVATE page it may need
+    (suffix + token budget, rounded up to whole pages) — out-of-pages
+    is BACKPRESSURE (the request waits), never a mid-decode failure,
+    because reservations are made in full before prefill.  A request
+    extending a cached prefix does not copy the prefix's pages: it
+    :meth:`share`\\ s them (refcount + 1 per co-owner), so N
+    concurrent requests over a P-page prefix pin P physical pages,
+    not N·P.  :meth:`release` is the ONLY way out: the page returns
+    to the LIFO free list exactly when its LAST owner releases it.
+    LIFO reuse keeps recently-touched pages hot.  Double-release and
+    foreign-page releases raise — a leaked page is a capacity leak
+    forever and a premature free corrupts another request's stream,
+    so the bookkeeping is strict.
+
+    Conservation invariant (the allocator sweep test walks it every
+    step): ``free_pages + live_pages == num_pages`` with
+    ``live_pages`` counting DISTINCT outstanding pages, while
+    ``weighted_live()`` (the refcount-weighted view) equals the sum of
+    every holder's page list — shared pages counted once per owner.
     """
 
     def __init__(self, num_pages: int, page_size: int,
@@ -457,11 +577,28 @@ class PageAllocator:
         self.page_size = int(page_size)
         self.max_pages_per_slot = int(max_pages_per_slot)
         self._free: List[int] = list(range(self.num_pages))
-        self._outstanding: set = set()
+        self._refs: dict = {}          # page id -> outstanding refcount
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def live_pages(self) -> int:
+        """Distinct pages with at least one outstanding reference."""
+        return len(self._refs)
+
+    def weighted_live(self) -> int:
+        """Sum of refcounts over live pages — what N sharers of one
+        page would have paid WITHOUT sharing."""
+        return sum(self._refs.values())
+
+    def shared_pages(self) -> int:
+        """Pages currently held by more than one owner."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, pid: int) -> int:
+        return self._refs.get(int(pid), 0)
 
     def pages_needed(self, tokens: int) -> int:
         """Whole pages covering ``tokens``, clamped to the per-slot
@@ -470,20 +607,44 @@ class PageAllocator:
         need = -(-int(tokens) // self.page_size)
         return max(1, min(need, self.max_pages_per_slot))
 
-    def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` page IDs, or None (backpressure) if the pool can't
-        cover the reservation."""
+    def acquire(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh page IDs at refcount 1 each, or None
+        (backpressure) if the free list can't cover the reservation."""
         if n > len(self._free):
             return None
         ids = [self._free.pop() for _ in range(n)]
-        self._outstanding.update(ids)
+        for pid in ids:
+            self._refs[pid] = 1
         return ids
 
-    def free(self, ids: Sequence[int]) -> None:
+    def share(self, ids: Sequence[int]) -> None:
+        """Take one additional reference on each (already outstanding)
+        page — the sharing half of copy-on-write.  Sharing a page with
+        no live owner raises: a freed page may already back another
+        request, so silent resurrection is the corruption this
+        allocator exists to prevent."""
+        ids = [int(p) for p in ids]
         for pid in ids:
-            if pid not in self._outstanding:
+            if pid not in self._refs:
                 raise ValueError(
-                    f"page {pid} is not outstanding (double free, or a "
-                    f"page this allocator never issued)")
-            self._outstanding.discard(pid)
-            self._free.append(pid)
+                    f"page {pid} is not outstanding (cannot share a "
+                    f"freed page, or a page this allocator never "
+                    f"issued)")
+        for pid in ids:
+            self._refs[pid] += 1
+
+    def release(self, ids: Sequence[int]) -> None:
+        """Drop one reference per page; a page whose LAST owner
+        releases it returns to the LIFO free list.  Strict: releasing
+        a page with no outstanding reference (double release, or a
+        page this allocator never issued) raises."""
+        for pid in ids:
+            pid = int(pid)
+            if pid not in self._refs:
+                raise ValueError(
+                    f"page {pid} is not outstanding (double release, "
+                    f"or a page this allocator never issued)")
+            self._refs[pid] -= 1
+            if self._refs[pid] == 0:
+                del self._refs[pid]
+                self._free.append(pid)

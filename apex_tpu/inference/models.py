@@ -24,7 +24,11 @@ import jax.numpy as jnp
 
 from apex_tpu.inference import kv_cache
 from apex_tpu.ops import layer_norm, rms_norm
-from apex_tpu.ops.attention import decode_attention, flash_attention
+from apex_tpu.ops.attention import (
+    decode_attention,
+    flash_attention,
+    prefix_window_attention,
+)
 from apex_tpu.ops.paged_attention import paged_decode_attention
 from apex_tpu.transformer.functional.fused_rope import (
     fused_apply_rotary_pos_emb_cached,
@@ -74,6 +78,46 @@ def _linear(p, x):
     return y
 
 
+def _suffix_attend(cache, layer: int, row, q, k, v, start):
+    """Prefill attention for a (possibly mid-prompt) token slab: cold
+    (``start == 0``) it is EXACTLY the causal flash path the original
+    prefill ran — bitwise, so cold prefills and the dense-parity tests
+    are untouched; warm (``start > 0``, a prefix-cache hit or a later
+    chunk of a chunked prefill) each row additionally attends to the
+    already-cached prefix, gathered from the slot's KV pages through
+    ``row`` (:func:`~apex_tpu.ops.attention.prefix_window_attention`).
+
+    ``q``: ``[b, h, s, d]``; ``k``/``v``: pre-broadcast
+    ``[b, kv_heads, s, d]``.  One ``lax.cond`` keeps both paths inside
+    the ONE compiled prefill executable per bucket — the runtime
+    executes only the taken branch, so cold prefills never pay the
+    window gather."""
+    b, h, s, d = q.shape
+    kvh = k.shape[1]
+    group = h // kvh
+
+    def cold(q, k, v, pk, pv):
+        if group > 1:                   # GQA: share kv across the group
+            k, v = (jnp.broadcast_to(
+                t[:, :, None], (b, kvh, group, s, d)
+            ).reshape(b, h, s, d) for t in (k, v))
+        return flash_attention(q, k, v, causal=True)
+
+    def warm(q, k, v, pk, pv):
+        # pk/pv [pages, kvh, ps, d] -> the slot's virtual window
+        # [b, kvh, max_seq, d] in row order; unowned ordinals gather the
+        # trash page — finite garbage masked by start
+        def window(p):
+            w = jnp.take(p, row, axis=0)          # [mpps, kvh, ps, d]
+            return w.transpose(1, 0, 2, 3).reshape(
+                1, kvh, -1, d).astype(q.dtype)
+        return prefix_window_attention(q, k, v, window(pk), window(pv),
+                                       start)
+
+    return jax.lax.cond(start > 0, warm, cold, q, k, v,
+                        cache.k[:, layer], cache.v[:, layer])
+
+
 def _cache_attend(cache, layer: int, q, live):
     """Single-token attention against ONE layer of whichever cache
     layout the engine runs: the dense slot window
@@ -115,15 +159,27 @@ def _last_row(h, length):
                                         keepdims=False)       # [b, hid]
 
 
-def _gpt_prefill(cfg, params, tokens, length=None):
+def _gpt_prefill(cfg, params, tokens, length=None, cache=None, row=None,
+                 start=None):
     p = _params_subtree(params)
     b, s = tokens.shape
     dims = model_dims("gpt", cfg)
     heads, head_dim = dims["heads"], dims["head_dim"]
+    suffix = cache is not None          # static: suffix-prefill variant
 
     emb_w = p["embedding"]["word_embeddings"]["weight"]
     h = jnp.take(emb_w, tokens, axis=0)                     # [b, s, h]
-    h = h + p["embedding"]["position_embeddings"][None, :s, :]
+    pos_tab = p["embedding"]["position_embeddings"]
+    if suffix:
+        # rows sit at absolute positions start + i (clamped: dead
+        # bucket-padding rows past the table stay in range)
+        positions = jnp.minimum(
+            jnp.asarray(start, jnp.int32)
+            + jnp.arange(s, dtype=jnp.int32),
+            jnp.int32(pos_tab.shape[0] - 1))
+        h = h + jnp.take(pos_tab, positions, axis=0)[None]
+    else:
+        h = h + pos_tab[None, :s, :]
     h = h.transpose(1, 0, 2)                                # [s, b, h]
 
     ks, vs = [], []
@@ -136,7 +192,10 @@ def _gpt_prefill(cfg, params, tokens, length=None):
         q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
         ks.append(k[0])                                     # [n, s, d]
         vs.append(v[0])
-        ctx = flash_attention(q, k, v, causal=True)
+        if suffix:
+            ctx = _suffix_attend(cache, i, row, q, k, v, start)
+        else:
+            ctx = flash_attention(q, k, v, causal=True)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
         x = x + _linear(lp["self_attention"]["dense"], ctx)
         h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
@@ -146,7 +205,8 @@ def _gpt_prefill(cfg, params, tokens, length=None):
     h = layer_norm(h, p["final_layernorm"]["weight"],
                    p["final_layernorm"]["bias"])
     if length is not None:
-        logits = jnp.einsum("bh,vh->bv", _last_row(h, length), emb_w)
+        last = length - start if suffix else length   # local slab index
+        logits = jnp.einsum("bh,vh->bv", _last_row(h, last), emb_w)
     else:
         logits = jnp.einsum("sbh,vh->sbv", h, emb_w)        # tied head
     return logits, jnp.stack(ks), jnp.stack(vs)
@@ -210,16 +270,30 @@ def _llama_mlp(lp, h):
     return _linear(lp["mlp"]["down_proj"], jax.nn.silu(gate) * up)
 
 
-def _llama_prefill(cfg, params, tokens, length=None):
+def _llama_prefill(cfg, params, tokens, length=None, cache=None,
+                   row=None, start=None):
     p = _params_subtree(params)
     b, s = tokens.shape
     dims = model_dims("llama", cfg)
     heads, kv_heads = dims["heads"], dims["kv_heads"]
     head_dim, group = dims["head_dim"], heads // kv_heads
+    suffix = cache is not None          # static: suffix-prefill variant
 
     h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
     h = h.transpose(1, 0, 2)                                # [s, b, h]
-    cos, sin = _rope_cos_sin(s, head_dim, cfg.rope_theta)   # [s, 1, 1, d]
+    if suffix:
+        # RoPE at the slab's absolute positions start + i (clamped for
+        # dead bucket-padding rows), indexed from the full-window table
+        cos_t, sin_t = _rope_cos_sin(cache.max_seq, head_dim,
+                                     cfg.rope_theta)  # [max_seq, 1, 1, d]
+        positions = jnp.minimum(
+            jnp.asarray(start, jnp.int32)
+            + jnp.arange(s, dtype=jnp.int32),
+            jnp.int32(cache.max_seq - 1))
+        cos = jnp.take(cos_t, positions, axis=0)            # [s, 1, 1, d]
+        sin = jnp.take(sin_t, positions, axis=0)
+    else:
+        cos, sin = _rope_cos_sin(s, head_dim, cfg.rope_theta)
 
     ks, vs = [], []
     for i in range(cfg.num_layers):
@@ -232,12 +306,17 @@ def _llama_prefill(cfg, params, tokens, length=None):
         # cache the PRE-broadcast kv (once per kv head)
         ks.append(k.transpose(1, 2, 0, 3)[0])               # [kv, s, d]
         vs.append(v.transpose(1, 2, 0, 3)[0])
-        if group > 1:                   # GQA: share kv across the group
-            k, v = (jnp.broadcast_to(
-                t[:, :, :, None, :], (s, b, kv_heads, group, head_dim)
-            ).reshape(s, b, heads, head_dim) for t in (k, v))
-        q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
-        ctx = flash_attention(q, k, v, causal=True)
+        if suffix:
+            qb, kb, vb = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+            ctx = _suffix_attend(cache, i, row, qb, kb, vb, start)
+        else:
+            if group > 1:               # GQA: share kv across the group
+                k, v = (jnp.broadcast_to(
+                    t[:, :, :, None, :],
+                    (s, b, kv_heads, group, head_dim)
+                ).reshape(s, b, heads, head_dim) for t in (k, v))
+            q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+            ctx = flash_attention(q, k, v, causal=True)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
         x = x + _linear(lp["attention"]["o_proj"], ctx)
         h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
@@ -246,7 +325,8 @@ def _llama_prefill(cfg, params, tokens, length=None):
 
     h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
     if length is not None:
-        logits = _linear(p["lm_head"], _last_row(h, length))  # [b, v]
+        last = length - start if suffix else length   # local slab index
+        logits = _linear(p["lm_head"], _last_row(h, last))    # [b, v]
     else:
         logits = _linear(p["lm_head"], h)                     # [s, b, v]
     return logits, jnp.stack(ks), jnp.stack(vs)
@@ -291,7 +371,8 @@ def _llama_decode(cfg, params, cache, tokens):
 # dispatch
 # --------------------------------------------------------------------------
 
-def prefill_forward(kind: str, cfg, params, tokens, length=None):
+def prefill_forward(kind: str, cfg, params, tokens, length=None, *,
+                    cache=None, row=None, prefill_from=None):
     """Full-prompt forward: ``tokens [1, s]`` -> ``(logits, k_stack,
     v_stack)`` with k/v ``[layers, kv_heads, s, head_dim]`` ready for
     :func:`kv_cache.insert`.
@@ -299,12 +380,30 @@ def prefill_forward(kind: str, cfg, params, tokens, length=None):
     With ``length`` (the real prompt length inside a bucket-padded
     ``s``, traced OK) the lm head runs on ONLY the last real position —
     ``logits [1, v]``; without it every position is projected
-    (``logits [s, 1, v]``, the full-forward shape parity tests pin)."""
+    (``logits [s, 1, v]``, the full-forward shape parity tests pin).
+
+    Suffix mode (ISSUE 12 — paged engines only): with ``cache`` (the
+    :class:`~apex_tpu.inference.kv_cache.PagedKVCache`), ``row`` (the
+    slot's full page-table row) and ``prefill_from`` (how many prompt
+    tokens are already cached, traced OK), ``tokens`` is the
+    bucket-padded UNCACHED TAIL: rows sit at absolute positions
+    ``prefill_from + i``, attend to the cached prefix through the page
+    window (:func:`_suffix_attend`) and causally to the slab itself,
+    and ``length`` is the TOTAL live length (prefix + real suffix).
+    ``prefill_from == 0`` reproduces the cold path bitwise — one
+    compiled executable per bucket serves cold prefills, prefix-cache
+    hits, and chunked-prefill continuation chunks alike."""
     if tokens.ndim != 2 or tokens.shape[0] != 1:
         raise ValueError(
             f"prefill takes one prompt [1, s], got {tuple(tokens.shape)}")
     fn = _gpt_prefill if kind == "gpt" else _llama_prefill
-    return fn(cfg, params, tokens, length)
+    if cache is None:
+        return fn(cfg, params, tokens, length)
+    if row is None or prefill_from is None or length is None:
+        raise ValueError(
+            "suffix prefill needs cache, row, prefill_from AND length")
+    return fn(cfg, params, tokens, length, cache=cache, row=row,
+              start=prefill_from)
 
 
 def decode_forward(kind: str, cfg, params, cache, tokens):

@@ -1,0 +1,250 @@
+"""Host-side prefix cache: a radix tree over token ids mapping cached
+prompt prefixes to KV page lists (ISSUE 12).
+
+Serving traffic is dominated by shared prompt prefixes — system
+prompts, few-shot templates, multi-turn history.  The paged layout
+(ISSUE 6) makes reusing them a TABLE-ROW EDIT: page-table indirection
+means N requests can point at ONE physical copy of the prefix's pages,
+so this cache only has to answer, host-side, "which already-filled
+pages cover a prefix of this prompt?"  The device needs no new
+executables.
+
+Structure (the SGLang-style radix tree, at PAGE granularity):
+
+* Each FULL-PAGE edge is keyed by its ``page_size`` token ids and
+  carries the physical page holding those tokens' k/v.  Walking edges
+  from the root yields the longest cached page-aligned prefix.
+* A node may additionally hold PARTIAL-TAIL edges (< ``page_size``
+  tokens): the unaligned tail of a cached prompt.  At the walk's
+  boundary the longest common prefix against any outgoing edge adds
+  sub-page coverage — the rows past the match are masked by the
+  consumer (``prefix_window_attention`` masks columns ``>= start``),
+  so partially matching pages are safely reusable.
+
+Reference counting: the cache holds ONE reference
+(:meth:`~apex_tpu.inference.kv_cache.PageAllocator.share`) on every
+page it indexes, so cached pages survive their original request's
+retirement; :meth:`evict_lru` releases references leaf-first in
+least-recently-matched order when the scheduler needs pages back —
+BACKPRESSURE drives eviction, never a mid-request free.
+
+The cache never touches the device: matching and insertion are pure
+host bookkeeping over ints, performed at the admission points the
+scheduler already occupies.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.inference.kv_cache import PageAllocator
+
+__all__ = ["PrefixCache", "prefix_cache_enabled"]
+
+_PREFIX_CACHE_ENV = "APEX_TPU_PREFIX_CACHE"
+
+
+def prefix_cache_enabled() -> bool:
+    """``APEX_TPU_PREFIX_CACHE``: prefix caching for paged schedulers —
+    on by default (sharing is functionally transparent); ``0`` disables
+    matching AND insertion (every admission prefills cold)."""
+    env = os.environ.get(_PREFIX_CACHE_ENV)
+    if env is None:
+        return True
+    return env.strip() not in ("0", "", "false", "False")
+
+
+class _Edge:
+    """One cached page: the tokens it holds, the physical page id, the
+    LRU stamp, and (full-page edges only) the child node continuing the
+    prefix."""
+    __slots__ = ("page", "child", "stamp")
+
+    def __init__(self, page: int, child: Optional["_Node"], stamp: int):
+        self.page = page
+        self.child = child
+        self.stamp = stamp
+
+
+class _Node:
+    __slots__ = ("children", "partials")
+
+    def __init__(self):
+        self.children: Dict[Tuple[int, ...], _Edge] = {}   # ps-token edges
+        self.partials: Dict[Tuple[int, ...], _Edge] = {}   # sub-page tails
+
+
+def _lcp(a: Tuple[int, ...], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+class PrefixCache:
+    """Radix tree ``token ids -> page list`` over one
+    :class:`~apex_tpu.inference.kv_cache.PageAllocator`'s pages.
+
+    ``min_hit_tokens`` (default ``page_size``) is the smallest coverage
+    reported as a hit: sharing less than one page's worth of prefix
+    costs a COW copy for near-zero compute savings, so sub-page
+    accidental overlaps stay cold.
+    """
+
+    def __init__(self, allocator: PageAllocator,
+                 min_hit_tokens: Optional[int] = None):
+        self._alloc = allocator
+        self.page_size = allocator.page_size
+        self.min_hit_tokens = (self.page_size if min_hit_tokens is None
+                               else int(min_hit_tokens))
+        self._root = _Node()
+        self._clock = 0
+        self.pinned_pages = 0          # pages this cache holds a ref on
+        self.evictions = 0             # entries released by evict_lru
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # -- lookup --------------------------------------------------------------
+    def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``tokens``: ``(covered_tokens,
+        pages)`` with ``pages`` covering ``ceil(covered / page_size)``
+        physical pages (the last one possibly partial — its rows past
+        the coverage are masked by the consumer).  Coverage below
+        ``min_hit_tokens`` reports a miss ``(0, [])``.  Matched edges
+        are LRU-touched."""
+        toks = [int(t) for t in tokens]
+        ps = self.page_size
+        node, pages, c = self._root, [], 0
+        path: List[_Edge] = []
+        while len(toks) - c >= ps:
+            edge = node.children.get(tuple(toks[c:c + ps]))
+            if edge is None:
+                break
+            path.append(edge)
+            pages.append(edge.page)
+            c += ps
+            node = edge.child
+        # boundary: best sub-page overlap against any outgoing edge
+        rest = toks[c:]
+        best, best_edge = 0, None
+        if rest:
+            for et, edge in list(node.children.items()) \
+                    + list(node.partials.items()):
+                n = _lcp(et, rest)
+                if n > best:
+                    best, best_edge = n, edge
+        if best_edge is not None:
+            path.append(best_edge)
+            pages.append(best_edge.page)
+            c += best
+        if c < self.min_hit_tokens:
+            return 0, []
+        stamp = self._tick()
+        for edge in path:
+            edge.stamp = stamp
+        return c, pages
+
+    # -- insertion -----------------------------------------------------------
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Register a prefilled prompt: ``pages`` are the physical
+        pages backing ``tokens`` in order (``ceil(len(tokens) /
+        page_size)`` of them).  New edges take one allocator reference
+        per page (the cache's own pin); edges already present are
+        deduplicated — the newcomer's identical private pages simply
+        stay uncached and die with their request.  Returns the number
+        of pages newly pinned."""
+        toks = [int(t) for t in tokens]
+        ps = self.page_size
+        full = len(toks) // ps
+        if len(pages) < full + (1 if len(toks) % ps else 0):
+            raise ValueError(
+                f"{len(pages)} pages cannot back {len(toks)} tokens at "
+                f"page size {ps}")
+        stamp = self._tick()
+        node, new = self._root, 0
+        for j in range(full):
+            et = tuple(toks[j * ps:(j + 1) * ps])
+            edge = node.children.get(et)
+            if edge is None:
+                self._alloc.share([pages[j]])
+                new += 1
+                edge = _Edge(int(pages[j]), _Node(), stamp)
+                node.children[et] = edge
+            edge.stamp = stamp
+            node = edge.child
+        tail = tuple(toks[full * ps:])
+        if tail:
+            edge = node.partials.get(tail)
+            if edge is None:
+                self._alloc.share([pages[full]])
+                new += 1
+                node.partials[tail] = _Edge(int(pages[full]), None, stamp)
+            else:
+                edge.stamp = stamp
+        self.pinned_pages += new
+        return new
+
+    # -- eviction ------------------------------------------------------------
+    def _evictable(self):
+        """Yield ``(stamp, parent_dict, key)`` for every leaf edge: any
+        partial tail, and any full-page edge whose child continues
+        nothing — interior pages stay until their subtree drains."""
+        out = []
+
+        def walk(node: _Node):
+            for key, edge in node.partials.items():
+                out.append((edge.stamp, node.partials, key))
+            for key, edge in node.children.items():
+                child = edge.child
+                if not child.children and not child.partials:
+                    out.append((edge.stamp, node.children, key))
+                else:
+                    walk(child)
+
+        walk(self._root)
+        return out
+
+    def evict_lru(self, pages_wanted: int) -> int:
+        """Release cached references, least-recently-matched leaves
+        first, until ``pages_wanted`` pages have RETURNED to the free
+        list (a released page still shared by a live request frees
+        nothing, so eviction keeps going) or the cache is empty.
+        Returns the number of pages actually freed.
+
+        One tree walk evicts a whole BATCH of leaves (oldest first);
+        the tree is re-walked only when the batch is exhausted (popping
+        a leaf can turn its parent into a leaf) — O(leaves) per level
+        instead of a full walk per evicted page."""
+        freed0 = self._alloc.free_pages
+
+        def short():
+            return self._alloc.free_pages - freed0 >= pages_wanted
+
+        while not short():
+            leaves = sorted(self._evictable(), key=lambda t: t[0])
+            if not leaves:
+                break
+            for _, parent, key in leaves:
+                if short():
+                    break
+                edge = parent.pop(key)
+                self._alloc.release([edge.page])
+                self.pinned_pages -= 1
+                self.evictions += 1
+        return self._alloc.free_pages - freed0
+
+    def clear(self) -> None:
+        """Release every cached reference (cache teardown)."""
+        def walk(node: _Node):
+            for edge in node.partials.values():
+                self._alloc.release([edge.page])
+            for edge in node.children.values():
+                self._alloc.release([edge.page])
+                walk(edge.child)
+
+        walk(self._root)
+        self._root = _Node()
+        self.pinned_pages = 0

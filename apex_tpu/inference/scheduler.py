@@ -1,5 +1,5 @@
-"""Static-bucket continuous batching: a host-side slot (+page)
-allocator.
+"""SLO-aware continuous batching: a host-side slot (+page) allocator
+with shared-prefix admission, chunked prefill, and tenant fairness.
 
 The Megatron/vLLM-style serving loop reduced to its TPU-native core: the
 DEVICE programs never change shape — decode is always ``[slots]``-wide,
@@ -9,51 +9,122 @@ retires requests between device steps:
     admit:   free slot + queued request -> prefill into the slot
              (one donated executable; first token sampled in-program).
              PAGED engines additionally need the request's page
-             reservation (prompt + token budget, whole pages) from the
-             pool — short of pages the request WAITS (backpressure)
-             until a retire reclaims some, so admission is bounded by
-             free HBM pages, not by worst-case slots.
+             reservation from the pool — but a request whose prompt
+             extends a CACHED PREFIX (ISSUE 12) reserves only its
+             uncached SUFFIX pages: the shared prefix pages are
+             written into the slot's page-table row at one extra
+             reference each (:class:`~apex_tpu.inference.prefix_cache.
+             PrefixCache` + the refcounted allocator), and only the
+             tail is prefilled (``prefill_from``).  Short of pages the
+             scheduler first EVICTS cold cache entries (LRU), then
+             WAITS (backpressure).  Admission order is SLO-aware:
+             highest effective priority first (request priority + the
+             ``APEX_TPU_TENANT_PRIORITY`` override), ties broken by
+             least-recently-admitted tenant (per-tenant fairness under
+             overload), then FIFO.
+    chunk:   a long prompt's prefill is split into fixed-token chunks
+             (``APEX_TPU_PREFILL_CHUNK``) interleaved with decode
+             steps, so a long-prompt burst cannot stall every
+             in-flight decode token for a whole monolithic prefill —
+             at most ``max_chunks_per_pass`` chunks run between
+             consecutive decode steps.
     step:    one decode executable over every slot (inactive slots
              compute garbage that is masked and never advances)
-    retire:  EOS, the token budget, or slot capacity frees the slot
-             (and returns its pages to the pool); eviction is pure
-             metadata, so retiring moves zero bytes on device.  Every
-             finished request records WHY in ``finish_reasons`` —
-             capacity truncation is surfaced, never silent (ISSUE 6).
+    retire:  EOS, the token budget, or slot capacity frees the slot;
+             a retired slot only RELEASES its page references — a page
+             another request (or the prefix cache) still maps goes
+             back to the free list only when its LAST owner lets go.
+             Every finished request records WHY in ``finish_reasons``.
+
+Copy-on-write: a slot about to write into a page it still shares (the
+partial boundary page of an unaligned prefix hit — e.g. a prompt that
+EXACTLY matches a cached prefix re-prefills only its last token)
+first privatizes it: one fresh page, one compiled copy dispatch
+(:meth:`~apex_tpu.inference.engine.InferenceEngine.cow_page`), and the
+row points at the copy — the other owners' reads stay bitwise
+untouched.
 
 A wave of requests therefore flows through a FIXED set of compiled
-programs — the continuous-batching property: a finished sequence's slot
-is refilled on the next loop iteration while the other slots keep
-decoding, with no recompile and no cache reallocation anywhere.
+programs — the continuous-batching property — and N requests sharing a
+P-page prefix pin P physical prefix pages, not N·P.
 
-Telemetry (ISSUE 8): every scheduler carries a
+Telemetry (ISSUE 8/12): every scheduler carries a
 :class:`~apex_tpu.observability.serve.ServeTelemetry` observing the
-lifecycle at the host points the loop ALREADY occupies (it reads
-sampled tokens between steps by construction, so instrumentation adds
-zero device reads and zero recompiles): submit/admit/first-token/finish
-events, TTFT + per-token decode-latency histograms, queue depth,
-backpressure + per-``finish_reasons`` counters, and the page-pool
-free/occupancy gauges.  ``peak_active``/``finish_reasons`` stay as
-attributes for existing callers, mirrored into the registry.
+lifecycle at host points the loop ALREADY occupies — zero device reads,
+zero recompiles — now including prefix-cache hit rate, shared-page and
+cache-pinned-page gauges, COW copies, prefill chunks, and per-tenant
+admitted/rejected counters.
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Optional
+import os
+from typing import Dict, Optional
 
 import numpy as np
 
 from apex_tpu.inference import kv_cache
+from apex_tpu.inference.prefix_cache import PrefixCache, prefix_cache_enabled
 from apex_tpu.observability import ServeTelemetry
 
-__all__ = ["Request", "SlotScheduler", "generate"]
+__all__ = ["Request", "SlotScheduler", "generate",
+           "default_prefill_chunk", "tenant_priority_overrides"]
 
 #: finish_reasons codes
 REASON_EOS = "eos"                    # the request's eos_id was sampled
 REASON_LENGTH = "length"              # max_new_tokens budget exhausted
 REASON_TRUNCATED = "truncated"        # slot capacity (max_seq or page
 #                                       reservation) cut the stream
+
+_PREFILL_CHUNK_ENV = "APEX_TPU_PREFILL_CHUNK"
+_TENANT_PRIORITY_ENV = "APEX_TPU_TENANT_PRIORITY"
+
+
+def default_prefill_chunk() -> int:
+    """``APEX_TPU_PREFILL_CHUNK``: chunked-prefill chunk size in tokens
+    (``0`` = monolithic prefill).  Prompts longer than this prefill in
+    chunks interleaved with decode steps, bounding decode-token p99
+    during long-prompt bursts."""
+    env = os.environ.get(_PREFILL_CHUNK_ENV)
+    if not env:
+        return 0
+    try:
+        val = int(env)
+    except ValueError as e:
+        raise ValueError(
+            f"{_PREFILL_CHUNK_ENV} must be an int, got {env!r}") from e
+    if val < 0:
+        raise ValueError(
+            f"{_PREFILL_CHUNK_ENV} must be >= 0, got {val}")
+    return val
+
+
+def tenant_priority_overrides() -> Dict[str, int]:
+    """``APEX_TPU_TENANT_PRIORITY``: per-tenant admission-priority
+    boosts, ``"tenantA=10,tenantB=-1"`` (empty/``0`` = none).  Added to
+    each request's own ``priority`` when the scheduler picks the next
+    admission."""
+    env = os.environ.get(_TENANT_PRIORITY_ENV)
+    if not env or env.strip() == "0":
+        return {}
+    out: Dict[str, int] = {}
+    for item in env.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(
+                f"{_TENANT_PRIORITY_ENV} entries must be "
+                f"tenant=priority, got {item!r}")
+        name, _, val = item.partition("=")
+        try:
+            out[name.strip()] = int(val)
+        except ValueError as e:
+            raise ValueError(
+                f"{_TENANT_PRIORITY_ENV}: priority for {name!r} must "
+                f"be an int, got {val!r}") from e
+    return out
 
 
 @dataclasses.dataclass
@@ -62,6 +133,8 @@ class Request:
     prompt: list
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
+    tenant: str = "default"
+    priority: int = 0
 
 
 @dataclasses.dataclass
@@ -73,7 +146,15 @@ class _SlotState:
     eos_id: Optional[int]
     prompt_len: int = 0
     capacity: int = 0              # cache positions this slot owns
-    pages: Optional[list] = None   # reserved page IDs (paged engines)
+    pages: Optional[list] = None   # page refs held (shared + private)
+    tenant: str = "default"
+    prompt: Optional[list] = None  # full prompt (chunked prefill)
+    prefilled: int = 0             # prompt tokens already in the cache
+    chunked: bool = False          # prefill split into >1 chunk
+
+    def prefilling(self) -> bool:
+        """Still inserting prompt tokens — not decoding yet."""
+        return self.prefilled < self.prompt_len
 
     def done(self) -> bool:
         if self.eos_id is not None and self.generated \
@@ -91,17 +172,26 @@ class _SlotState:
 
 class SlotScheduler:
     """Maps a request queue onto the engine's fixed slots (and, paged,
-    onto its page pool).
+    onto its page pool, sharing cached prefix pages across requests).
 
     ``finish_reasons[uid]`` records why each request stopped:
     ``"eos"``, ``"length"`` (token budget), or ``"truncated"`` (slot
     capacity — ``max_seq``, or the page reservation when prompt +
     budget exceeded the virtual window).  ``peak_active`` tracks the
     maximum concurrently-decoding requests the run reached — the
-    admission-capacity observable the paged cache exists to raise.
+    admission-capacity observable prefix sharing exists to raise.
+
+    ``prefill_chunk``/``tenant_priority`` default from their env knobs
+    (``APEX_TPU_PREFILL_CHUNK`` / ``APEX_TPU_TENANT_PRIORITY``);
+    ``prefix_cache=False`` disables prefix sharing for this scheduler
+    regardless of ``APEX_TPU_PREFIX_CACHE``.
     """
 
-    def __init__(self, engine, telemetry: Optional[ServeTelemetry] = None):
+    def __init__(self, engine, telemetry: Optional[ServeTelemetry] = None,
+                 *, prefix_cache: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 tenant_priority: Optional[Dict[str, int]] = None,
+                 max_chunks_per_pass: int = 1):
         self.engine = engine
         self.queue: collections.deque = collections.deque()
         self._next_uid = 0
@@ -112,31 +202,63 @@ class SlotScheduler:
         # tests pass a ServeTelemetry over a fresh registry for isolation
         self.telemetry = (telemetry if telemetry is not None
                           else ServeTelemetry())
+        use_prefix = (prefix_cache if prefix_cache is not None
+                      else prefix_cache_enabled())
+        self.prefix = (PrefixCache(self.alloc)
+                       if engine.paged and use_prefix else None)
+        self.prefill_chunk = (default_prefill_chunk()
+                              if prefill_chunk is None
+                              else int(prefill_chunk))
+        if self.prefill_chunk and not engine.paged:
+            raise ValueError(
+                "chunked prefill rides the paged cache's prefill_from "
+                "path; this engine runs the dense slot cache")
+        if self.prefill_chunk and engine.paged \
+                and self.prefill_chunk % engine.page_size:
+            raise ValueError(
+                f"prefill chunk ({self.prefill_chunk}) must be a "
+                f"multiple of page_size ({engine.page_size}) so chunk "
+                f"boundaries stay page-aligned")
+        self.tenant_priority = (tenant_priority_overrides()
+                                if tenant_priority is None
+                                else dict(tenant_priority))
+        self.max_chunks_per_pass = max(1, int(max_chunks_per_pass))
+        self._admit_clock = 0
+        self._tenant_last_admit: Dict[str, int] = {}
+        # the scheduler OWNS one cache for its lifetime (lazily built):
+        # the prefix cache indexes physical pages of THIS cache, so a
+        # fresh pool per run() would turn every cached prefix into a
+        # dangling pointer at zeroed pages.  One allocator, one prefix
+        # cache, one device cache — one lifetime.
+        self.cache = None
         if self.alloc is not None:
             self.telemetry.pool(self.alloc.free_pages,
                                 self.engine.num_pages)
 
     def submit(self, prompt, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> int:
+               eos_id: Optional[int] = None, tenant: str = "default",
+               priority: int = 0) -> int:
         """Queue one request; returns its uid (results key)."""
         tel = self.telemetry
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
-            tel.request_rejected("empty_prompt")
+            tel.request_rejected("empty_prompt", tenant=tenant)
             raise ValueError("empty prompt")
         if len(prompt) > self.engine.max_seq:
-            tel.request_rejected("prompt_over_max_seq")
+            tel.request_rejected("prompt_over_max_seq", tenant=tenant)
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds engine max_seq "
                 f"{self.engine.max_seq}")
         if self.alloc is not None:
             # fail fast: a request no empty pool could ever cover would
-            # otherwise stall the FIFO mid-run after earlier requests
-            # already finished (and their results were built)
+            # otherwise stall the queue mid-run after earlier requests
+            # already finished (and their results were built).  The
+            # check is conservative — cold-path pages — because hits
+            # cannot be known before the prefix cache is populated.
             need = self.alloc.pages_needed(len(prompt)
                                            + int(max_new_tokens))
             if need > self.engine.num_pages:
-                tel.request_rejected("request_over_pool")
+                tel.request_rejected("request_over_pool", tenant=tenant)
                 raise ValueError(
                     f"request needs {need} pages of "
                     f"{self.engine.page_size} (prompt {len(prompt)} + "
@@ -146,47 +268,124 @@ class SlotScheduler:
         uid = self._next_uid
         self._next_uid += 1
         self.queue.append(Request(uid, prompt, int(max_new_tokens),
-                                  eos_id))
+                                  eos_id, str(tenant), int(priority)))
         tel.request_submitted(uid, len(prompt), int(max_new_tokens),
                               queue_depth=len(self.queue))
         return uid
 
     # -- admission ----------------------------------------------------------
+    def _pick_index(self) -> int:
+        """Queue index of the next request to admit: highest effective
+        priority (request priority + tenant override); ties go to the
+        LEAST recently admitted tenant (round-robin fairness under
+        overload), then FIFO."""
+        best_key, best_i = None, 0
+        for i, req in enumerate(self.queue):
+            pr = req.priority + self.tenant_priority.get(req.tenant, 0)
+            key = (-pr, self._tenant_last_admit.get(req.tenant, -1), i)
+            if best_key is None or key < best_key:
+                best_key, best_i = key, i
+        return best_i
+
     def _reservation(self, req: Request):
-        """(pages or None, capacity) for one request.  Paged: whole
-        pages covering prompt + token budget — the static prefill
-        bucket may be LARGER, but bucket pages past the reservation
-        hold only dead padding rows (masked by the length) and spill
-        into the pool's trash page by construction, so they cost
-        nothing.  ``None`` pages means the pool can't cover the
-        request right now (backpressure).  Dense: capacity is the
-        shared ``max_seq``."""
+        """Page plan for one request, or None (backpressure).
+
+        Paged: match the prompt against the prefix cache, take one
+        shared reference per covered page, and ACQUIRE only the
+        private pages (uncached suffix + decode headroom).  Coverage
+        is clamped to ``len(prompt) - 1`` — the last prompt token is
+        always prefilled so its logits seed the first sampled token —
+        which is exactly what makes a fully-cached prompt's boundary
+        page a COW candidate.  Short of private pages the prefix
+        cache evicts LRU entries first; only then does the request
+        wait.  Returns ``(row_ids, capacity, covered, cow_src)``:
+        ``row_ids`` the slot's full ordered page list, ``covered`` the
+        shared token coverage, ``cow_src`` the shared page to
+        privatize before the suffix prefill writes mid-page (or
+        None).  Dense: ``(None, max_seq, 0, None)``."""
         eng = self.engine
         if not eng.paged:
-            return None, eng.max_seq
-        need = self.alloc.pages_needed(
+            return None, eng.max_seq, 0, None
+        ps = eng.page_size
+        need_total = self.alloc.pages_needed(
             len(req.prompt) + req.max_new_tokens)
-        pages = self.alloc.alloc(need)
-        if pages is None:
-            return None, 0
-        return pages, min(need * eng.page_size, eng.max_seq)
+        covered, mpages = 0, []
+        if self.prefix is not None:
+            covered, mpages = self.prefix.match(req.prompt)
+            covered = min(covered, len(req.prompt) - 1)
+            if covered < self.prefix.min_hit_tokens:
+                covered, mpages = 0, []
+            else:
+                mpages = mpages[:-(-covered // ps)]
+        full = covered // ps
+        partial = covered % ps
+        shared = mpages[:full]
+        cow_src = mpages[full] if partial else None
+        # pin the matched pages BEFORE eviction/acquire: evict_lru may
+        # release the cache's (sole) reference on exactly these pages,
+        # and the LIFO acquire would then re-issue one of them as a
+        # private suffix page — the same physical page mapped twice
+        # into one row.  The request's own references block that.
+        pinned = shared + ([cow_src] if cow_src is not None else [])
+        self.alloc.share(pinned)
+        need_priv = need_total - full
+        if need_priv > self.alloc.free_pages and self.prefix is not None:
+            freed = self.prefix.evict_lru(
+                need_priv - self.alloc.free_pages)
+            if freed:
+                self.telemetry.prefix_evicted(self.prefix.evictions)
+        priv = self.alloc.acquire(need_priv)
+        if priv is None:
+            self.alloc.release(pinned)
+            return None, 0, covered, None
+        row_ids = shared + priv
+        return row_ids, min(len(row_ids) * ps, eng.max_seq), covered, \
+            cow_src
 
     def run(self, cache=None) -> dict:
         """Drain the queue; returns ``{uid: generated token list}``.
 
-        One pass of the loop = admit every free slot (and, paged, every
-        page reservation) it can, then one batched decode step.  The
-        device sees only the fixed-shape prefill/decode executables;
-        everything else here is host-side bookkeeping on ints.
+        One pass of the loop = admit what fits (slots, pages —
+        priority/fairness ordered), advance at most
+        ``max_chunks_per_pass`` prefill chunks, then ONE batched
+        decode step over the decoding slots.  The device sees only the
+        fixed-shape prefill/decode (+COW copy) executables; everything
+        else here is host-side bookkeeping on ints.
         """
         eng = self.engine
         tel = self.telemetry
         if cache is None:
-            cache = eng.init_cache()
+            if self.cache is None:
+                self.cache = eng.init_cache()
+            cache = self.cache
+        elif cache is not self.cache:
+            # the allocator and prefix cache index PHYSICAL page ids of
+            # the cache this scheduler has been serving — swapping in a
+            # foreign cache would turn every cached prefix into a
+            # dangling pointer at garbage pages.  A fresh cache is only
+            # adoptable while no page state references the old one.
+            if self.alloc is not None and (
+                    self.alloc.live_pages > 0
+                    or (self.prefix is not None
+                        and self.prefix.pinned_pages > 0)):
+                raise ValueError(
+                    "a paged SlotScheduler owns its cache for its "
+                    "lifetime (the prefix cache/allocator index this "
+                    "cache's physical pages); cannot substitute a "
+                    "different cache while pages are live — build a "
+                    "new scheduler instead")
+            self.cache = cache
         slots: list = [None] * eng.slots
         free = list(range(eng.slots))
         last = np.zeros((eng.slots,), np.int32)
         results: dict = {}
+
+        def pool_gauges():
+            tel.pool(self.alloc.free_pages, eng.num_pages)
+            tel.prefix_pages(
+                self.alloc.shared_pages(),
+                self.prefix.pinned_pages if self.prefix is not None
+                else 0)
 
         def retire(slot, reason):
             nonlocal cache
@@ -199,55 +398,136 @@ class SlotScheduler:
             results[st.uid] = gen
             self.finish_reasons[st.uid] = reason
             if st.pages is not None:
-                # device-side metadata evict BEFORE the pages can be
+                # device-side metadata evict BEFORE any page could be
                 # reassigned: it re-parks the slot's page-table row on
                 # the trash page, so the idle slot's masked decode
-                # appends can never land in another request's pages
-                # (dense slots skip this — their rows are slot-private)
+                # appends can never land in another request's pages.
+                # Host-side the slot then only RELEASES its references
+                # — a page the prefix cache or a prefix-sharing
+                # neighbour still maps stays live until its LAST owner
+                # lets go (the ISSUE 12 silent-overwrite fix).
                 cache = kv_cache.evict(cache, slot)
-                self.alloc.free(st.pages)      # pages back to the pool
-                tel.pool(self.alloc.free_pages, eng.num_pages)
+                self.alloc.release(st.pages)
+                pool_gauges()
             slots[slot] = None
             free.append(slot)          # eviction = metadata; insert
             # on re-admit overwrites the stale cache rows
             tel.request_finished(st.uid, reason, len(gen))
 
+        def prefill_piece(slot):
+            """Advance one slot's prefill by one chunk (or the whole
+            uncached tail when chunking is off / the tail fits)."""
+            nonlocal cache
+            st = slots[slot]
+            total = st.prompt_len
+            start = st.prefilled
+            end = (total if not self.prefill_chunk
+                   else min(total, start + self.prefill_chunk))
+            with tel.prefill_step(
+                    prompt_len=end - start,
+                    bucket_len=eng.bucket_for(end - start)):
+                cache, tok, _ = eng.prefill(
+                    cache, st.prompt[:end], slot, pages=st.pages,
+                    prefill_from=start)
+                tok = int(np.asarray(tok))
+            st.prefilled = end
+            if st.chunked:
+                tel.prefill_chunked(st.uid, start, end - start)
+            if end < total:
+                return                 # more chunks to go
+            # final piece: the sampled token is the request's first
+            tel.first_token(st.uid)
+            st.generated.append(tok)
+            last[slot] = tok
+            if self.prefix is not None:
+                ps = eng.page_size
+                new = self.prefix.insert(
+                    st.prompt, st.pages[:-(-total // ps)])
+                if new:
+                    pool_gauges()
+            if st.done():
+                retire(slot, REASON_LENGTH)
+
+        def admit_one() -> bool:
+            nonlocal cache
+            i = self._pick_index()
+            row_ids, capacity, covered, cow_src = \
+                self._reservation(self.queue[i])
+            if eng.paged and row_ids is None:
+                tel.backpressured()
+                return False           # out of pages: wait for a retire
+            req = self.queue[i]
+            del self.queue[i]
+            slot = free.pop()
+            self._admit_clock += 1
+            self._tenant_last_admit[req.tenant] = self._admit_clock
+            if self.prefix is not None:
+                tel.prefix_lookup(covered > 0, covered)
+            tel.request_admitted(
+                req.uid, slot, queue_depth=len(self.queue),
+                pages=len(row_ids) if row_ids is not None else None,
+                tenant=req.tenant, prefix_tokens=covered)
+            if row_ids is not None:
+                pool_gauges()
+            if cow_src is not None:
+                # privatize the partially-shared boundary page before
+                # the suffix prefill writes into it mid-page: the copy
+                # lands in the first private page of the reservation.
+                # The source was pinned by _reservation only for the
+                # copy window — the slot's row maps the copy, not it.
+                dst = row_ids[covered // eng.page_size]
+                cache = eng.cow_page(cache, cow_src, dst)
+                self.alloc.release([cow_src])
+                tel.cow_copied(req.uid, slot, cow_src, dst)
+            n_chunks = (1 if not self.prefill_chunk else
+                        -(-(len(req.prompt) - covered)
+                          // self.prefill_chunk))
+            slots[slot] = _SlotState(
+                req.uid, [], req.max_new_tokens, req.eos_id,
+                prompt_len=len(req.prompt), capacity=capacity,
+                pages=row_ids, tenant=req.tenant, prompt=req.prompt,
+                prefilled=covered, chunked=n_chunks > 1)
+            return True
+
         while self.queue or any(s is not None for s in slots):
-            # admit: fill free slots from the queue (FIFO — a request
-            # the pool can't cover yet blocks later ones rather than
-            # being starved by them)
+            # admit: fill free slots from the queue (priority/fairness
+            # ordered — a picked request the pool can't cover yet
+            # blocks this pass rather than being starved)
+            blocked = False
             while self.queue and free:
-                pages, capacity = self._reservation(self.queue[0])
-                if eng.paged and pages is None:
-                    tel.backpressured()
-                    break              # out of pages: wait for a retire
-                req = self.queue.popleft()
-                slot = free.pop()
-                tel.request_admitted(
-                    req.uid, slot, queue_depth=len(self.queue),
-                    pages=len(pages) if pages is not None else None)
-                if pages is not None:
-                    tel.pool(self.alloc.free_pages, eng.num_pages)
-                with tel.prefill_step(
-                        prompt_len=len(req.prompt),
-                        bucket_len=eng.bucket_for(len(req.prompt))):
-                    cache, tok, _ = eng.prefill(cache, req.prompt, slot,
-                                                pages=pages)
-                    tok = int(np.asarray(tok))
-                tel.first_token(req.uid)
-                slots[slot] = _SlotState(req.uid, [tok],
-                                         req.max_new_tokens, req.eos_id,
-                                         prompt_len=len(req.prompt),
-                                         capacity=capacity, pages=pages)
-                last[slot] = tok
-                if slots[slot].done():
-                    retire(slot, REASON_LENGTH)
-            active = np.array([s is not None for s in slots], bool)
+                if not admit_one():
+                    blocked = True
+                    break
+            # advance prefills.  Chunking off: every pending admission
+            # prefills now (the classic loop).  Chunking on: at most
+            # max_chunks_per_pass chunks run BETWEEN decode steps, so a
+            # long-prompt burst cannot starve in-flight decodes.
+            budget = (self.max_chunks_per_pass if self.prefill_chunk
+                      else eng.slots)
+            chunks = 0
+            for slot in range(eng.slots):
+                st = slots[slot]
+                if st is None or not st.prefilling():
+                    continue
+                prefill_piece(slot)
+                chunks += 1
+                if chunks >= budget:
+                    break
+            active = np.array(
+                [s is not None and not s.prefilling()
+                 and bool(s.generated) for s in slots], bool)
             if not active.any():
+                if any(s is not None for s in slots):
+                    continue           # still prefilling: next pass
                 if self.queue:
-                    # nothing running and the head request still can't
-                    # be admitted: the POOL itself is too small for it
-                    req = self.queue[0]
+                    if not blocked:
+                        # slots opened up mid-pass (a request finished
+                        # at its prefill): admit on the next pass
+                        continue
+                    # nothing running and the picked request still
+                    # can't be admitted: the POOL itself is too small
+                    # (prefix-cache eviction already ran)
+                    req = self.queue[self._pick_index()]
                     raise RuntimeError(
                         f"request {req.uid} needs more pages than the "
                         f"pool frees up (prompt {len(req.prompt)} + "
@@ -262,7 +542,8 @@ class SlotScheduler:
             # tokens themselves.  The decode step's `truncated` output
             # is the device-side belt to this suspender.
             for slot, st in enumerate(slots):
-                if st is not None and st.cache_len() >= st.capacity:
+                if st is not None and active[slot] \
+                        and st.cache_len() >= st.capacity:
                     retire(slot, REASON_TRUNCATED)
                     active[slot] = False
             if not active.any():
@@ -292,6 +573,9 @@ class SlotScheduler:
                 last[slot] = toks[slot]
                 if st.done():
                     retire(slot, REASON_LENGTH)
+        # the (donation-threaded) cache carries into the next wave —
+        # cached prefix pages stay valid across run() calls
+        self.cache = cache
         # wave boundary: flush snapshot sinks (the Prometheus file is
         # only written on export — without this, APEX_TPU_TELEMETRY
         # would produce the JSONL stream but never metrics.prom)

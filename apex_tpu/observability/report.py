@@ -318,6 +318,27 @@ def _serve_section(events: list, families: dict) -> Optional[dict]:
             goodput.get("generated_tokens", 0.0) / spent
             if spent > 0 else None)
         out["goodput"] = goodput
+    # shared-prefix serving (ISSUE 12): cache effectiveness + sharing
+    prefix = {}
+    for key, fam in (("hits", "serve_prefix_cache_hits_total"),
+                     ("misses", "serve_prefix_cache_misses_total"),
+                     ("hit_tokens", "serve_prefix_hit_tokens_total"),
+                     ("evictions", "serve_prefix_cache_evictions_total"),
+                     ("cow_copies", "serve_cow_copies_total"),
+                     ("prefill_chunks", "serve_prefill_chunks_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            prefix[key] = v
+    lookups = prefix.get("hits", 0.0) + prefix.get("misses", 0.0)
+    if lookups:
+        prefix["hit_rate"] = prefix.get("hits", 0.0) / lookups
+    if prefix and (lookups or prefix.get("prefill_chunks")
+                   or prefix.get("cow_copies")):
+        out["prefix_cache"] = prefix
+    tenants = _family_by_label(families, "serve_tenant_admitted_total",
+                               "tenant")
+    if tenants:
+        out["tenants_admitted"] = dict(sorted(tenants.items()))
     return out
 
 
@@ -536,6 +557,18 @@ def render_markdown(report: dict) -> str:
                     lines.append(f"| {k} | {_f(gp[k])} |")
             lines.append(f"| goodput_fraction | "
                          f"{_f(gp.get('goodput_fraction'))} |")
+        px = serve.get("prefix_cache")
+        if px:
+            lines += ["",
+                      "| prefix cache | value |", "|---|---|"]
+            for k in ("hits", "misses", "hit_rate", "hit_tokens",
+                      "evictions", "cow_copies", "prefill_chunks"):
+                if k in px:
+                    lines.append(f"| {k} | {_f(px[k])} |")
+        tn = serve.get("tenants_admitted")
+        if tn:
+            lines.append("- **tenants_admitted**: " + ", ".join(
+                f"{k}={_f(v)}" for k, v in sorted(tn.items())))
         lines.append("")
 
     attr = report.get("compiled_attribution")

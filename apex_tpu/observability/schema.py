@@ -120,11 +120,47 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("serve_badput_truncated_tokens_total", "counter",
                "tokens generated by requests that finished 'truncated' "
                "(slot/page capacity cut the stream short)"),
+    # -- shared-prefix serving (ISSUE 12): prefix cache, page sharing,
+    #    copy-on-write, chunked prefill, per-tenant admission ----------
+    MetricSpec("serve_prefix_cache_hits_total", "counter",
+               "admissions whose prompt extended a cached prefix "
+               "(shared pages written into the slot's page-table row)"),
+    MetricSpec("serve_prefix_cache_misses_total", "counter",
+               "admissions that found no cached prefix (cold prefill)"),
+    MetricSpec("serve_prefix_hit_tokens_total", "counter",
+               "prompt tokens served from shared prefix pages instead "
+               "of prefill compute (summed over admissions)"),
+    MetricSpec("serve_prefix_cache_hit_rate", "gauge",
+               "hits / (hits + misses) over the scheduler's lifetime, "
+               "0..1 (set after every prefix-cache lookup)"),
+    MetricSpec("serve_prefix_shared_pages", "gauge",
+               "KV pages currently held by MORE than one owner "
+               "(requests and/or the prefix cache)"),
+    MetricSpec("serve_prefix_cache_pages", "gauge",
+               "KV pages currently pinned by the host prefix cache"),
+    MetricSpec("serve_prefix_cache_evictions_total", "counter",
+               "prefix-cache entries evicted (LRU, under page "
+               "backpressure)"),
+    MetricSpec("serve_cow_copies_total", "counter",
+               "copy-on-write page copies: a slot privatized a page it "
+               "shared before writing into it"),
+    MetricSpec("serve_prefill_chunks_total", "counter",
+               "chunked-prefill continuation chunks dispatched "
+               "(long prompts split so decode steps interleave)"),
+    MetricSpec("serve_tenant_admitted_total", "counter",
+               "requests admitted, keyed by tenant (fairness "
+               "observable under overload)", labels=("tenant",)),
+    MetricSpec("serve_tenant_rejected_total", "counter",
+               "submissions rejected at validation, keyed by tenant",
+               labels=("tenant",)),
     # -- engine dispatch (host wrappers around the donated executables) ---
     MetricSpec("infer_prefill_dispatch_total", "counter",
                "InferenceEngine.prefill dispatches"),
     MetricSpec("infer_decode_dispatch_total", "counter",
                "InferenceEngine.decode dispatches"),
+    MetricSpec("infer_cow_dispatch_total", "counter",
+               "InferenceEngine.cow_page dispatches (copy-on-write "
+               "page duplications)"),
     # -- training (TrainTelemetry) ----------------------------------------
     MetricSpec("train_steps_total", "counter",
                "instrumented train steps dispatched"),
@@ -212,7 +248,11 @@ EVENT_FIELDS: Dict[str, Dict[str, str]] = {
     "request_submit": {"uid": "int", "prompt_len": "int",
                        "max_new_tokens": "int", "queue_depth": "int"},
     "request_admit": {"uid": "int", "slot": "int", "wait_s": "float",
-                      "pages": "int|null"},
+                      "pages": "int|null", "tenant": "str",
+                      "prefix_tokens": "int"},
+    "prefill_chunk": {"uid": "int", "start": "int", "tokens": "int"},
+    "cow_copy": {"uid": "int", "slot": "int", "src": "int",
+                 "dst": "int"},
     "request_first_token": {"uid": "int", "ttft_s": "float"},
     "request_finish": {"uid": "int", "reason": "str", "tokens": "int",
                        "e2e_s": "float"},

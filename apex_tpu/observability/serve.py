@@ -66,6 +66,19 @@ class ServeTelemetry:
             "serve_badput_idle_slot_tokens_total")
         self.truncated_tokens = d(
             "serve_badput_truncated_tokens_total")
+        # shared-prefix serving (ISSUE 12): prefix-cache effectiveness,
+        # page sharing, copy-on-write, chunked prefill, tenants
+        self.prefix_hits = d("serve_prefix_cache_hits_total")
+        self.prefix_misses = d("serve_prefix_cache_misses_total")
+        self.prefix_hit_tokens = d("serve_prefix_hit_tokens_total")
+        self.prefix_hit_rate = d("serve_prefix_cache_hit_rate")
+        self.shared_pages = d("serve_prefix_shared_pages")
+        self.prefix_cache_pages = d("serve_prefix_cache_pages")
+        self.prefix_evictions = d("serve_prefix_cache_evictions_total")
+        self.cow_copies = d("serve_cow_copies_total")
+        self.prefill_chunks = d("serve_prefill_chunks_total")
+        self.tenant_admitted = d("serve_tenant_admitted_total")
+        self.tenant_rejected = d("serve_tenant_rejected_total")
         # separate timers: prefill legitimately compiles once per prompt
         # bucket, and must not advance the decode timer past its warmup
         # step (which would mislabel decode's one compile a recompile)
@@ -85,22 +98,68 @@ class ServeTelemetry:
             max_new_tokens=int(max_new_tokens),
             queue_depth=int(queue_depth))
 
-    def request_rejected(self, reason: str) -> None:
+    def request_rejected(self, reason: str,
+                         tenant: str = "default") -> None:
         """A submission that failed validation (counted as submitted —
         conservation: submitted == finished + active + rejected)."""
         self.submitted.inc()
         self.rejected.inc(reason=reason)
+        self.tenant_rejected.inc(tenant=str(tenant))
 
     def request_admitted(self, uid: int, slot: int, queue_depth: int,
-                         pages: Optional[int] = None) -> None:
+                         pages: Optional[int] = None,
+                         tenant: str = "default",
+                         prefix_tokens: int = 0) -> None:
         self.admitted.inc()
+        self.tenant_admitted.inc(tenant=str(tenant))
         self.queue_depth.set(queue_depth)
         wait = time.perf_counter() - self._submit_ts.get(
             uid, time.perf_counter())
         self.registry.emit_event(
             "request_admit", uid=int(uid), slot=int(slot),
             wait_s=round(wait, 9),
-            pages=int(pages) if pages is not None else None)
+            pages=int(pages) if pages is not None else None,
+            tenant=str(tenant), prefix_tokens=int(prefix_tokens))
+
+    # -- shared-prefix serving (ISSUE 12) -----------------------------------
+    def prefix_lookup(self, hit: bool, tokens_reused: int) -> None:
+        """One prefix-cache lookup at admission: hit/miss tally plus
+        the prompt tokens served from shared pages instead of prefill
+        compute; the hit-rate gauge tracks the running ratio."""
+        (self.prefix_hits if hit else self.prefix_misses).inc()
+        if tokens_reused:
+            self.prefix_hit_tokens.inc(tokens_reused)
+        hits = self.prefix_hits.total()
+        total = hits + self.prefix_misses.total()
+        if total:
+            self.prefix_hit_rate.set(hits / total)
+
+    def prefix_pages(self, shared: int, cached: int) -> None:
+        """Gauge refresh: pages held by more than one owner, and pages
+        pinned by the host prefix cache."""
+        self.shared_pages.set(shared)
+        self.prefix_cache_pages.set(cached)
+
+    def prefix_evicted(self, total_evictions: int) -> None:
+        """Sync the eviction counter to the cache's lifetime tally
+        (called after an LRU sweep)."""
+        done = self.prefix_evictions.total()
+        if total_evictions > done:
+            self.prefix_evictions.inc(total_evictions - done)
+
+    def cow_copied(self, uid: int, slot: int, src: int, dst: int) -> None:
+        """One copy-on-write page duplication (a slot privatized a
+        shared page before writing into it)."""
+        self.cow_copies.inc()
+        self.registry.emit_event("cow_copy", uid=int(uid),
+                                 slot=int(slot), src=int(src),
+                                 dst=int(dst))
+
+    def prefill_chunked(self, uid: int, start: int, tokens: int) -> None:
+        """One chunk of a split (chunked) prefill dispatched."""
+        self.prefill_chunks.inc()
+        self.registry.emit_event("prefill_chunk", uid=int(uid),
+                                 start=int(start), tokens=int(tokens))
 
     @contextlib.contextmanager
     def prefill_step(self, prompt_len: Optional[int] = None,
@@ -212,6 +271,16 @@ class ServeTelemetry:
             "decode_steps": int(self.decode_steps.total()),
             "recompiles": int(self.recompiles.total()),
         }
+        lookups = self.prefix_hits.total() + self.prefix_misses.total()
+        if lookups:
+            out["prefix_hits"] = int(self.prefix_hits.total())
+            out["prefix_misses"] = int(self.prefix_misses.total())
+            out["prefix_hit_tokens"] = int(self.prefix_hit_tokens.total())
+            out["prefix_hit_rate"] = round(
+                self.prefix_hits.total() / lookups, 4)
+            out["cow_copies"] = int(self.cow_copies.total())
+        if self.prefill_chunks.total():
+            out["prefill_chunks"] = int(self.prefill_chunks.total())
         for name, hist in (("ttft", self.ttft),
                            ("decode_token", self.decode_token_seconds)):
             if hist.count():

@@ -60,7 +60,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils import cdiv, interpret_mode
 
-__all__ = ["flash_attention", "mha_reference", "decode_attention"]
+__all__ = ["flash_attention", "mha_reference", "decode_attention",
+           "prefix_window_attention"]
 
 _NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
 # The kernels work in BASE-2 log domain: the dot's scalar scale absorbs
@@ -1037,3 +1038,64 @@ def decode_attention(q, k, v, lengths, *, sm_scale: Optional[float] = None,
         preferred_element_type=jnp.float32)             # [b, kvh, group, d]
     out = out.reshape(b, h, 1, d).astype(q.dtype)
     return out[:, :, 0] if squeezed else out
+
+
+def prefix_window_attention(q, k, v, win_k, win_v, start,
+                            *, sm_scale: Optional[float] = None):
+    """Suffix-prefill attention: each query row attends to a cached
+    prefix WINDOW plus causally to the suffix itself (ISSUE 12 — the
+    math behind prefix-cache hits and chunked prefill).
+
+    * ``q``: ``[b, h, s, d]`` — the suffix tokens' query heads; row
+      ``i`` sits at absolute position ``start + i``.
+    * ``k``/``v``: ``[b, kv_heads, s, d]`` — the suffix's own
+      (pre-broadcast, GQA/MQA) keys/values.
+    * ``win_k``/``win_v``: ``[b, kv_heads, W, d]`` — the cached prefix
+      window gathered from the slot's KV pages; only columns
+      ``j < start`` are live (rows past the prefix hold other pages'
+      garbage — finite by construction — and are masked, so their
+      values can never leak into the context).
+    * ``start``: ``[]`` int32 (traced OK) — the prefix length, i.e.
+      how many window columns are valid.
+
+    One fused XLA chain mirroring :func:`decode_attention`'s grouped
+    einsum path: bf16 operands into the MXU with fp32 accumulation,
+    fp32 softmax over the concatenated ``[W + s]`` key axis.  Every
+    real query row has at least itself to attend to (causal self), so
+    no fully-masked-row zeroing is needed.
+    """
+    b, h, sq, d = q.shape
+    if k.shape != v.shape or k.ndim != 4 or k.shape[0] != b \
+            or k.shape[2] != sq or k.shape[3] != d:
+        raise ValueError(
+            f"suffix k/v must be [b, kv_heads, {sq}, {d}], got "
+            f"k {tuple(k.shape)} v {tuple(v.shape)}")
+    if win_k.shape != win_v.shape or win_k.ndim != 4 \
+            or win_k.shape[:2] != k.shape[:2] or win_k.shape[3] != d:
+        raise ValueError(
+            f"window k/v must be [b, kv_heads, W, {d}], got "
+            f"win_k {tuple(win_k.shape)} win_v {tuple(win_v.shape)}")
+    kvh, w = win_k.shape[1], win_k.shape[2]
+    if kvh == 0 or h % kvh:
+        raise ValueError(
+            f"kv_heads ({kvh}) must divide query heads ({h})")
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    start = jnp.asarray(start, jnp.int32)
+    group = h // kvh
+    qg = q.reshape(b, kvh, group, sq, d)
+    kk = jnp.concatenate([win_k, k], axis=2)            # [b, kvh, W+s, d]
+    vv = jnp.concatenate([win_v, v], axis=2)
+    s = jax.lax.dot_general(
+        qg, kk, (((4,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32) * scale  # [b,kvh,g,s,W+s]
+    col = jnp.arange(w + sq, dtype=jnp.int32)[None, :]
+    row = jnp.arange(sq, dtype=jnp.int32)[:, None]
+    valid = jnp.where(col < w, col < start, (col - w) <= row)
+    s = jnp.where(valid[None, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p.astype(vv.dtype), vv, (((4,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)          # [b, kvh, g, s, d]
+    return out.reshape(b, h, sq, d).astype(q.dtype)

@@ -861,7 +861,7 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     # prefill: re-admit the prompt into slot 0 every iteration (cache
     # carried, so the insert is a live donated update, not DCE'd)
     if paged:
-        row0_ids = alloc.alloc(pages_per_req)
+        row0_ids = alloc.acquire(pages_per_req)
         row0 = jnp.asarray(page_row(row0_ids, engine.max_pages_per_slot,
                                     engine.num_pages))
 
@@ -870,6 +870,7 @@ def _microbench_infer(rtt: float, on_tpu: bool):
             cache, _, _ = prefill_fn(cache, engine.params, tokens,
                                      jnp.int32(0),
                                      jnp.int32(prefill_len), row0,
+                                     jnp.int32(0),       # prefill_from
                                      key_, jnp.int32(0))
             return cache
     else:
@@ -886,10 +887,10 @@ def _microbench_infer(rtt: float, on_tpu: bool):
 
     # decode: warm cache (every slot mid-sequence), then scan steps
     if paged:
-        alloc.free(row0_ids)     # the prefill-timing slot's reservation
+        alloc.release(row0_ids)     # the prefill-timing slot's reservation
     cache = engine.init_cache()
     for slot in range(slots):
-        pages = alloc.alloc(pages_per_req) if paged else None
+        pages = alloc.acquire(pages_per_req) if paged else None
         cache, _, _ = engine.prefill(cache, np.asarray(prompt), slot,
                                      pages=pages)
 
@@ -965,6 +966,111 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     out["infer_serve_ttft_us"] = round(s["ttft_mean_s"] * 1e6, 1)
     out["infer_serve_decode_token_us"] = round(
         s["decode_token_mean_s"] * 1e6, 1)
+
+    # shared-prefix burst + chunked-prefill legs (ISSUE 12, paged only):
+    # (a) N requests extending ONE long cached prefix — hit TTFT vs the
+    # same wave served cold, plus sharing/COW counters; (b) a long
+    # prompt admitted mid-decode — the victim stream's worst inter-token
+    # gap with monolithic vs chunked prefill.  Effective knob values are
+    # stamped so captures self-describe (same contract as page_size).
+    if paged:
+        import time as _time
+
+        from apex_tpu.inference.prefix_cache import prefix_cache_enabled
+        from apex_tpu.inference.scheduler import (
+            default_prefill_chunk,
+            tenant_priority_overrides,
+        )
+
+        out["infer_prefix_cache"] = int(prefix_cache_enabled())
+        out["infer_prefill_chunk"] = default_prefill_chunk()
+        out["infer_tenant_priority"] = ",".join(
+            f"{k}={v}" for k, v in
+            sorted(tenant_priority_overrides().items())) or "0"
+
+        burst_new = min(2, max_seq - prefill_len - 3)
+        prefix_toks = list(host_prompt)
+        burst = [prefix_toks + [(i + 1) % cfg.vocab_size,
+                                (i + 3) % cfg.vocab_size]
+                 for i in range(slots)]
+
+        def _serve_wave(sched, prompts):
+            for p in prompts:
+                sched.submit(p, max_new_tokens=burst_new)
+            sched.run()
+
+        # warm every executable the burst touches (full-prompt bucket,
+        # then — in a SECOND wave, so the first wave's pages are cached
+        # — the hit path's suffix bucket and the COW copy program) so
+        # neither measured wave pays a compile
+        warm2 = SlotScheduler(engine,
+                              telemetry=ServeTelemetry(MetricsRegistry()))
+        _serve_wave(warm2, [burst[0]])
+        _serve_wave(warm2, [burst[0]])
+
+        tel_cold = ServeTelemetry(MetricsRegistry())
+        _serve_wave(SlotScheduler(engine, telemetry=tel_cold,
+                                  prefix_cache=False), burst)
+        tel_hit = ServeTelemetry(MetricsRegistry())
+        sched_hit = SlotScheduler(engine, telemetry=tel_hit)
+        _serve_wave(sched_hit, [burst[0]])       # seed the prefix cache
+        hits0 = int(tel_hit.prefix_hits.total())
+        n0, s0 = tel_hit.ttft.count(), tel_hit.ttft.sum()
+        _serve_wave(sched_hit, burst)            # the shared burst
+        sc, sh = tel_cold.summary(), tel_hit.summary()
+        out["infer_prefix_cold_ttft_us"] = round(
+            sc["ttft_mean_s"] * 1e6, 1)
+        # burst-only mean: the seed admission is a cold prefill and
+        # must not ride the hit-TTFT stamp
+        out["infer_prefix_hit_ttft_us"] = round(
+            (tel_hit.ttft.sum() - s0)
+            / max(tel_hit.ttft.count() - n0, 1) * 1e6, 1)
+        out["infer_prefix_hit_rate"] = sh.get("prefix_hit_rate", 0.0)
+        out["infer_prefix_hits"] = int(tel_hit.prefix_hits.total()) - hits0
+        out["infer_prefix_hit_tokens"] = sh.get("prefix_hit_tokens", 0)
+        out["infer_prefix_cow_copies"] = sh.get("cow_copies", 0)
+        # the sharing geometry: one physical copy of the prefix's pages
+        out["infer_prefix_shared_pages"] = -(-prefill_len // page_size)
+
+        # chunked-prefill burst: victim decodes, a filler retires, the
+        # long prompt's prefill lands mid-stream — worst victim
+        # inter-token gap, monolithic vs chunked
+        chunk = max(page_size,
+                    (max_seq // 4) // page_size * page_size)
+        long_len = min(max_seq - 4, prefill_len + 2 * chunk)
+        long_prompt = list((np.arange(long_len) + 7) % cfg.vocab_size)
+
+        def _victim_gap(chunk_size):
+            sched = SlotScheduler(
+                engine, telemetry=ServeTelemetry(MetricsRegistry()),
+                prefix_cache=False, prefill_chunk=chunk_size)
+            sched.submit(list(host_prompt), max_new_tokens=12)  # victim
+            for _ in range(slots - 1):                          # fillers
+                sched.submit(list(host_prompt), max_new_tokens=2)
+            sched.submit(long_prompt, max_new_tokens=2)         # burst
+            stamps = []
+            orig = engine.decode
+
+            def timed(*a, **kw):
+                r = orig(*a, **kw)
+                stamps.append(_time.perf_counter())
+                return r
+
+            engine.decode = timed
+            try:
+                sched.run()
+            finally:
+                engine.decode = orig
+            gaps = np.diff(np.asarray(stamps))
+            return float(gaps.max()) if gaps.size else 0.0
+
+        _victim_gap(chunk)                       # warm the chunk bucket
+        mono = _victim_gap(0)
+        chunked = _victim_gap(chunk)
+        out["infer_burst_decode_gap_mono_us"] = round(mono * 1e6, 1)
+        out["infer_burst_decode_gap_chunked_us"] = round(
+            chunked * 1e6, 1)
+        out["infer_burst_chunk_tokens"] = chunk
     return out
 
 

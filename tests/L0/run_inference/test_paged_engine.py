@@ -155,7 +155,7 @@ def test_prefill_rejects_undersized_reservation():
     alloc = eng.new_allocator()
     cache = eng.init_cache()
     with pytest.raises(ValueError, match="trash page"):
-        eng.prefill(cache, list(range(2, 20)), 0, pages=alloc.alloc(1))
+        eng.prefill(cache, list(range(2, 20)), 0, pages=alloc.acquire(1))
 
 
 def test_request_larger_than_pool_fails_fast_at_submit():
@@ -224,7 +224,7 @@ def test_paged_decode_is_one_executable_across_admits_and_retires():
         lambda name, **kw: events.append(name))
     try:
         cache = eng.init_cache()
-        pages0 = alloc.alloc(2)
+        pages0 = alloc.acquire(2)
         cache, _, _ = eng.prefill(cache, [1, 2, 3], 0, pages=pages0)
         last = np.zeros((2,), np.int32)
         active = np.array([True, False])
@@ -235,12 +235,12 @@ def test_paged_decode_is_one_executable_across_admits_and_retires():
         # interleave: decode / retire+admit into the other slot (fresh
         # pages, same bucket) / decode / admit again / decode
         cache, toks, _, _ = eng.decode(cache, last, active)
-        alloc.free(pages0)
-        pages1 = alloc.alloc(2)
+        alloc.release(pages0)
+        pages1 = alloc.acquire(2)
         cache, _, _ = eng.prefill(cache, [4, 5], 1, pages=pages1)
         active = np.array([False, True])
         cache, toks, _, _ = eng.decode(cache, last, active)
-        pages2 = alloc.alloc(2)
+        pages2 = alloc.acquire(2)
         cache, _, _ = eng.prefill(cache, [6, 7, 8], 0, pages=pages2)
         active = np.array([True, True])
         for _ in range(3):

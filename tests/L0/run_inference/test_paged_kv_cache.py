@@ -229,54 +229,216 @@ def test_pool_is_scan_carryable():
 
 
 # --------------------------------------------------------------------------
+# token-granular suffix insert + copy-on-write (ISSUE 12)
+# --------------------------------------------------------------------------
+
+def test_insert_tokens_cold_matches_slab_insert():
+    """start=0 insert_tokens places exactly what insert_pages places —
+    the cold path is the slab path at token granularity."""
+    k = _rand((LAYERS, KVH, 2 * PS, D), 1)
+    v = _rand((LAYERS, KVH, 2 * PS, D), 2)
+    a = kv_cache.insert_pages(_cache(), 1, k, v, 5, _row([4, 1]))
+    b = kv_cache.insert_tokens(_cache(), 1, k, v, 5, _row([4, 1]), 0)
+    np.testing.assert_array_equal(np.asarray(a.k), np.asarray(b.k))
+    np.testing.assert_array_equal(np.asarray(a.v), np.asarray(b.v))
+    np.testing.assert_array_equal(np.asarray(a.page_table),
+                                  np.asarray(b.page_table))
+    assert np.asarray(b.lengths).tolist() == [0, 5, 0]
+    assert np.asarray(b.capacity).tolist() == [0, 2 * PS, 0]
+
+
+def test_insert_tokens_mid_page_preserves_earlier_rows():
+    """An unaligned suffix insert (a prefix-cache hit resuming mid-page
+    after its boundary COW) writes rows [start % PS, ...) of the
+    boundary page and leaves the copied prefix rows below untouched."""
+    c = _cache()
+    base = _rand((LAYERS, KVH, PS, D), 3)
+    c = kv_cache.insert_pages(c, 0, base, base, PS, _row([2]))
+    before = np.asarray(c.k[2]).copy()
+    # resume at start = PS - 2: the slab's first rows land at offsets
+    # PS-2, PS-1 of page 2, then roll into page 5
+    slab = _rand((LAYERS, KVH, PS, D), 4)
+    start = PS - 2
+    c = kv_cache.insert_tokens(c, 0, slab, slab, start + PS,
+                               _row([2, 5]), start)
+    got = np.asarray(c.k[2])
+    np.testing.assert_array_equal(got[:, :, :PS - 2],
+                                  before[:, :, :PS - 2])   # kept
+    np.testing.assert_array_equal(got[:, :, PS - 2:],
+                                  np.asarray(slab[:, :, :2]))
+    np.testing.assert_array_equal(np.asarray(c.k[5])[:, :, :PS - 2],
+                                  np.asarray(slab[:, :, 2:PS]))
+    assert np.asarray(c.lengths)[0] == start + PS
+    assert np.asarray(c.capacity)[0] == 2 * PS
+
+
+def test_insert_tokens_overhang_spills_into_trash_page():
+    """Bucket positions beyond the reservation clamp into the trash
+    page, exactly like the slab insert's overhang."""
+    c = _cache()
+    victim = _rand((LAYERS, KVH, PS, D), 5)
+    c = kv_cache.insert_pages(c, 0, victim, victim, PS, _row([2]))
+    slab = _rand((LAYERS, KVH, 3 * PS, D), 6)
+    c = kv_cache.insert_tokens(c, 1, slab, slab, 3, _row([5]), 0)
+    np.testing.assert_array_equal(np.asarray(c.k[2]), np.asarray(victim))
+    np.testing.assert_array_equal(np.asarray(c.k[5]),
+                                  np.asarray(slab[:, :, :PS]))
+    assert np.asarray(c.capacity).tolist() == [PS, PS, 0]
+
+
+def test_insert_tokens_full_window_overhang_is_dropped_not_clamped():
+    """Regression (review finding): when the slab overhangs past the
+    END of the virtual window (a prompt filling the whole per-slot
+    window, e.g. an exact-repeat hit at max_seq), the overhang rows are
+    DROPPED — clamping them onto the last owned position would clobber
+    the real last token's KV with padding garbage."""
+    c = _cache()
+    base = _rand((LAYERS, KVH, MPPS * PS, D), 9)
+    full_row = _row([0, 1, 2, 3])
+    c = kv_cache.insert_pages(c, 0, base, base, MPPS * PS, full_row)
+    # re-insert the LAST position only, with a bucket overhanging the
+    # window end: positions MPPS*PS .. beyond must vanish
+    slab = _rand((LAYERS, KVH, PS, D), 10)
+    c = kv_cache.insert_tokens(c, 0, slab, slab, MPPS * PS, full_row,
+                               MPPS * PS - 1)
+    got = np.asarray(c.k[3])
+    np.testing.assert_array_equal(got[:, :, PS - 1],
+                                  np.asarray(slab)[:, :, 0])  # real row
+    np.testing.assert_array_equal(got[:, :, :PS - 1],
+                                  np.asarray(base)[:, :, -PS:-1])
+    # the other owned pages are untouched by the dropped overhang
+    np.testing.assert_array_equal(np.asarray(c.k[0]),
+                                  np.asarray(base)[:, :, :PS])
+
+
+def test_cow_page_copies_rows_and_isolates_writers():
+    """cow_page duplicates a physical page; the copy's owner can then
+    be written without perturbing the original — the write barrier
+    behind shared-boundary-page admission."""
+    c = _cache()
+    base = _rand((LAYERS, KVH, PS, D), 7)
+    c = kv_cache.insert_pages(c, 0, base, base, PS - 1, _row([3]))
+    c = kv_cache.cow_page(c, 3, 0)
+    np.testing.assert_array_equal(np.asarray(c.k[0]), np.asarray(c.k[3]))
+    np.testing.assert_array_equal(np.asarray(c.v[0]), np.asarray(c.v[3]))
+    # slot 1 maps the COPY and overwrites its tail; page 3 is untouched
+    slab = _rand((LAYERS, KVH, PS, D), 8)
+    c = kv_cache.insert_tokens(c, 1, slab, slab, PS, _row([0]), PS - 1)
+    np.testing.assert_array_equal(np.asarray(c.k[3]), np.asarray(base))
+    got = np.asarray(c.k[0])
+    np.testing.assert_array_equal(got[:, :, :PS - 1],
+                                  np.asarray(base)[:, :, :PS - 1])
+    np.testing.assert_array_equal(got[:, :, PS - 1],
+                                  np.asarray(slab)[:, :, 0])
+
+
+def test_cow_page_is_donation_safe():
+    def step(c):
+        return kv_cache.cow_page(c, jnp.int32(1), jnp.int32(0))
+
+    c = _cache()
+    kbuf = c.k
+    c2 = jax.jit(step, donate_argnums=(0,))(c)
+    jax.block_until_ready(c2)
+    assert kbuf.is_deleted()
+
+
+# --------------------------------------------------------------------------
 # host-side page allocator
 # --------------------------------------------------------------------------
 
-def test_allocator_alloc_free_reuse():
+def test_allocator_acquire_release_reuse():
     al = kv_cache.PageAllocator(4, PS, MPPS)
-    a = al.alloc(2)
-    b = al.alloc(2)
+    a = al.acquire(2)
+    b = al.acquire(2)
     assert sorted(a + b) == [0, 1, 2, 3]
-    assert al.alloc(1) is None            # exhausted -> backpressure
-    al.free(a)
-    c = al.alloc(2)
-    assert sorted(c) == sorted(a)         # freed pages come back
+    assert al.acquire(1) is None          # exhausted -> backpressure
+    al.release(a)
+    c = al.acquire(2)
+    assert sorted(c) == sorted(a)         # released pages come back
     assert al.free_pages == 0
 
 
+def test_allocator_share_refcounts_and_last_owner_frees():
+    """The ISSUE 12 sharing contract: share() adds one owner per call,
+    release() drops one, and the page reaches the free list exactly
+    when its LAST owner lets go — N sharers of one page pin ONE page."""
+    al = kv_cache.PageAllocator(4, PS, MPPS)
+    [pid] = al.acquire(1)
+    al.share([pid])                       # second owner
+    al.share([pid])                       # third owner
+    assert al.refcount(pid) == 3
+    assert (al.live_pages, al.free_pages) == (1, 3)   # ONE page pinned
+    assert al.weighted_live() == 3        # ...by three owners
+    assert al.shared_pages() == 1
+    al.release([pid])
+    al.release([pid])
+    assert al.refcount(pid) == 1          # survivors keep it alive
+    assert al.free_pages == 3
+    al.release([pid])                     # last owner
+    assert al.refcount(pid) == 0
+    assert al.free_pages == 4
+    with pytest.raises(ValueError, match="not outstanding"):
+        al.share([pid])                   # sharing a freed page raises
+
+
 def test_allocator_interleaved_retire_admit_leaks_nothing():
-    """Fragmentation shape: interleaved alloc/free of uneven requests
-    returns the pool to fully-free — no page leaked, none duplicated."""
-    al = kv_cache.PageAllocator(8, PS, MPPS)
-    held = {}
+    """200-step fragmentation sweep WITH prefix sharing and COW
+    (ISSUE 12 satellite): interleaved acquire/share/release of uneven
+    requests — where a 'hit' takes extra references on a random live
+    holder's leading pages and a 'COW' acquires a private copy page —
+    returns the pool to fully-free.  At every step: no page is issued
+    twice concurrently, distinct live + free == total (conservation),
+    and the refcount-weighted live count equals the sum of every
+    holder's page list."""
+    total = 8
+    al = kv_cache.PageAllocator(total, PS, MPPS)
+    held = {}                              # uid -> list of page refs
     rng = np.random.RandomState(0)
     uid = 0
     for _ in range(200):
-        if held and (rng.rand() < 0.5 or al.free_pages == 0):
+        r = rng.rand()
+        if held and (r < 0.4 or al.free_pages == 0):
             k = list(held)[rng.randint(len(held))]
-            al.free(held.pop(k))
+            al.release(held.pop(k))        # retire: release EVERY ref
+        elif held and r < 0.6:
+            # prefix hit: share a random holder's leading pages, then
+            # acquire a private tail (suffix + COW boundary copy)
+            src = held[list(held)[rng.randint(len(held))]]
+            n_share = int(rng.randint(1, len(src) + 1))
+            shared = src[:n_share]
+            priv = al.acquire(int(rng.randint(1, 3)))
+            if priv is not None:
+                al.share(shared)
+                held[uid] = list(shared) + priv
+                uid += 1
         else:
-            got = al.alloc(int(rng.randint(1, 4)))
+            got = al.acquire(int(rng.randint(1, 4)))
             if got is not None:
                 held[uid] = got
                 uid += 1
-        live = [p for ids in held.values() for p in ids]
-        assert len(live) == len(set(live))           # no double issue
-        assert len(live) + al.free_pages == 8        # conservation
+        for ids in held.values():          # no double issue WITHIN one
+            assert len(ids) == len(set(ids))
+        live = {p for ids in held.values() for p in ids}
+        assert len(live) == al.live_pages
+        assert al.live_pages + al.free_pages == total   # conservation
+        weighted = sum(len(ids) for ids in held.values())
+        assert al.weighted_live() == weighted
     for ids in held.values():
-        al.free(ids)
-    assert al.free_pages == 8
+        al.release(ids)
+    assert al.free_pages == total
+    assert al.live_pages == 0 and al.weighted_live() == 0
 
 
-def test_allocator_eviction_returns_all_pages_and_rejects_double_free():
+def test_allocator_eviction_returns_all_pages_and_rejects_double_release():
     al = kv_cache.PageAllocator(6, PS, MPPS)
-    ids = al.alloc(3)
-    al.free(ids)                          # retire returns EVERY page
+    ids = al.acquire(3)
+    al.release(ids)                       # retire returns EVERY page
     assert al.free_pages == 6
     with pytest.raises(ValueError, match="not outstanding"):
-        al.free(ids)                      # double free is a bug, loudly
+        al.release(ids)                   # double release, loudly
     with pytest.raises(ValueError, match="not outstanding"):
-        al.free([99])                     # foreign page likewise
+        al.release([99])                  # foreign page likewise
 
 
 def test_allocator_pages_needed_rounds_and_clamps():

@@ -1,0 +1,161 @@
+"""Host-side radix prefix cache (ISSUE 12): page-granular trie
+matching, partial-tail / LCP boundary coverage, refcount pinning, and
+LRU leaf eviction under backpressure."""
+import numpy as np
+import pytest
+
+from apex_tpu.inference.kv_cache import PageAllocator
+from apex_tpu.inference.prefix_cache import PrefixCache
+
+PS = 4
+
+
+def _setup(pages=12, min_hit=None):
+    al = PageAllocator(pages, PS, 8)
+    return al, PrefixCache(al, min_hit_tokens=min_hit)
+
+
+def _toks(*vals):
+    return list(vals)
+
+
+def test_insert_pins_pages_and_match_returns_them_in_order():
+    al, pc = _setup()
+    pages = al.acquire(3)                  # a request's prompt pages
+    prompt = list(range(10))               # 2 full pages + 2-token tail
+    new = pc.insert(prompt, pages)
+    assert new == 3 and pc.pinned_pages == 3
+    # the cache holds its own reference: releasing the request's refs
+    # keeps every cached page live
+    al.release(pages)
+    assert al.live_pages == 3 and al.free_pages == 9
+    c, got = pc.match(prompt)
+    assert c == 10 and got == pages
+
+
+def test_match_walks_longest_prefix_and_reports_partial_lcp():
+    al, pc = _setup()
+    pages = al.acquire(3)
+    pc.insert(list(range(10)), pages)      # [0..9]
+    # full-page walk only: diverges inside page 2
+    c, got = pc.match(list(range(8)) + [99, 98, 97])
+    assert c == 8 and got == pages[:2]
+    # partial tail [8, 9]: lcp 1 against [8, 55] adds sub-page coverage
+    c, got = pc.match(list(range(8)) + [8, 55])
+    assert c == 9 and got == pages[:3]
+    # divergence in the FIRST page with lcp below min_hit_tokens: miss
+    c, got = pc.match([0, 1, 77, 66])
+    assert (c, got) == (0, [])
+
+
+def test_min_hit_tokens_suppresses_subpage_accidental_overlap():
+    al, pc = _setup()                      # min hit = PS
+    pages = al.acquire(2)
+    pc.insert(list(range(PS)), pages[:1])
+    c, got = pc.match([0, 1, 2, 99])       # 3-token overlap < PS
+    assert (c, got) == (0, [])
+    al2, pc2 = _setup(min_hit=1)
+    p2 = al2.acquire(1)
+    pc2.insert(list(range(PS)), p2)
+    c, got = pc2.match([0, 1, 2, 99])
+    assert c == 3 and got == p2
+
+
+def test_insert_dedupes_existing_edges():
+    al, pc = _setup()
+    a = al.acquire(2)
+    assert pc.insert(list(range(8)), a) == 2
+    # identical prompt prefilled again with private pages: no new pins
+    b = al.acquire(2)
+    assert pc.insert(list(range(8)), b) == 0
+    assert pc.pinned_pages == 2
+    c, got = pc.match(list(range(8)))
+    assert got == a                        # the original stays indexed
+    al.release(b)
+
+
+def test_insert_extends_cached_prefix_radix_style():
+    al, pc = _setup()
+    a = al.acquire(1)
+    pc.insert(list(range(4)), a)
+    b = al.acquire(2)                      # same first page + new tail
+    new = pc.insert(list(range(8)) + [42], [a[0]] + b)
+    assert new == 2                        # only the extension pinned
+    c, got = pc.match(list(range(8)) + [42, 7])
+    assert c == 9 and got == [a[0]] + b
+
+
+def test_evict_lru_releases_leaves_first_until_pages_free():
+    al, pc = _setup(pages=6)
+    a = al.acquire(2)
+    pc.insert(list(range(8)), a)           # chain: a0 -> a1 (leaf)
+    b = al.acquire(2)
+    pc.insert([50, 51, 52, 53] + [60, 61, 62, 63], b)
+    al.release(a)
+    al.release(b)                          # only the cache pins now
+    pc.match(list(range(8)))               # touch chain A (fresher)
+    assert al.free_pages == 2
+    freed = pc.evict_lru(1)
+    assert freed >= 1
+    # chain B's leaf went first (least recently matched)
+    c, got = pc.match([50, 51, 52, 53, 60, 61, 62, 63])
+    assert c == 4                          # b1 evicted, b0 kept
+    c, got = pc.match(list(range(8)))
+    assert c == 8                          # chain A untouched
+    # interior pages are never evicted before their subtree
+    freed = pc.evict_lru(10)               # drain everything evictable
+    assert pc.pinned_pages == 0
+    assert al.free_pages == 6
+
+
+def test_evicting_shared_page_does_not_free_it_under_a_live_owner():
+    """The silent-overwrite hazard, cache edition: eviction only drops
+    the cache's OWN reference — a page a live request still maps stays
+    out of the free list until that request releases it."""
+    al, pc = _setup(pages=4)
+    a = al.acquire(1)
+    pc.insert(list(range(4)), a)           # rc(a0) = 2 (request+cache)
+    free_before = al.free_pages
+    freed = pc.evict_lru(1)
+    assert freed == 0                      # released, NOT freed
+    assert pc.pinned_pages == 0
+    assert al.refcount(a[0]) == 1          # the request's ref survives
+    assert al.free_pages == free_before
+    al.release(a)
+    assert al.free_pages == 4
+
+
+def test_matched_pages_pinned_before_eviction_cannot_be_reissued():
+    """Regression (review finding): the scheduler pins matched pages
+    (share) BEFORE eviction/acquire — so even an eviction sweep that
+    drains the whole cache cannot free a matched page into the LIFO
+    free list where the very next acquire would re-issue it as a
+    private page (one physical page mapped twice into one row)."""
+    al, pc = _setup(pages=6)
+    a = al.acquire(3)
+    pc.insert(list(range(10)), a)
+    al.release(a)                          # cache is the sole owner
+    c, matched = pc.match(list(range(10)))
+    assert matched == a
+    al.share(matched)                      # the _reservation pin
+    pc.evict_lru(100)                      # drain everything evictable
+    assert pc.pinned_pages == 0
+    got = al.acquire(al.free_pages)        # whatever actually freed
+    assert not set(got) & set(matched), (got, matched)
+    for p in matched:
+        assert al.refcount(p) == 1         # still the request's
+
+
+def test_insert_validates_page_coverage():
+    al, pc = _setup()
+    with pytest.raises(ValueError, match="cannot back"):
+        pc.insert(list(range(9)), al.acquire(2))
+
+
+def test_clear_releases_everything():
+    al, pc = _setup()
+    a = al.acquire(3)
+    pc.insert(list(range(10)), a)
+    al.release(a)
+    pc.clear()
+    assert pc.pinned_pages == 0 and al.free_pages == 12
