@@ -183,6 +183,42 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "SlotScheduler(tenant_priority=)",
         read_by="apex_tpu/inference/scheduler.py"),
     EnvKnob(
+        name="APEX_TPU_TRACE",
+        default="0",
+        effect="request-trace sampling for serving schedulers: 0 "
+               "(default) off, 1 traces every request, N traces one "
+               "request in N (uid % N == 0) — each sampled request's "
+               "lifecycle lands in the JSONL stream as trace_span "
+               "events (queued/admitted/prefill_chunk/cow_copy/"
+               "first_token/decode/retired) rendered by `report "
+               "--trace <uid>`; host-side only (the tracer never "
+               "enters jitted code), so no value can add a sync or "
+               "recompile; per-telemetry override: ServeTelemetry("
+               "trace=); stamped into infer bench captures as "
+               "infer_trace",
+        read_by="apex_tpu/observability/spans.py"),
+    EnvKnob(
+        name="APEX_TPU_SLO_TTFT_US",
+        default="0",
+        effect="TTFT p99 SLO target in microseconds (0 = off): arms a "
+               "ttft_p99 objective over serve_ttft_seconds — per-wave "
+               "burn-rate/error-budget gauges, slo_violation events "
+               "when a window burns faster than its 1% budget "
+               "(bucket-resolution accounting off the pinned "
+               "histogram; host-side only, can never recompile); "
+               "per-scheduler override: SlotScheduler(slo=); stamped "
+               "into infer bench captures as infer_slo_ttft (µs)",
+        read_by="apex_tpu/observability/slo.py"),
+    EnvKnob(
+        name="APEX_TPU_SLO_DECODE_US",
+        default="0",
+        effect="decode-token p99 SLO target in microseconds (0 = "
+               "off): arms a decode_token_p99 objective over "
+               "serve_decode_token_seconds — same burn-rate/error-"
+               "budget accounting as APEX_TPU_SLO_TTFT_US; stamped "
+               "into infer bench captures as infer_slo_decode (µs)",
+        read_by="apex_tpu/observability/slo.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

@@ -355,10 +355,13 @@ class InferenceEngine:
             args = (cache, self.params, padded, np.int32(slot),
                     np.int32(n))
         # counted AFTER validation: a rejected reservation raised above
-        # and dispatched nothing
+        # and dispatched nothing.  The annotation metadata (slot, the
+        # chunk origin) lets an xprof capture line up each dispatch
+        # with the request tracer's prefill_chunk spans (ISSUE 13).
         self._refresh_dispatch_counters()
         self._prefill_dispatches.inc()
-        with obs.trace_annotation("apex_tpu.inference.prefill"):
+        with obs.trace_annotation("apex_tpu.inference.prefill",
+                                  slot=int(slot), prefill_from=start):
             return self._prefill(*args, self._key, self._next_step())
 
     def cow_page(self, cache, src, dst):
@@ -375,7 +378,8 @@ class InferenceEngine:
                              "this engine runs the dense slot cache")
         self._refresh_dispatch_counters()
         self._cow_dispatches.inc()
-        with obs.trace_annotation("apex_tpu.inference.cow_page"):
+        with obs.trace_annotation("apex_tpu.inference.cow_page",
+                                  src=int(src), dst=int(dst)):
             return self._cow(cache, np.int32(src), np.int32(dst))
 
     def decode(self, cache, last_tokens, active=None):

@@ -54,6 +54,18 @@ lifecycle at host points the loop ALREADY occupies — zero device reads,
 zero recompiles — now including prefix-cache hit rate, shared-page and
 cache-pinned-page gauges, COW copies, prefill chunks, and per-tenant
 admitted/rejected counters.
+
+SLO awareness (ISSUE 13): the same boundaries feed the request tracer
+(``APEX_TPU_TRACE`` — per-request ``trace_span`` waterfalls) and an
+:class:`~apex_tpu.observability.slo.SLOTracker` — one load observation
+per loop pass through the overload detector, one burn-rate/error-budget
+accounting window per ``run()`` wave (``APEX_TPU_SLO_TTFT_US`` /
+``APEX_TPU_SLO_DECODE_US``).  Behind ``shed_on_overload=True`` the
+priority admission consumes the advisory: while overload holds, the
+LOWEST effective-priority queued request is rejected
+(``finish_reasons[uid] == "shed"``, a ``rejected`` terminal span, the
+rejected side of the conservation law) so high-priority tenants keep
+their SLOs through the storm.
 """
 from __future__ import annotations
 
@@ -67,6 +79,7 @@ import numpy as np
 from apex_tpu.inference import kv_cache
 from apex_tpu.inference.prefix_cache import PrefixCache, prefix_cache_enabled
 from apex_tpu.observability import ServeTelemetry
+from apex_tpu.observability.slo import SLOTracker
 
 __all__ = ["Request", "SlotScheduler", "generate",
            "default_prefill_chunk", "tenant_priority_overrides"]
@@ -76,6 +89,8 @@ REASON_EOS = "eos"                    # the request's eos_id was sampled
 REASON_LENGTH = "length"              # max_new_tokens budget exhausted
 REASON_TRUNCATED = "truncated"        # slot capacity (max_seq or page
 #                                       reservation) cut the stream
+REASON_SHED = "shed"                  # rejected while queued by the
+#                                       overload shedding advisory
 
 _PREFILL_CHUNK_ENV = "APEX_TPU_PREFILL_CHUNK"
 _TENANT_PRIORITY_ENV = "APEX_TPU_TENANT_PRIORITY"
@@ -191,7 +206,9 @@ class SlotScheduler:
                  *, prefix_cache: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
                  tenant_priority: Optional[Dict[str, int]] = None,
-                 max_chunks_per_pass: int = 1):
+                 max_chunks_per_pass: int = 1,
+                 slo: Optional[SLOTracker] = None,
+                 shed_on_overload: bool = False):
         self.engine = engine
         self.queue: collections.deque = collections.deque()
         self._next_uid = 0
@@ -223,6 +240,17 @@ class SlotScheduler:
                                 if tenant_priority is None
                                 else dict(tenant_priority))
         self.max_chunks_per_pass = max(1, int(max_chunks_per_pass))
+        # SLO accounting (ISSUE 13): the tracker shares the telemetry's
+        # registry so its burn-rate math reads the SAME histograms the
+        # lifecycle methods feed; specs default from the
+        # APEX_TPU_SLO_*_US knobs (none armed = the tracker only runs
+        # the overload detector).  shed_on_overload lets the priority
+        # admission consume the advisory: while it holds, the LOWEST
+        # effective-priority queued request is rejected (reason "shed")
+        # once per pass instead of starving every tenant equally.
+        self.slo = (slo if slo is not None
+                    else SLOTracker(self.telemetry.registry))
+        self.shed_on_overload = bool(shed_on_overload)
         self._admit_clock = 0
         self._tenant_last_admit: Dict[str, int] = {}
         # the scheduler OWNS one cache for its lifetime (lazily built):
@@ -274,18 +302,36 @@ class SlotScheduler:
         return uid
 
     # -- admission ----------------------------------------------------------
-    def _pick_index(self) -> int:
+    def _pick_index(self, worst: bool = False) -> int:
         """Queue index of the next request to admit: highest effective
         priority (request priority + tenant override); ties go to the
         LEAST recently admitted tenant (round-robin fairness under
-        overload), then FIFO."""
+        overload), then FIFO.  ``worst=True`` inverts the ordering —
+        the shed victim: LOWEST effective priority, most recently
+        admitted tenant, newest submission."""
         best_key, best_i = None, 0
         for i, req in enumerate(self.queue):
             pr = req.priority + self.tenant_priority.get(req.tenant, 0)
             key = (-pr, self._tenant_last_admit.get(req.tenant, -1), i)
-            if best_key is None or key < best_key:
+            better = (best_key is None
+                      or (key > best_key if worst else key < best_key))
+            if better:
                 best_key, best_i = key, i
         return best_i
+
+    def _shed_one(self) -> int:
+        """Reject the worst-ranked queued request under the overload
+        advisory (ISSUE 13): it leaves the queue with
+        ``finish_reasons[uid] == "shed"`` (no results entry), its trace
+        closes with a ``rejected`` terminal span, and the shed/rejected
+        counters keep the conservation law intact."""
+        i = self._pick_index(worst=True)
+        req = self.queue[i]
+        del self.queue[i]
+        self.finish_reasons[req.uid] = REASON_SHED
+        self.telemetry.request_shed(req.uid, tenant=req.tenant,
+                                    queue_depth=len(self.queue))
+        return req.uid
 
     def _reservation(self, req: Request):
         """Page plan for one request, or None (backpressure).
@@ -354,6 +400,7 @@ class SlotScheduler:
         """
         eng = self.engine
         tel = self.telemetry
+        tel.begin_wave()
         if cache is None:
             if self.cache is None:
                 self.cache = eng.init_cache()
@@ -425,7 +472,8 @@ class SlotScheduler:
                    else min(total, start + self.prefill_chunk))
             with tel.prefill_step(
                     prompt_len=end - start,
-                    bucket_len=eng.bucket_for(end - start)):
+                    bucket_len=eng.bucket_for(end - start),
+                    uid=st.uid, start_tok=start):
                 cache, tok, _ = eng.prefill(
                     cache, st.prompt[:end], slot, pages=st.pages,
                     prefill_from=start)
@@ -490,6 +538,18 @@ class SlotScheduler:
             return True
 
         while self.queue or any(s is not None for s in slots):
+            # SLO load observation (ISSUE 13): one host-side sample per
+            # pass through the overload detector; while the advisory
+            # holds and shedding is armed, the worst-ranked queued
+            # request is rejected (at most one per pass — shedding
+            # relieves pressure, it does not empty the queue)
+            advisory = self.slo.observe_load(
+                queue_depth=len(self.queue),
+                backpressure_total=tel.backpressure_waits.total(),
+                free_pages=(self.alloc.free_pages
+                            if self.alloc is not None else None))
+            if advisory and self.shed_on_overload and self.queue:
+                self._shed_one()
             # admit: fill free slots from the queue (priority/fairness
             # ordered — a picked request the pool can't cover yet
             # blocks this pass rather than being starved)
@@ -576,9 +636,13 @@ class SlotScheduler:
         # the (donation-threaded) cache carries into the next wave —
         # cached prefix pages stay valid across run() calls
         self.cache = cache
-        # wave boundary: flush snapshot sinks (the Prometheus file is
-        # only written on export — without this, APEX_TPU_TELEMETRY
-        # would produce the JSONL stream but never metrics.prom)
+        # wave boundary: close one SLO accounting window (burn rate /
+        # budget gauges + slo_violation events off the histogram deltas
+        # this wave contributed), then flush snapshot sinks (the
+        # Prometheus file is only written on export — without this,
+        # APEX_TPU_TELEMETRY would produce the JSONL stream but never
+        # metrics.prom)
+        self.slo.observe_window()
         tel.registry.export()
         return results
 

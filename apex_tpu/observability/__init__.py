@@ -17,6 +17,21 @@ code (device scalars resolve one step late via the deferred collector).
     serve      ServeTelemetry (SlotScheduler lifecycle: TTFT, decode
                latency, queue depth, finish reasons, page-pool gauges,
                token-goodput decomposition)
+    spans      request-scoped tracing (ISSUE 13): every sampled request
+               (``APEX_TPU_TRACE``) gets a trace of ``trace_span``
+               JSONL events — queued/admitted/prefill_chunk/cow_copy/
+               first_token/decode/retired — rebuilt as a waterfall by
+               ``report --trace <uid>``
+    slo        declarative SLOs (ISSUE 13): windowed error-budget +
+               burn-rate accounting off the pinned histograms
+               (``APEX_TPU_SLO_TTFT_US``/``APEX_TPU_SLO_DECODE_US``),
+               per-tenant goodput floors, and the overload detector
+               whose shedding advisory the scheduler consumes
+    watch      perf-regression watch (ISSUE 13): ``python -m apex_tpu.
+               observability.watch bench_captures/`` ratchets committed
+               capture history — per-leg trend deltas vs the best prior
+               capture at the same shape/knobs, nonzero exit on
+               regressions beyond the slack factor
     train      TrainTelemetry (step time, tokens/s, overflow skips,
                loss-scale gauge, exposed-comm residual, MFU gauge,
                badput decomposition)
@@ -44,6 +59,11 @@ Knobs (registered in ``analysis/env_registry.py``):
   ``instrumented_train_loop`` when ``numerics=`` is not passed;
   ``APEX_TPU_NUMERICS_EVERY=N`` samples the probes every N steps
   (host-side only — the compiled step is identical at every value).
+* ``APEX_TPU_TRACE=N`` samples request traces (0=off, 1=all, N=1-in-N)
+  for every :class:`ServeTelemetry` that doesn't pass ``trace=``;
+  ``APEX_TPU_SLO_TTFT_US``/``APEX_TPU_SLO_DECODE_US`` arm p99 latency
+  objectives for every scheduler that doesn't pass ``slo=`` (all
+  host-side — none can add a sync or a recompile).
 """
 from __future__ import annotations
 
@@ -62,6 +82,10 @@ from apex_tpu.observability.numerics import (NumericsAccountant,
 from apex_tpu.observability.serve import ServeTelemetry
 from apex_tpu.observability.sinks import (JsonlSink, PrometheusSink,
                                           render_prometheus)
+from apex_tpu.observability.slo import (OverloadDetector, SLOSpec,
+                                        SLOTracker, slo_specs_from_env)
+from apex_tpu.observability.spans import (RequestTracer,
+                                          default_trace_sample)
 from apex_tpu.observability.timers import StepSample, StepTimer, \
     compile_count
 from apex_tpu.observability.tracing import (named_scope, profile_capture,
@@ -85,6 +109,8 @@ __all__ = [
     "trace_annotation", "named_scope", "profile_capture", "profile_dir",
     "start_profile", "stop_profile",
     "ServeTelemetry", "TrainTelemetry",
+    "RequestTracer", "default_trace_sample",
+    "SLOSpec", "SLOTracker", "OverloadDetector", "slo_specs_from_env",
     "NumericsProbes", "NumericsAccountant", "compute_probes",
     "flat_leaf_names",
     "telemetry_enabled", "configure_from_env",

@@ -1,0 +1,112 @@
+"""Capture hygiene: the physical-plausibility scrub for bench capture
+payloads, shared by the bench orchestrator (``bench.py`` republishing
+recorded history) and the perf-regression watch
+(:mod:`apex_tpu.observability.watch` trending committed captures).
+
+Extracted from ``bench.py`` (ISSUE 13) so package code can scrub
+without importing the repo-root bench script: one copy of the rules,
+two consumers — the no-second-copy discipline the chip-spec table
+already follows.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["MAX_PLAUSIBLE_SPEEDUP", "MAX_PLAUSIBLE_TOKENS_PER_S",
+           "MAX_PLAUSIBLE_LATENCY_US", "is_us_key", "is_tokens_per_s_key",
+           "hbm_capacity_bound", "scrub_capture_values"]
+
+#: capture-hygiene bounds: a measured duration of exactly 0.0 µs means
+#: the whole timing loop collapsed inside the tunnel's RTT jitter (r5:
+#: flash_attn_us 0.0, moe us_gather 0.0), and a kernel "speedup" beyond
+#: 100x over an XLA baseline on the same chip is not physics either
+#: (r5: flash_attn_speedup 89198634.0 — the ratio of a real baseline to
+#: a collapsed ~0 measurement).  Such values are measurement artifacts
+#: and must never be republished by the capture-history loader.
+MAX_PLAUSIBLE_SPEEDUP = 100.0
+
+#: throughput sanity ceiling for ``*tokens_per_s`` capture fields.  The
+#: same RTT-collapse that produced ``flash_attn_us: 0.0`` turns a
+#: throughput field into tokens/(~0 s): a v5e streaming a transformer
+#: at > 1e8 tokens/s is not physics (the flagship GPT measures ~1.1e5;
+#: even the cheap MoE layer pass peaks ~2.3e6).  0 and negatives are
+#: the us==0.0 artifact's other face (tokens / garbage-negative time).
+MAX_PLAUSIBLE_TOKENS_PER_S = 1e8
+
+#: latency sanity ceiling for ``*_us`` capture fields (ISSUE 8: the
+#: telemetry TTFT / per-token decode latencies now ride in captures).
+#: One HOUR for a single step/request latency is not physics — it is a
+#: stuck tunnel, a wedged profiler, or a unit bug (seconds stamped into
+#: a ``_us`` field would read ~1e6x small, its inverse ~1e6x large);
+#: negatives are clock-skew garbage, 0.0 the RTT-collapse artifact.
+MAX_PLAUSIBLE_LATENCY_US = 3.6e9
+
+
+def is_us_key(key: str) -> bool:
+    return key == "us" or key.endswith("_us") or key.startswith("us_")
+
+
+def is_tokens_per_s_key(key: str) -> bool:
+    return key == "tokens_per_s" or key.endswith("_tokens_per_s")
+
+
+def hbm_capacity_bound(obj: dict) -> int:
+    """Physical ceiling for a ``compiled_peak_hbm_bytes`` field: the
+    capture's own chip's HBM when the ``chip`` stamp matches the spec
+    table, else the LARGEST capacity in the table (the permissive bound
+    — an unknown chip must not scrub a valid value)."""
+    from apex_tpu.chip_specs import CHIP_SPECS, match_spec
+    spec = match_spec(str(obj.get("chip", "")))
+    if spec is not None:
+        return spec.hbm_bytes
+    return max(s.hbm_bytes for s in CHIP_SPECS.values())
+
+
+def scrub_capture_values(obj):
+    """Drop physically impossible values from a capture payload
+    (recursively): NaN/Inf in ANY numeric field (NaN passes every
+    range comparison below as False, so without this gate a poisoned
+    measurement sails through checks written as rejections — ISSUE 11
+    satellite), ``*_us``/``us_*`` latency fields that are
+    non-positive (0.0 = the RTT-collapse artifact, negatives =
+    clock-skew garbage) or beyond :data:`MAX_PLAUSIBLE_LATENCY_US`
+    (covers the telemetry TTFT / decode-latency fields),
+    ``*_speedup`` fields above :data:`MAX_PLAUSIBLE_SPEEDUP`,
+    ``*tokens_per_s`` throughputs that are non-positive or beyond
+    :data:`MAX_PLAUSIBLE_TOKENS_PER_S`, and the ISSUE-10
+    compiled-truth stamps — ``compiled_flops`` must be positive and
+    ``compiled_peak_hbm_bytes`` must be positive and fit the chip's
+    HBM (the ``chip`` field in the same dict selects the bound).
+    Returns a scrubbed copy; containers are preserved, only the
+    corrupt scalar fields vanish."""
+    if isinstance(obj, dict):
+        out = {}
+        hbm_bound = None
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)):
+                out[k] = scrub_capture_values(v)
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                if not math.isfinite(v):
+                    continue
+                if is_us_key(k) and \
+                        not 0.0 < v <= MAX_PLAUSIBLE_LATENCY_US:
+                    continue
+                if (k == "speedup" or k.endswith("_speedup")) \
+                        and v > MAX_PLAUSIBLE_SPEEDUP:
+                    continue
+                if is_tokens_per_s_key(k) \
+                        and not 0.0 < v <= MAX_PLAUSIBLE_TOKENS_PER_S:
+                    continue
+                if k == "compiled_flops" and v <= 0:
+                    continue
+                if k == "compiled_peak_hbm_bytes":
+                    if hbm_bound is None:
+                        hbm_bound = hbm_capacity_bound(obj)
+                    if not 0 < v <= hbm_bound:
+                        continue
+            out[k] = v
+        return out
+    if isinstance(obj, list):
+        return [scrub_capture_values(v) for v in obj]
+    return obj

@@ -13,8 +13,15 @@ artifacts the stack already writes —
 
 — into one markdown (or ``--json``) run report: step-time percentiles,
 MFU, the badput decomposition, exposed-comm residual, TTFT/decode
-percentiles, finish reasons, serve goodput, recompiles, and the
-estimate-vs-compiled attribution table.
+percentiles, finish reasons, serve goodput, recompiles, the SLO
+accounting (per-objective burn rate, error budget remaining,
+violations, overload/shed tallies and violating tenants — ISSUE 13),
+and the estimate-vs-compiled attribution table.
+
+``--trace <uid>`` switches to the per-request waterfall (ISSUE 13):
+the request's ``trace_span`` events — queued, admitted, prefill
+chunks, COW copies, first token, decode, terminal — rendered as one
+table per (uid, wave) trace with a proportional timeline bar.
 
 Everything is a pure function of the input files — no clocks, no
 device, no environment — so the committed fixture's report reproduces
@@ -32,7 +39,8 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["parse_prometheus", "percentile", "histogram_quantile",
-           "build_report", "render_markdown", "main"]
+           "build_report", "render_markdown", "build_traces",
+           "render_traces_markdown", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -342,6 +350,62 @@ def _serve_section(events: list, families: dict) -> Optional[dict]:
     return out
 
 
+def _slo_section(events: list, families: dict) -> Optional[dict]:
+    """The ISSUE 13 SLO leg: per-objective burn rate / budget
+    remaining / violations off the ``slo_*`` families, tenant
+    goodput, overload + shed tallies, and the violating tenants named
+    by ``slo_violation`` events.  Returns None when the run carried no
+    SLO signal at all — a pre-PR-13 run dir renders byte-identically
+    (the back-compat golden pins it)."""
+    viols = [e for e in events if e.get("kind") == "slo_violation"]
+    overloads = [e for e in events if e.get("kind") == "overload"]
+    sheds = [e for e in events if e.get("kind") == "request_shed"]
+    has_fams = any(f in families for f in
+                   ("slo_burn_rate", "slo_error_budget_remaining",
+                    "slo_violations_total", "slo_tenant_goodput",
+                    "serve_overload", "serve_requests_shed_total"))
+    if not (viols or overloads or sheds or has_fams):
+        return None
+    out: dict = {}
+    burn = _family_by_label(families, "slo_burn_rate", "slo")
+    remaining = _family_by_label(families,
+                                 "slo_error_budget_remaining", "slo")
+    counted = _family_by_label(families, "slo_violations_total", "slo")
+    slos = {}
+    for name in sorted(set(burn) | set(remaining) | set(counted)):
+        slos[name] = {"burn_rate": burn.get(name),
+                      "budget_remaining": remaining.get(name),
+                      "violations": counted.get(name, 0.0)}
+    if slos:
+        out["slos"] = slos
+    goodput = _family_by_label(families, "slo_tenant_goodput", "tenant")
+    if goodput:
+        out["tenant_goodput"] = dict(sorted(goodput.items()))
+    shed_by_tenant = _family_by_label(families,
+                                      "serve_requests_shed_total",
+                                      "tenant")
+    shed_total = sum(shed_by_tenant.values()) if shed_by_tenant \
+        else float(len(sheds)) if sheds else None
+    if shed_total:
+        out["shed_requests"] = shed_total
+        if shed_by_tenant:
+            out["shed_by_tenant"] = dict(sorted(shed_by_tenant.items()))
+    overload_now = _family_total(families, "serve_overload")
+    if overload_now is not None:
+        out["overloaded"] = bool(overload_now)
+    if overloads:
+        out["overload_events"] = len(overloads)
+    if viols:
+        out["violation_events"] = len(viols)
+        tenants = sorted({str(e["slo"]).split(":", 1)[1]
+                          for e in viols
+                          if str(e.get("slo", "")).startswith(
+                              "tenant_goodput:")})
+        if tenants:
+            out["violating_tenants"] = tenants
+    return out
+
+
 def _attribution_section(stats: Optional[dict],
                          budget: Optional[dict]) -> Optional[dict]:
     """Estimate-vs-compiled table: one row per executable, merged from
@@ -423,9 +487,79 @@ def build_report(events: list, prom_text: str,
         "train": _train_section(events, families),
         "numerics": _numerics_section(events, families),
         "serve": _serve_section(events, families),
+        "slo": _slo_section(events, families),
         "compiled_attribution": _attribution_section(stats, budget),
     }
     return {k: v for k, v in out.items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# per-request waterfall (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def build_traces(events: list, uid: int) -> List[dict]:
+    """All traces for ``uid`` (one per wave — uids are unique within a
+    scheduler, so distinct waves mean distinct schedulers sharing one
+    sink), each as ``{"uid", "wave", "spans", "extent_s"}`` with spans
+    in seq order."""
+    spans = [e for e in events if e.get("kind") == "trace_span"
+             and e.get("uid") == uid]
+    traces = []
+    for wave in sorted({e.get("wave", 0) for e in spans}):
+        evs = sorted((e for e in spans if e.get("wave", 0) == wave),
+                     key=lambda e: e.get("seq", 0))
+        extent = max((e.get("start_s", 0.0) + (e.get("dur_s") or 0.0)
+                      for e in evs), default=0.0)
+        traces.append({
+            "uid": uid, "wave": wave, "extent_s": extent,
+            "spans": [{"seq": e.get("seq"), "span": e.get("span"),
+                       "start_s": e.get("start_s"),
+                       "dur_s": e.get("dur_s"),
+                       "detail": e.get("detail")} for e in evs],
+        })
+    return traces
+
+
+_BAR_WIDTH = 24
+
+
+def _bar(start: float, dur: Optional[float], extent: float) -> str:
+    """Proportional timeline cell: ``#`` fills a duration span, ``|``
+    marks a point span, ``.`` pads — deterministic, so the golden
+    fixture pins the bytes."""
+    if extent <= 0:
+        return "." * _BAR_WIDTH
+    cells = list("." * _BAR_WIDTH)
+    lo = min(int(start / extent * _BAR_WIDTH), _BAR_WIDTH - 1)
+    if dur is None:
+        cells[lo] = "|"
+    else:
+        hi = min(int(math.ceil((start + dur) / extent * _BAR_WIDTH)),
+                 _BAR_WIDTH)
+        for i in range(lo, max(hi, lo + 1)):
+            cells[i] = "#"
+    return "".join(cells)
+
+
+def render_traces_markdown(traces: List[dict]) -> str:
+    if not traces:
+        return "no trace_span events for this uid\n"
+    uid = traces[0]["uid"]
+    lines = [f"# apex_tpu request trace — uid {uid}", ""]
+    for tr in traces:
+        lines += [f"## wave {_f(tr['wave'])} "
+                  f"(extent {_f(tr['extent_s'])} s)", "",
+                  "| seq | span | start_s | dur_s | timeline | detail |",
+                  "|---|---|---|---|---|---|"]
+        for s in tr["spans"]:
+            bar = _bar(s.get("start_s") or 0.0, s.get("dur_s"),
+                       tr["extent_s"])
+            lines.append(
+                f"| {_f(s.get('seq'))} | {s.get('span')} "
+                f"| {_f(s.get('start_s'))} | {_f(s.get('dur_s'))} "
+                f"| `{bar}` | {s.get('detail') or '—'} |")
+        lines.append("")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -571,6 +705,38 @@ def render_markdown(report: dict) -> str:
                 f"{k}={_f(v)}" for k, v in sorted(tn.items())))
         lines.append("")
 
+    slo = report.get("slo")
+    if slo:
+        lines += ["## SLO", ""]
+        if "overloaded" in slo:
+            lines.append(f"- **overloaded**: {slo['overloaded']}")
+        lines += _kv_lines(slo, (
+            "overload_events", "violation_events", "shed_requests"))
+        vt = slo.get("violating_tenants")
+        if vt:
+            lines.append(f"- **violating_tenants**: {', '.join(vt)}")
+        slos = slo.get("slos")
+        if slos:
+            lines += ["",
+                      "| slo | burn rate | budget remaining "
+                      "| violations |", "|---|---|---|---|"]
+            for name in sorted(slos):
+                r = slos[name]
+                lines.append(
+                    f"| {name} | {_f(r.get('burn_rate'))} "
+                    f"| {_f(r.get('budget_remaining'))} "
+                    f"| {_f(r.get('violations'))} |")
+        tg = slo.get("tenant_goodput")
+        if tg:
+            lines.append("")
+            lines.append("- **tenant_goodput**: " + ", ".join(
+                f"{k}={_f(v)}" for k, v in sorted(tg.items())))
+        sb = slo.get("shed_by_tenant")
+        if sb:
+            lines.append("- **shed_by_tenant**: " + ", ".join(
+                f"{k}={_f(v)}" for k, v in sorted(sb.items())))
+        lines.append("")
+
     attr = report.get("compiled_attribution")
     if attr:
         lines += ["## Compiled truth vs analytic estimates", "",
@@ -625,6 +791,10 @@ def main(argv=None) -> int:
                    help=".analysis_budget.json for the comm-model "
                         "estimates + committed compiled blocks "
                         "(optional)")
+    p.add_argument("--trace", type=int, default=None, metavar="UID",
+                   help="render the per-request waterfall for this "
+                        "uid's trace_span events instead of the run "
+                        "report")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the report as JSON instead of markdown")
     p.add_argument("--out", default=None,
@@ -666,12 +836,26 @@ def main(argv=None) -> int:
         with open(prom_path, encoding="utf-8") as fh:
             prom_text = fh.read()
 
-    report = build_report(events, prom_text,
-                          stats=_load_json(args.stats),
-                          budget=_load_json(args.budget))
-    if args.as_json:
+    if args.trace is not None:
+        traces = build_traces(events, args.trace)
+        if not traces:
+            print(f"report: no trace_span events for uid {args.trace} "
+                  f"(is APEX_TPU_TRACE sampling this uid?)",
+                  file=sys.stderr)
+            return 1
+        if args.as_json:
+            text = json.dumps(traces, indent=1, sort_keys=True) + "\n"
+        else:
+            text = render_traces_markdown(traces)
+    elif args.as_json:
+        report = build_report(events, prom_text,
+                              stats=_load_json(args.stats),
+                              budget=_load_json(args.budget))
         text = json.dumps(report, indent=1, sort_keys=True) + "\n"
     else:
+        report = build_report(events, prom_text,
+                              stats=_load_json(args.stats),
+                              budget=_load_json(args.budget))
         text = render_markdown(report)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:

@@ -153,6 +153,34 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("serve_tenant_rejected_total", "counter",
                "submissions rejected at validation, keyed by tenant",
                labels=("tenant",)),
+    # -- request tracing + SLO accounting (ISSUE 13) ----------------------
+    MetricSpec("serve_trace_spans_total", "counter",
+               "trace_span events emitted by the request tracer "
+               "(APEX_TPU_TRACE-sampled request lifecycles)"),
+    MetricSpec("serve_requests_shed_total", "counter",
+               "queued requests rejected by the overload shedding "
+               "advisory (lowest effective priority first), keyed by "
+               "tenant", labels=("tenant",)),
+    MetricSpec("serve_overload", "gauge",
+               "overload advisory (0/1): sustained queue pressure or "
+               "backpressure with no free-page recovery over the "
+               "detector window"),
+    MetricSpec("slo_burn_rate", "gauge",
+               "per-window error-budget burn rate, keyed by SLO: "
+               "window violation fraction / error budget (1.0 = "
+               "consuming budget exactly at the sustainable rate)",
+               labels=("slo",)),
+    MetricSpec("slo_error_budget_remaining", "gauge",
+               "cumulative error budget remaining, keyed by SLO: "
+               "1 - violations/(budget * samples), floored at 0",
+               labels=("slo",)),
+    MetricSpec("slo_violations_total", "counter",
+               "samples over their SLO threshold (bucket resolution), "
+               "keyed by SLO", labels=("slo",)),
+    MetricSpec("slo_tenant_goodput", "gauge",
+               "per-tenant admission goodput: admitted / (admitted + "
+               "validation rejects + sheds), 0..1",
+               labels=("tenant",)),
     # -- engine dispatch (host wrappers around the donated executables) ---
     MetricSpec("infer_prefill_dispatch_total", "counter",
                "InferenceEngine.prefill dispatches"),
@@ -256,6 +284,27 @@ EVENT_FIELDS: Dict[str, Dict[str, str]] = {
     "request_first_token": {"uid": "int", "ttft_s": "float"},
     "request_finish": {"uid": "int", "reason": "str", "tokens": "int",
                        "e2e_s": "float"},
+    # overload shedding (ISSUE 13): a QUEUED request rejected by the
+    # shedding advisory (validation rejects raise at submit and never
+    # reach the stream)
+    "request_shed": {"uid": "int", "tenant": "str",
+                     "queue_depth": "int"},
+    # request tracing (ISSUE 13): one event per closed span of a
+    # sampled request's trace; offsets are seconds from submit.
+    "trace_span": {"uid": "int", "wave": "int", "span": "str",
+                   "seq": "int", "start_s": "float",
+                   "dur_s": "float|null", "detail": "str|null"},
+    # SLO accounting (ISSUE 13): a window that burned error budget
+    # faster than sustainable (burn_rate > 1), or a tenant under its
+    # goodput floor (slo="tenant_goodput:<tenant>", burn_rate null,
+    # fraction = the goodput, threshold = the floor).
+    "slo_violation": {"slo": "str", "window": "int", "samples": "int",
+                      "violations": "int", "fraction": "float",
+                      "burn_rate": "float|null", "threshold": "float"},
+    # overload-advisory flips from the load-trend detector
+    "overload": {"overloaded": "bool", "queue_depth": "int",
+                 "backpressure_waits": "float",
+                 "free_pages": "int|null"},
     "train_step": {"step": "int", "seconds": "float|null",
                    "recompiled": "bool"},
     "train_numerics": {"step": "int", "grad_norm": "float|null",

@@ -10,7 +10,12 @@ lifecycle the scheduler already walks —
 — yielding the PAPERS.md Gemma-serving signals: TTFT and per-token
 decode-latency histograms, queue depth, admitted/backpressured counters,
 finish-reason counts, and the page-pool free/occupancy gauges the PR 6
-scheduler computed internally but never exported.
+scheduler computed internally but never exported.  Since ISSUE 13 the
+same boundaries also drive the request tracer
+(:class:`~apex_tpu.observability.spans.RequestTracer`, armed by
+``APEX_TPU_TRACE``): every sampled request's lifecycle lands in the
+JSONL stream as ``trace_span`` events the flight recorder renders as a
+per-request waterfall.
 
 Sync discipline: every timestamp is taken at a host point the scheduler
 ALREADY occupies (it reads sampled tokens between steps by
@@ -26,6 +31,7 @@ import time
 from typing import Optional
 
 from apex_tpu.observability.registry import MetricsRegistry
+from apex_tpu.observability.spans import RequestTracer
 from apex_tpu.observability.timers import StepTimer
 
 __all__ = ["ServeTelemetry"]
@@ -33,7 +39,8 @@ __all__ = ["ServeTelemetry"]
 
 class ServeTelemetry:
 
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
+    def __init__(self, registry: Optional[MetricsRegistry] = None, *,
+                 trace: Optional[int] = None):
         if registry is None:
             # default = the global registry with env-selected sinks
             # attached (lazy import: this module is part of the package)
@@ -79,6 +86,11 @@ class ServeTelemetry:
         self.prefill_chunks = d("serve_prefill_chunks_total")
         self.tenant_admitted = d("serve_tenant_admitted_total")
         self.tenant_rejected = d("serve_tenant_rejected_total")
+        self.shed = d("serve_requests_shed_total")
+        # request tracing (ISSUE 13): spans ride the SAME host
+        # boundaries the methods below already occupy — arming the
+        # tracer (trace= or APEX_TPU_TRACE) adds zero device work
+        self.tracer = RequestTracer(reg, sample=trace)
         # separate timers: prefill legitimately compiles once per prompt
         # bucket, and must not advance the decode timer past its warmup
         # step (which would mislabel decode's one compile a recompile)
@@ -88,11 +100,17 @@ class ServeTelemetry:
         self._first_token_seen: set = set()
 
     # -- lifecycle ----------------------------------------------------------
+    def begin_wave(self) -> None:
+        """A scheduler ``run()`` started (trace spans admitted from
+        here carry the new wave index)."""
+        self.tracer.begin_wave()
+
     def request_submitted(self, uid: int, prompt_len: int,
                           max_new_tokens: int, queue_depth: int) -> None:
         self.submitted.inc()
         self.queue_depth.set(queue_depth)
         self._submit_ts[uid] = time.perf_counter()
+        self.tracer.request_submitted(uid, self._submit_ts[uid])
         self.registry.emit_event(
             "request_submit", uid=int(uid), prompt_len=int(prompt_len),
             max_new_tokens=int(max_new_tokens),
@@ -106,6 +124,25 @@ class ServeTelemetry:
         self.rejected.inc(reason=reason)
         self.tenant_rejected.inc(tenant=str(tenant))
 
+    def request_shed(self, uid: int, tenant: str = "default",
+                     queue_depth: Optional[int] = None) -> None:
+        """A QUEUED request rejected by the overload shedding advisory
+        (ISSUE 13).  Rides the ``rejected`` side of the conservation
+        law — it was already counted submitted at submit() — and closes
+        the request's trace with a ``rejected`` terminal span so no
+        trace dangles."""
+        self.rejected.inc(reason="shed")
+        self.shed.inc(tenant=str(tenant))
+        if queue_depth is not None:
+            self.queue_depth.set(queue_depth)
+        self._submit_ts.pop(uid, None)
+        self._first_token_seen.discard(uid)
+        self.tracer.request_rejected(uid, "shed")
+        self.registry.emit_event(
+            "request_shed", uid=int(uid), tenant=str(tenant),
+            queue_depth=int(queue_depth) if queue_depth is not None
+            else -1)
+
     def request_admitted(self, uid: int, slot: int, queue_depth: int,
                          pages: Optional[int] = None,
                          tenant: str = "default",
@@ -115,6 +152,8 @@ class ServeTelemetry:
         self.queue_depth.set(queue_depth)
         wait = time.perf_counter() - self._submit_ts.get(
             uid, time.perf_counter())
+        self.tracer.request_admitted(uid, slot, pages=pages,
+                                     prefix_tokens=prefix_tokens)
         self.registry.emit_event(
             "request_admit", uid=int(uid), slot=int(slot),
             wait_s=round(wait, 9),
@@ -151,6 +190,7 @@ class ServeTelemetry:
         """One copy-on-write page duplication (a slot privatized a
         shared page before writing into it)."""
         self.cow_copies.inc()
+        self.tracer.cow_copy(uid, src, dst)
         self.registry.emit_event("cow_copy", uid=int(uid),
                                  slot=int(slot), src=int(src),
                                  dst=int(dst))
@@ -163,21 +203,31 @@ class ServeTelemetry:
 
     @contextlib.contextmanager
     def prefill_step(self, prompt_len: Optional[int] = None,
-                     bucket_len: Optional[int] = None):
+                     bucket_len: Optional[int] = None,
+                     uid: Optional[int] = None, start_tok: int = 0):
         """Bracket one admission's prefill dispatch + first-token read.
 
         ``prompt_len``/``bucket_len`` (when the scheduler knows them)
         feed the padding-badput counter: the bucket positions beyond
         the prompt are compute the fixed-shape executable spends on
-        padding rows."""
+        padding rows.  ``uid``/``start_tok`` (when the scheduler passes
+        them) close a ``prefill_chunk`` span on the request's trace —
+        one span per dispatched piece, monolithic prefill included."""
+        t_begin = time.perf_counter()
         self._prefill_timer.start()
         try:
             yield
         finally:
-            self.prefill_seconds.observe(self._prefill_timer.stop().seconds)
+            sample = self._prefill_timer.stop()
+            self.prefill_seconds.observe(sample.seconds)
             if prompt_len is not None and bucket_len is not None \
                     and bucket_len > prompt_len:
                 self.prefill_pad_tokens.inc(bucket_len - prompt_len)
+            if uid is not None:
+                self.tracer.prefill_chunk(
+                    uid, t_begin, sample.seconds, start_tok,
+                    prompt_len if prompt_len is not None else 0,
+                    bucket=bucket_len)
 
     def first_token(self, uid: int) -> None:
         """The request's first token reached the host: observe TTFT."""
@@ -189,6 +239,7 @@ class ServeTelemetry:
             return
         ttft = time.perf_counter() - t0
         self.ttft.observe(ttft)
+        self.tracer.first_token(uid, ttft)
         self.registry.emit_event("request_first_token", uid=int(uid),
                                  ttft_s=round(ttft, 9))
 
@@ -223,6 +274,7 @@ class ServeTelemetry:
             self.truncated_tokens.inc(n_tokens)
         t0 = self._submit_ts.pop(uid, None)
         self._first_token_seen.discard(uid)
+        self.tracer.request_finished(uid, reason, n_tokens)
         e2e = (time.perf_counter() - t0) if t0 is not None else 0.0
         self.registry.emit_event(
             "request_finish", uid=int(uid), reason=str(reason),
@@ -281,6 +333,10 @@ class ServeTelemetry:
             out["cow_copies"] = int(self.cow_copies.total())
         if self.prefill_chunks.total():
             out["prefill_chunks"] = int(self.prefill_chunks.total())
+        if self.tracer.enabled():
+            out["trace_spans"] = int(self.tracer.spans.total())
+        if self.shed.total():
+            out["shed"] = int(self.shed.total())
         for name, hist in (("ttft", self.ttft),
                            ("decode_token", self.decode_token_seconds)):
             if hist.count():

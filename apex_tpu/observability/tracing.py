@@ -26,10 +26,13 @@ __all__ = ["trace_annotation", "named_scope", "profile_dir",
 _ENV_PROFILE_DIR = "APEX_TPU_PROFILE_DIR"
 
 
-def trace_annotation(name: str):
+def trace_annotation(name: str, **metadata):
     """Context manager marking a host-side region in profiler traces
-    (analog of ``torch.cuda.nvtx.range``)."""
-    return jax.profiler.TraceAnnotation(name)
+    (analog of ``torch.cuda.nvtx.range``).  ``metadata`` key/values
+    ride the TraceMe into xprof (ISSUE 13: the engine stamps
+    ``slot``/``prefill_from`` onto prefill dispatches so device traces
+    correlate with the request tracer's ``trace_span`` waterfalls)."""
+    return jax.profiler.TraceAnnotation(name, **metadata)
 
 
 def named_scope(name: str):

@@ -967,6 +967,19 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     out["infer_serve_decode_token_us"] = round(
         s["decode_token_mean_s"] * 1e6, 1)
 
+    # tracing/SLO knob stamps (ISSUE 13): captures self-describe the
+    # effective sampling + targets (same contract as page_size); the
+    # SLO stamps are µs targets, NOT measurements — named without the
+    # `_us` suffix so the capture scrubber/watch never mistake a
+    # target change for a latency regression
+    from apex_tpu.observability.slo import slo_targets
+    from apex_tpu.observability.spans import default_trace_sample
+
+    targets = slo_targets()
+    out["infer_trace"] = default_trace_sample()
+    out["infer_slo_ttft"] = targets["ttft_us"]
+    out["infer_slo_decode"] = targets["decode_us"]
+
     # shared-prefix burst + chunked-prefill legs (ISSUE 12, paged only):
     # (a) N requests extending ONE long cached prefix — hit TTFT vs the
     # same wave served cold, plus sharing/COW counters; (b) a long
@@ -1520,100 +1533,19 @@ def _run_all_legs(mode: str, errors: list):
     return result
 
 
-#: capture-hygiene bounds: a measured duration of exactly 0.0 µs means
-#: the whole timing loop collapsed inside the tunnel's RTT jitter (r5:
-#: flash_attn_us 0.0, moe us_gather 0.0), and a kernel "speedup" beyond
-#: 100x over an XLA baseline on the same chip is not physics either
-#: (r5: flash_attn_speedup 89198634.0 — the ratio of a real baseline to
-#: a collapsed ~0 measurement).  Such values are measurement artifacts
-#: and must never be republished by the capture-history loader.
-_MAX_PLAUSIBLE_SPEEDUP = 100.0
-
-#: throughput sanity ceiling for ``*tokens_per_s`` capture fields.  The
-#: same RTT-collapse that produced ``flash_attn_us: 0.0`` turns a
-#: throughput field into tokens/(~0 s): a v5e streaming a transformer
-#: at > 1e8 tokens/s is not physics (the flagship GPT measures ~1.1e5;
-#: even the cheap MoE layer pass peaks ~2.3e6).  0 and negatives are
-#: the us==0.0 artifact's other face (tokens / garbage-negative time).
-_MAX_PLAUSIBLE_TOKENS_PER_S = 1e8
-
-#: latency sanity ceiling for ``*_us`` capture fields (ISSUE 8: the
-#: telemetry TTFT / per-token decode latencies now ride in captures).
-#: One HOUR for a single step/request latency is not physics — it is a
-#: stuck tunnel, a wedged profiler, or a unit bug (seconds stamped into
-#: a ``_us`` field would read ~1e6x small, its inverse ~1e6x large);
-#: negatives are clock-skew garbage, 0.0 the RTT-collapse artifact.
-_MAX_PLAUSIBLE_LATENCY_US = 3.6e9
-
-
-def _is_us_key(key: str) -> bool:
-    return key == "us" or key.endswith("_us") or key.startswith("us_")
-
-
-def _is_tokens_per_s_key(key: str) -> bool:
-    return key == "tokens_per_s" or key.endswith("_tokens_per_s")
-
-
-def _hbm_capacity_bound(obj: dict) -> int:
-    """Physical ceiling for a ``compiled_peak_hbm_bytes`` field: the
-    capture's own chip's HBM when the ``chip`` stamp matches the spec
-    table, else the LARGEST capacity in the table (the permissive bound
-    — an unknown chip must not scrub a valid value)."""
-    from apex_tpu.chip_specs import CHIP_SPECS, match_spec
-    spec = match_spec(str(obj.get("chip", "")))
-    if spec is not None:
-        return spec.hbm_bytes
-    return max(s.hbm_bytes for s in CHIP_SPECS.values())
-
-
-def _scrub_capture_values(obj):
-    """Drop physically impossible values from a capture payload
-    (recursively): NaN/Inf in ANY numeric field (NaN passes every
-    range comparison below as False, so without this gate a poisoned
-    measurement sails through checks written as rejections — ISSUE 11
-    satellite), ``*_us``/``us_*`` latency fields that are
-    non-positive (0.0 = the RTT-collapse artifact, negatives =
-    clock-skew garbage) or beyond ``_MAX_PLAUSIBLE_LATENCY_US`` (covers
-    the telemetry TTFT / decode-latency fields), ``*_speedup`` fields
-    above ``_MAX_PLAUSIBLE_SPEEDUP``, ``*tokens_per_s`` throughputs
-    that are non-positive or beyond ``_MAX_PLAUSIBLE_TOKENS_PER_S``,
-    and the ISSUE-10 compiled-truth stamps — ``compiled_flops`` must be
-    positive and ``compiled_peak_hbm_bytes`` must be positive and fit
-    the chip's HBM (the ``chip`` field in the same dict selects the
-    bound).  Returns a scrubbed copy; containers are preserved, only
-    the corrupt scalar fields vanish."""
-    import math as _math
-    if isinstance(obj, dict):
-        out = {}
-        hbm_bound = None
-        for k, v in obj.items():
-            if isinstance(v, (dict, list)):
-                out[k] = _scrub_capture_values(v)
-                continue
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                if not _math.isfinite(v):
-                    continue
-                if _is_us_key(k) and \
-                        not 0.0 < v <= _MAX_PLAUSIBLE_LATENCY_US:
-                    continue
-                if (k == "speedup" or k.endswith("_speedup")) \
-                        and v > _MAX_PLAUSIBLE_SPEEDUP:
-                    continue
-                if _is_tokens_per_s_key(k) \
-                        and not 0.0 < v <= _MAX_PLAUSIBLE_TOKENS_PER_S:
-                    continue
-                if k == "compiled_flops" and v <= 0:
-                    continue
-                if k == "compiled_peak_hbm_bytes":
-                    if hbm_bound is None:
-                        hbm_bound = _hbm_capacity_bound(obj)
-                    if not 0 < v <= hbm_bound:
-                        continue
-            out[k] = v
-        return out
-    if isinstance(obj, list):
-        return [_scrub_capture_values(v) for v in obj]
-    return obj
+# capture hygiene lives in apex_tpu.observability.capture_hygiene (one
+# copy of the plausibility rules, shared with the perf-regression
+# watch); the underscored aliases keep this module's documented
+# surface — tests and the history loader read bench._scrub_* — intact.
+from apex_tpu.observability.capture_hygiene import (  # noqa: E402
+    MAX_PLAUSIBLE_LATENCY_US as _MAX_PLAUSIBLE_LATENCY_US,
+    MAX_PLAUSIBLE_SPEEDUP as _MAX_PLAUSIBLE_SPEEDUP,
+    MAX_PLAUSIBLE_TOKENS_PER_S as _MAX_PLAUSIBLE_TOKENS_PER_S,
+    hbm_capacity_bound as _hbm_capacity_bound,
+    is_tokens_per_s_key as _is_tokens_per_s_key,
+    is_us_key as _is_us_key,
+    scrub_capture_values as _scrub_capture_values,
+)
 
 
 def _summarize_capture(name, payload):

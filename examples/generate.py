@@ -221,6 +221,14 @@ def main(argv=None):
     print(f"{n_new} tokens in {dt:.2f}s "
           f"({n_new / dt:.1f} tok/s incl. compile)")
     print(f"telemetry: {json.dumps(sched.telemetry.summary())}")
+    # SLO accounting (ISSUE 13): armed by APEX_TPU_SLO_TTFT_US /
+    # APEX_TPU_SLO_DECODE_US; the scheduler closed one window per wave
+    if sched.slo.specs:
+        print(f"slo: {json.dumps(sched.slo.summary())}")
+    if sched.telemetry.tracer.enabled():
+        print("traces: APEX_TPU_TRACE armed — render a waterfall with "
+              "`python -m apex_tpu.observability.report <telemetry "
+              f"dir> --trace <uid>` (uids 0..{len(uids) - 1})")
     if args.train_steps and args.temperature == 0.0:
         want = [[(p[-1] + 1 + i) % args.vocab
                  for i in range(len(o))] for p, o in zip(prompts, outs)]

@@ -134,6 +134,104 @@ def test_ttft_histogram_counts_every_admitted_request(engine):
         int(tel.decode_steps.total()) > 0
 
 
+def test_span_conservation_every_trace_closes_terminal(engine):
+    """ISSUE 13 satellite: with tracing armed, every admitted trace
+    closes with exactly ONE terminal span (`retired` carrying the
+    finish reason) — asserted alongside the lifecycle conservation
+    law; nothing dangles at the wave boundary."""
+    from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+    from apex_tpu.observability.spans import TERMINAL_SPANS
+
+    reg = MetricsRegistry()
+    events = []
+
+    class _Sink:
+        def event(self, obj):
+            events.append(obj)
+
+    reg.add_sink(_Sink())
+    tel = ServeTelemetry(reg, trace=1)
+    sched = SlotScheduler(engine, telemetry=tel)
+    uids = [sched.submit([1 + i, 2, 3], max_new_tokens=3)
+            for i in range(5)]
+    sched.run()
+    # lifecycle conservation (the existing law) ...
+    c = tel.conservation()
+    assert c["submitted"] == c["finished"] + c["active"] + c["rejected"]
+    # ... and span conservation beside it
+    sc = tel.tracer.conservation()
+    assert sc["started"] == sc["admitted"] == sc["closed"] == 5
+    assert sc["closed_by_span"] == {"retired": 5}
+    assert sc["dangling"] == [] and sc["live"] == 0
+    assert sc["orphan_terminals"] == []
+    # exactly one terminal span per uid in the stream, reason from
+    # finish_reasons
+    for uid in uids:
+        terminals = [e for e in events if e["kind"] == "trace_span"
+                     and e["uid"] == uid
+                     and e["span"] in TERMINAL_SPANS]
+        assert len(terminals) == 1, uid
+        assert terminals[0]["detail"] == sched.finish_reasons[uid]
+
+
+def test_overload_sheds_lowest_priority_first(engine):
+    """ISSUE 13 satellite: a seeded overload — more queued work than
+    the slots drain — flips the shedding advisory, and the scheduler
+    rejects the LOWEST effective-priority request first (reason
+    "shed", no results entry, trace closed with a `rejected` terminal,
+    conservation intact)."""
+    from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+    from apex_tpu.observability.slo import OverloadDetector, SLOTracker
+
+    reg = MetricsRegistry()
+    events = []
+
+    class _Sink:
+        def event(self, obj):
+            events.append(obj)
+
+    reg.add_sink(_Sink())
+    tel = ServeTelemetry(reg, trace=1)
+    slo = SLOTracker(reg, (), detector=OverloadDetector(window=2,
+                                                        queue_high=2))
+    sched = SlotScheduler(engine, telemetry=tel, slo=slo,
+                          shed_on_overload=True)
+    low = None
+    for i in range(6):
+        pr = -5 if i == 3 else 0        # uid 3 is the shed victim
+        uid = sched.submit([1 + i, 2, 3], max_new_tokens=4,
+                           tenant="low" if i == 3 else "default",
+                           priority=pr)
+        if i == 3:
+            low = uid
+    out = sched.run()
+    sheds = [uid for uid, r in sched.finish_reasons.items()
+             if r == "shed"]
+    assert sheds, "the seeded overload never flipped the advisory"
+    # lowest effective priority went first
+    shed_events = [e for e in events if e["kind"] == "request_shed"]
+    assert shed_events[0]["uid"] == low
+    assert shed_events[0]["tenant"] == "low"
+    assert low not in out
+    # every non-shed request completed in full
+    for uid in range(6):
+        if uid not in sheds:
+            assert len(out[uid]) == 4, uid
+    # counters: shed rides the rejected side of the conservation law
+    assert int(tel.rejected.value(reason="shed")) == len(sheds)
+    assert int(tel.shed.total()) == len(sheds)
+    c = tel.conservation()
+    assert c["submitted"] == c["finished"] + c["active"] + c["rejected"]
+    assert c["active"] == 0
+    # the advisory was observable while it held
+    assert any(e["kind"] == "overload" and e["overloaded"]
+               for e in events)
+    # shed traces closed with the `rejected` terminal — no dangles
+    sc = tel.tracer.conservation()
+    assert sc["closed_by_span"]["rejected"] == len(sheds)
+    assert sc["dangling"] == []
+
+
 def test_decode_shape_is_fixed_across_admits(engine):
     """The continuous-batching property: a full wave of admits/retires
     compiles NO new decode programs after the first step."""

@@ -193,6 +193,97 @@ def test_report_without_numerics_stays_byte_stable(capsys):
         encoding="utf-8")
 
 
+# -- SLO section + per-request waterfall (ISSUE 13) -------------------------
+
+SLO_FIXTURE = Path(__file__).parent / "fixtures" / "flight_run_slo"
+
+
+def test_slo_golden_markdown_byte_stable(tmp_path, capsys):
+    """A run with tracing + SLO armed renders the SLO section — burn
+    rates, budget remaining, violating tenants, shed tallies — and the
+    committed golden reproduces byte-for-byte."""
+    out = tmp_path / "report.md"
+    assert main([str(SLO_FIXTURE), "--out", str(out)]) == 0
+    capsys.readouterr()
+    got = out.read_text(encoding="utf-8")
+    assert got == (SLO_FIXTURE / "expected_report.md").read_text(
+        encoding="utf-8"), (
+        "the SLO flight-recorder markdown drifted from the committed "
+        "golden — if intentional, regenerate expected_report.md with "
+        "the report CLI and commit it")
+    assert "## SLO" in got
+    assert "violating_tenants**: acme" in got
+
+
+def test_slo_json_section_shape(capsys):
+    assert main([str(SLO_FIXTURE), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    slo = report["slo"]
+    assert slo["slos"]["ttft_p99"]["burn_rate"] == 50.0
+    assert slo["slos"]["ttft_p99"]["budget_remaining"] == 0.0
+    assert slo["slos"]["ttft_p99"]["violations"] == 1.0
+    assert slo["slos"]["decode_token_p99"]["burn_rate"] == 0.0
+    assert slo["violating_tenants"] == ["acme"]
+    assert slo["tenant_goodput"] == {"acme": 0.5, "default": 1.0}
+    assert slo["shed_requests"] == 1.0
+    assert slo["overloaded"] is False and slo["overload_events"] == 2
+
+
+def test_trace_waterfall_golden(tmp_path, capsys):
+    """`report --trace 1`: the per-request waterfall reproduces its
+    committed golden byte-for-byte and reads as a lifecycle."""
+    out = tmp_path / "trace.md"
+    assert main([str(SLO_FIXTURE), "--trace", "1",
+                 "--out", str(out)]) == 0
+    capsys.readouterr()
+    got = out.read_text(encoding="utf-8")
+    assert got == (SLO_FIXTURE / "expected_trace.md").read_text(
+        encoding="utf-8"), (
+        "the trace-waterfall markdown drifted from the committed "
+        "golden — if intentional, regenerate expected_trace.md with "
+        "`report --trace 1 --out ...` and commit it")
+    for span in ("queued", "admitted", "cow_copy", "prefill_chunk",
+                 "first_token", "decode", "retired"):
+        assert span in got, span
+    assert "start=64 tokens=64 bucket=64" in got
+
+
+def test_trace_json_view(capsys):
+    assert main([str(SLO_FIXTURE), "--trace", "1", "--json"]) == 0
+    [trace] = json.loads(capsys.readouterr().out)
+    assert trace["uid"] == 1 and trace["wave"] == 1
+    seqs = [s["seq"] for s in trace["spans"]]
+    assert seqs == sorted(seqs)
+    terminals = [s for s in trace["spans"]
+                 if s["span"] in ("retired", "rejected")]
+    assert len(terminals) == 1 and terminals[0]["detail"] == "length"
+
+
+def test_trace_shed_request_ends_rejected(capsys):
+    assert main([str(SLO_FIXTURE), "--trace", "2", "--json"]) == 0
+    [trace] = json.loads(capsys.readouterr().out)
+    assert [s["span"] for s in trace["spans"]] == ["rejected"]
+    assert trace["spans"][0]["detail"] == "shed"
+
+
+def test_trace_unknown_uid_fails_loudly(capsys):
+    assert main([str(SLO_FIXTURE), "--trace", "99"]) == 1
+    err = capsys.readouterr().err
+    assert "no trace_span events for uid 99" in err
+
+
+def test_pre_pr13_run_dirs_have_no_slo_section(capsys):
+    """Back-compat (acceptance): the ISSUE 10/11 fixtures — committed
+    before SLOs existed — render NO SLO section and still reproduce
+    their goldens (asserted byte-for-byte by their own tests above)."""
+    main(_fixture_args())
+    assert "## SLO" not in capsys.readouterr().out
+    main([str(NUMERICS_FIXTURE)])
+    assert "## SLO" not in capsys.readouterr().out
+    assert "slo" not in build_report(
+        [], (FIXTURE / "metrics.prom").read_text(encoding="utf-8"))
+
+
 def test_numerics_section_histogram_fallback_from_prom_only():
     """A run whose JSONL was lost but whose prom snapshot survived:
     grad-norm percentiles fall back to bucket resolution from

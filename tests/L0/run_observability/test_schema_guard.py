@@ -45,6 +45,22 @@ def test_schema_pins_types_and_buckets_not_just_names():
     assert committed["jsonl"]["common"] == schema.COMMON_EVENT_FIELDS
 
 
+def test_span_and_slo_families_are_pinned():
+    """ISSUE 13 satellite: the committed schema re-pin covers every
+    family and event the tracing/SLO modules emit — a new span or SLO
+    family cannot ship unpinned (the NUMERICS_METRIC_FAMILIES pattern)."""
+    from apex_tpu.observability import slo, spans
+    committed = json.loads((REPO / schema.SCHEMA_NAME).read_text())
+    for fam in spans.TRACE_METRIC_FAMILIES + slo.SLO_METRIC_FAMILIES:
+        assert fam in committed["prometheus"], fam
+        assert fam in schema.METRIC_SPECS, fam
+    for kind in spans.TRACE_EVENTS + slo.SLO_EVENTS + ("request_shed",):
+        assert kind in committed["jsonl"]["events"], kind
+        assert kind in schema.EVENT_FIELDS, kind
+    # the scheduler's shed path reaches the shed counter too
+    assert "serve_requests_shed_total" in committed["prometheus"]
+
+
 def test_histogram_buckets_are_sorted_positive():
     """Non-physical bucket layouts (unsorted, non-positive bounds) are
     schema bugs — latencies cannot be <= 0."""

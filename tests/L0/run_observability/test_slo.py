@@ -1,0 +1,231 @@
+"""SLO math (ISSUE 13): burn rate / error budget against hand-computed
+windows (empty and 100%-violation windows included), bucket-resolution
+threshold semantics, per-tenant goodput floors, and the overload
+detector's trend rule."""
+import pytest
+
+from apex_tpu.observability import MetricsRegistry
+from apex_tpu.observability.slo import (OverloadDetector, SLOSpec,
+                                        SLOTracker, slo_specs_from_env,
+                                        slo_target_us)
+
+
+class _CaptureSink:
+    def __init__(self):
+        self.events = []
+
+    def event(self, obj):
+        self.events.append(obj)
+
+
+def _tracker(specs, **kw):
+    reg = MetricsRegistry()
+    sink = _CaptureSink()
+    reg.add_sink(sink)
+    return SLOTracker(reg, specs, **kw), reg, sink
+
+
+TTFT = SLOSpec("ttft_p99", "serve_ttft_seconds", 0.05)  # 50ms @ p99
+
+
+def test_burn_rate_hand_computed_window():
+    """10 good + 1 bad sample against a 1% budget: violation fraction
+    1/11, burn rate (1/11)/0.01 ~= 9.09 — budget fully gone."""
+    tr, reg, sink = _tracker((TTFT,))
+    h = reg.declared("serve_ttft_seconds")
+    for _ in range(10):
+        h.observe(0.01)
+    h.observe(0.2)
+    w = tr.observe_window()
+    s = w["slos"]["ttft_p99"]
+    assert s["samples"] == 11 and s["violations"] == 1
+    assert s["fraction"] == pytest.approx(1 / 11)
+    assert s["burn_rate"] == pytest.approx((1 / 11) / 0.01)
+    # cumulative budget: allowed = 0.01 * 11 = 0.11 violations, spent 1
+    assert s["budget_remaining"] == 0.0
+    assert tr.burn_rate.value(slo="ttft_p99") == s["burn_rate"]
+    assert int(tr.violations.value(slo="ttft_p99")) == 1
+    [ev] = [e for e in sink.events if e["kind"] == "slo_violation"]
+    assert ev["slo"] == "ttft_p99" and ev["window"] == 1
+    assert ev["violations"] == 1 and ev["samples"] == 11
+    assert ev["burn_rate"] == pytest.approx((1 / 11) / 0.01, rel=1e-6)
+    assert ev["threshold"] == 0.05
+
+
+def test_empty_window_publishes_nothing():
+    tr, reg, sink = _tracker((TTFT,))
+    w = tr.observe_window()
+    s = w["slos"]["ttft_p99"]
+    assert s["samples"] == 0 and s["burn_rate"] is None
+    assert tr.burn_rate.value(slo="ttft_p99") is None
+    assert [e for e in sink.events if e["kind"] == "slo_violation"] == []
+    # a later window only accounts its own delta
+    h = reg.declared("serve_ttft_seconds")
+    for _ in range(200):
+        h.observe(0.01)
+    w = tr.observe_window()
+    assert w["slos"]["ttft_p99"]["samples"] == 200
+    assert w["slos"]["ttft_p99"]["burn_rate"] == 0.0
+    # healthy traffic: full budget intact
+    assert w["slos"]["ttft_p99"]["budget_remaining"] == 1.0
+
+
+def test_hundred_percent_violation_window():
+    tr, reg, sink = _tracker((TTFT,))
+    h = reg.declared("serve_ttft_seconds")
+    for _ in range(5):
+        h.observe(1.0)
+    w = tr.observe_window()
+    s = w["slos"]["ttft_p99"]
+    assert s["fraction"] == 1.0
+    assert s["burn_rate"] == pytest.approx(1.0 / 0.01)   # 100x budget
+    assert s["budget_remaining"] == 0.0
+    assert int(tr.violations.value(slo="ttft_p99")) == 5
+
+
+def test_windows_are_deltas_not_cumulative():
+    """Window 2 sees only the samples recorded after window 1 — a hot
+    first window does not poison a healthy second one."""
+    tr, reg, sink = _tracker((SLOSpec("ttft_p99", "serve_ttft_seconds",
+                                      0.05, quantile=0.9),))
+    h = reg.declared("serve_ttft_seconds")
+    h.observe(0.2)                      # window 1: 100% violation
+    assert tr.observe_window()["slos"]["ttft_p99"]["burn_rate"] \
+        == pytest.approx(10.0)
+    for _ in range(9):                  # window 2: all good
+        h.observe(0.01)
+    w2 = tr.observe_window()["slos"]["ttft_p99"]
+    assert w2["samples"] == 9 and w2["violations"] == 0
+    assert w2["burn_rate"] == 0.0
+    # cumulative budget: 1 violation allowed over 10 samples at q=0.9
+    # -> exactly spent
+    assert w2["budget_remaining"] == pytest.approx(0.0)
+
+
+def test_fresh_tracker_on_warm_registry_owns_no_history():
+    """A second tracker attached to a registry already holding traffic
+    (two schedulers sharing one telemetry) seeds its window baseline
+    from the CURRENT histogram state — prior violations are not
+    re-counted and no spurious event fires (review fix)."""
+    tr, reg, sink = _tracker((TTFT,))
+    h = reg.declared("serve_ttft_seconds")
+    for _ in range(9):
+        h.observe(0.01)
+    h.observe(0.2)                      # scheduler 1's violation
+    tr.observe_window()
+    assert int(tr.violations.value(slo="ttft_p99")) == 1
+    tr2 = SLOTracker(reg, (TTFT,))      # scheduler 2, same registry
+    w = tr2.observe_window()            # no new traffic
+    s = w["slos"]["ttft_p99"]
+    assert s["samples"] == 0 and s["violations"] == 0
+    assert int(tr2.violations.value(slo="ttft_p99")) == 1   # unchanged
+    assert len([e for e in sink.events
+                if e["kind"] == "slo_violation"]) == 1
+
+
+def test_threshold_clamps_to_bucket_resolution():
+    """A sample between the clamped bucket bound and the threshold
+    counts as a violation — the conservative reading; a sample exactly
+    ON a bound covered by the threshold stays good."""
+    spec = SLOSpec("ttft_p99", "serve_ttft_seconds", 0.03)
+    tr, reg, _ = _tracker((spec,))
+    h = reg.declared("serve_ttft_seconds")
+    # buckets include 0.025: threshold 0.03 clamps down to 0.025
+    h.observe(0.025)                    # on the bound: good
+    h.observe(0.028)                    # < threshold but > bound: bad
+    w = tr.observe_window()["slos"]["ttft_p99"]
+    assert w["violations"] == 1
+
+
+def test_tenant_goodput_floor_names_violators():
+    tr, reg, sink = _tracker((), tenant_goodput_floor=0.9)
+    adm = reg.declared("serve_tenant_admitted_total")
+    shed = reg.declared("serve_requests_shed_total")
+    adm.inc(9, tenant="good")
+    adm.inc(1, tenant="acme")
+    shed.inc(1, tenant="acme")          # goodput 0.5 < 0.9
+    w = tr.observe_window()
+    assert w["tenants"] == {"acme": 0.5, "good": 1.0}
+    assert tr.violating_tenants == ["acme"]
+    assert tr.tenant_goodput.value(tenant="acme") == 0.5
+    [ev] = [e for e in sink.events if e["kind"] == "slo_violation"]
+    assert ev["slo"] == "tenant_goodput:acme"
+    assert ev["fraction"] == 0.5 and ev["threshold"] == 0.9
+    assert ev["burn_rate"] is None
+    assert "violating_tenants" in tr.summary()
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="threshold"):
+        SLOSpec("x", "serve_ttft_seconds", 0.0)
+    with pytest.raises(ValueError, match="quantile"):
+        SLOSpec("x", "serve_ttft_seconds", 0.1, quantile=1.0)
+    with pytest.raises(ValueError, match="histogram"):
+        tr, reg, _ = _tracker(
+            (SLOSpec("x", "serve_queue_depth", 0.1),))
+        tr.observe_window()
+    with pytest.raises(ValueError, match="tenant_goodput_floor"):
+        _tracker((), tenant_goodput_floor=1.5)
+
+
+def test_specs_from_env(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_SLO_TTFT_US", raising=False)
+    monkeypatch.delenv("APEX_TPU_SLO_DECODE_US", raising=False)
+    assert slo_specs_from_env() == ()
+    monkeypatch.setenv("APEX_TPU_SLO_TTFT_US", "50000")
+    monkeypatch.setenv("APEX_TPU_SLO_DECODE_US", "2500")
+    ttft, decode = slo_specs_from_env()
+    assert ttft.family == "serve_ttft_seconds"
+    assert ttft.threshold_s == pytest.approx(0.05)
+    assert decode.family == "serve_decode_token_seconds"
+    assert decode.threshold_s == pytest.approx(0.0025)
+    monkeypatch.setenv("APEX_TPU_SLO_TTFT_US", "fast")
+    with pytest.raises(ValueError, match="APEX_TPU_SLO_TTFT_US"):
+        slo_target_us("APEX_TPU_SLO_TTFT_US")
+
+
+# -- overload detector -------------------------------------------------------
+
+def test_overload_detector_sustained_queue_flips():
+    det = OverloadDetector(window=3, queue_high=2)
+    assert not det.observe(5)           # window not full yet
+    assert not det.observe(5)
+    assert det.observe(5)               # sustained at >= queue_high
+    assert det.observe(6)               # still rising
+    assert not det.observe(1)           # drained below high -> clears
+
+
+def test_overload_detector_draining_queue_is_not_overload():
+    det = OverloadDetector(window=3, queue_high=2)
+    for depth in (6, 5, 4, 3):
+        assert not det.observe(depth)   # decreasing = draining
+
+
+def test_overload_detector_backpressure_counts_as_pressure():
+    det = OverloadDetector(window=3, queue_high=100)
+    det.observe(1, backpressure_total=0)
+    det.observe(1, backpressure_total=1)
+    assert det.observe(1, backpressure_total=2)   # bp grew in-window
+
+
+def test_overload_detector_recovering_pool_blocks_advisory():
+    det = OverloadDetector(window=3, queue_high=2)
+    det.observe(5, free_pages=2)
+    det.observe(5, free_pages=4)
+    assert not det.observe(5, free_pages=8)   # pool recovering
+    det2 = OverloadDetector(window=3, queue_high=2)
+    det2.observe(5, free_pages=8)
+    det2.observe(5, free_pages=4)
+    assert det2.observe(5, free_pages=2)      # pool starving
+
+
+def test_observe_load_emits_events_on_flips_only():
+    tr, reg, sink = _tracker((), detector=OverloadDetector(
+        window=2, queue_high=2))
+    for depth in (4, 4, 4, 0, 0):
+        tr.observe_load(queue_depth=depth)
+    flips = [e for e in sink.events if e["kind"] == "overload"]
+    assert [e["overloaded"] for e in flips] == [True, False]
+    assert flips[0]["queue_depth"] == 4
+    assert tr.overload_gauge.value() == 0.0
+    assert not tr.shedding_advisory()

@@ -1,0 +1,188 @@
+"""Request-scoped tracing (ISSUE 13): span emission order, sampling,
+terminal discipline, and the span-conservation books — driven through
+ServeTelemetry's host boundaries with a capture sink, no engine."""
+import pytest
+
+from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+from apex_tpu.observability import schema, spans
+
+
+class _CaptureSink:
+    def __init__(self):
+        self.events = []
+
+    def event(self, obj):
+        self.events.append(obj)
+
+
+def _telemetry(trace=1):
+    reg = MetricsRegistry()
+    sink = _CaptureSink()
+    reg.add_sink(sink)
+    return ServeTelemetry(reg, trace=trace), sink
+
+
+def _spans(sink, uid=None):
+    out = [e for e in sink.events if e["kind"] == "trace_span"]
+    if uid is not None:
+        out = [e for e in out if e["uid"] == uid]
+    return out
+
+
+def _drive_request(tel, uid, chunks=1, cow=False):
+    """One full lifecycle through the telemetry's host boundaries."""
+    tel.request_submitted(uid, 8, 4, queue_depth=1)
+    tel.request_admitted(uid, slot=0, queue_depth=0, pages=3,
+                         prefix_tokens=2)
+    if cow:
+        tel.cow_copied(uid, slot=0, src=5, dst=9)
+    for i in range(chunks):
+        with tel.prefill_step(prompt_len=4, bucket_len=64, uid=uid,
+                              start_tok=4 * i):
+            pass
+    tel.first_token(uid)
+    tel.request_finished(uid, "length", 4)
+
+
+def test_full_lifecycle_span_sequence():
+    tel, sink = _telemetry()
+    tel.begin_wave()
+    _drive_request(tel, 0, chunks=2, cow=True)
+    evs = _spans(sink, uid=0)
+    assert [e["span"] for e in evs] == [
+        "queued", "admitted", "cow_copy", "prefill_chunk",
+        "prefill_chunk", "first_token", "decode", "retired"]
+    # seq is contiguous from 1, every event carries the serving wave
+    assert [e["seq"] for e in evs] == list(range(1, len(evs) + 1))
+    assert all(e["wave"] == 1 for e in evs)
+    # offsets are physical: queued starts the trace, later spans only
+    # move forward, durations are non-negative
+    assert evs[0]["start_s"] == 0.0
+    assert evs[0]["dur_s"] >= 0.0
+    starts = [e["start_s"] for e in evs[1:]]
+    assert starts == sorted(starts)
+    for e in evs:
+        if e["dur_s"] is not None:
+            assert e["dur_s"] >= 0.0
+    # details carry the operator-facing context
+    assert "slot=0" in evs[1]["detail"]
+    assert "prefix_tokens=2" in evs[1]["detail"]
+    assert evs[2]["detail"] == "page 5->9"
+    assert "start=4" in evs[4]["detail"] and "bucket=64" in evs[4]["detail"]
+    assert evs[6]["detail"] == "tokens=4"
+    assert evs[7]["detail"] == "length"
+    # decode opens exactly at the first token
+    first = next(e for e in evs if e["span"] == "first_token")
+    decode = next(e for e in evs if e["span"] == "decode")
+    assert decode["start_s"] == pytest.approx(first["start_s"])
+    # metric family counted every span
+    assert int(tel.tracer.spans.total()) == len(evs)
+
+
+def test_events_are_schema_shaped():
+    tel, sink = _telemetry()
+    tel.begin_wave()
+    _drive_request(tel, 0)
+    declared = schema.EVENT_FIELDS["trace_span"]
+    for e in _spans(sink):
+        assert set(e) == {"ts", "kind"} | set(declared)
+        assert isinstance(e["uid"], int) and isinstance(e["seq"], int)
+        assert isinstance(e["wave"], int)
+        assert isinstance(e["span"], str)
+        assert isinstance(e["start_s"], float)
+        assert e["dur_s"] is None or isinstance(e["dur_s"], float)
+        assert e["detail"] is None or isinstance(e["detail"], str)
+
+
+def test_sampling_one_in_n_is_uid_stable():
+    tel, sink = _telemetry(trace=2)
+    tel.begin_wave()
+    for uid in range(4):
+        _drive_request(tel, uid)
+    assert _spans(sink, uid=0) and _spans(sink, uid=2)
+    assert not _spans(sink, uid=1) and not _spans(sink, uid=3)
+    c = tel.tracer.conservation()
+    assert c["started"] == c["closed"] == 2
+    # the untraced uids never register as orphan terminals
+    assert c["orphan_terminals"] == []
+
+
+def test_trace_off_emits_nothing():
+    tel, sink = _telemetry(trace=0)
+    _drive_request(tel, 0)
+    assert _spans(sink) == []
+    assert not tel.tracer.enabled()
+    assert int(tel.tracer.spans.total()) == 0
+
+
+def test_env_knob_default(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_TRACE", raising=False)
+    assert spans.default_trace_sample() == 0
+    monkeypatch.setenv("APEX_TPU_TRACE", "3")
+    assert spans.default_trace_sample() == 3
+    tel, sink = _telemetry(trace=None)        # None -> env
+    assert tel.tracer.sample == 3
+    monkeypatch.setenv("APEX_TPU_TRACE", "banana")
+    with pytest.raises(ValueError, match="APEX_TPU_TRACE"):
+        spans.default_trace_sample()
+    monkeypatch.setenv("APEX_TPU_TRACE", "-1")
+    with pytest.raises(ValueError, match=">= 0"):
+        spans.default_trace_sample()
+
+
+def test_shed_closes_trace_with_rejected_terminal():
+    """A queued request shed under overload: the trace closes with a
+    `rejected` terminal span — no trace dangles (ISSUE 13 satellite)."""
+    tel, sink = _telemetry()
+    tel.begin_wave()
+    tel.request_submitted(7, 8, 4, queue_depth=1)
+    tel.request_shed(7, tenant="acme", queue_depth=0)
+    evs = _spans(sink, uid=7)
+    assert [e["span"] for e in evs] == ["rejected"]
+    assert evs[0]["detail"] == "shed"
+    c = tel.tracer.conservation()
+    assert c["closed_by_span"] == {"rejected": 1}
+    assert c["dangling"] == [] and c["live"] == 0
+    # the lifecycle conservation law still balances (shed rides the
+    # rejected side, submitted counted once)
+    lc = tel.conservation()
+    assert lc["submitted"] == lc["finished"] + lc["active"] \
+        + lc["rejected"] == 1
+    assert int(tel.shed.value(tenant="acme")) == 1
+
+
+def test_conservation_flags_dangling_and_orphans():
+    tel, _ = _telemetry()
+    tel.begin_wave()
+    tel.request_submitted(0, 4, 2, queue_depth=1)
+    tel.request_admitted(0, slot=0, queue_depth=0)
+    c = tel.tracer.conservation()
+    assert c["dangling"] == [0] and c["live"] == 1
+    tel.request_finished(0, "eos", 1)
+    c = tel.tracer.conservation()
+    assert c["dangling"] == [] and c["started"] == c["closed"] == 1
+    # a second terminal for the same uid is an orphan, not a crash
+    tel.tracer.request_finished(0, "eos", 1)
+    assert tel.tracer.conservation()["orphan_terminals"] == [0]
+
+
+def test_wave_stamps_the_serving_wave():
+    """A request submitted before run() is admitted inside the wave:
+    its spans carry the wave that SERVED it."""
+    tel, sink = _telemetry()
+    tel.request_submitted(0, 4, 2, queue_depth=1)   # pre-wave submit
+    tel.begin_wave()
+    tel.request_admitted(0, slot=0, queue_depth=0)
+    tel.request_finished(0, "length", 2)
+    tel.begin_wave()
+    tel.request_submitted(1, 4, 2, queue_depth=1)
+    tel.request_admitted(1, slot=0, queue_depth=0)
+    tel.request_finished(1, "length", 2)
+    assert {e["wave"] for e in _spans(sink, uid=0)} == {1}
+    assert {e["wave"] for e in _spans(sink, uid=1)} == {2}
+    # a request submitted pre-wave but SHED during the wave renders
+    # under the wave that shed it, same as the admitted path
+    tel.request_submitted(2, 4, 2, queue_depth=1)
+    tel.begin_wave()
+    tel.request_shed(2)
+    assert {e["wave"] for e in _spans(sink, uid=2)} == {3}

@@ -1,0 +1,212 @@
+"""Perf-regression watch (ISSUE 13): the committed fixture pairs —
+one where round 2 regresses a leg and the ratchet fires, a clean twin
+that passes, and a shuffled-stamp pair where ordering hygiene rejects
+the lying capture — plus the comparability/direction unit rules."""
+import json
+from pathlib import Path
+
+import pytest
+
+from apex_tpu.observability import watch
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _write(dirpath, name, payload):
+    (dirpath / name).write_text(json.dumps(payload) + "\n",
+                                encoding="utf-8")
+
+
+# -- the committed self-test fixtures (CI satellite) ------------------------
+
+def test_ratchet_fires_on_committed_regression_fixture(capsys):
+    rc = watch.main([str(FIXTURES / "watch_regress")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSED mini_decode_us" in out
+    assert "1 regression(s)" in out
+    # the throughput leg stayed inside slack — one bad leg, one firing
+    res = watch.analyze(str(FIXTURES / "watch_regress"))
+    by_metric = {r["metric"]: r for r in res["rows"]}
+    assert by_metric["mini_decode_us"]["status"] == "regressed"
+    assert by_metric["mini_decode_us"]["ratio"] == pytest.approx(1.3)
+    assert by_metric["mini_tokens_per_s"]["status"] == "ok"
+
+
+def test_clean_twin_passes(capsys):
+    rc = watch.main([str(FIXTURES / "watch_clean")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REGRESSED" not in out
+    assert "no regressions" in out
+
+
+def test_shuffled_stamps_reject_the_lying_capture(capsys):
+    """The shuffled pair is the REGRESS pair with swapped stamps: r2's
+    wall clock precedes r1's, so ordering hygiene rejects r2 before
+    trending — the (real) regression inside it must NOT fire, and the
+    rejection is loud."""
+    rc = watch.main([str(FIXTURES / "watch_shuffled")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REJECTED r2_mini.json" in out
+    assert "REGRESSED" not in out
+    res = watch.analyze(str(FIXTURES / "watch_shuffled"))
+    [rej] = res["rejected"]
+    assert rej["capture"] == "r2_mini.json"
+    assert "precedes" in rej["reason"]
+    # the surviving r1 trends alone
+    assert all(r["status"] == "no-prior" for r in res["rows"])
+
+
+def test_json_output_parses(capsys):
+    assert watch.main([str(FIXTURES / "watch_regress"),
+                       "--json"]) == 1
+    res = json.loads(capsys.readouterr().out)
+    assert res["regressions"][0]["metric"] == "mini_decode_us"
+
+
+def test_slack_is_honored(capsys):
+    # at x1.35 slack the 1.3x decode regression is tolerated
+    assert watch.main([str(FIXTURES / "watch_regress"),
+                       "--slack", "1.35"]) == 0
+    capsys.readouterr()
+
+
+# -- unit rules --------------------------------------------------------------
+
+def test_metric_direction_classifier():
+    assert watch.metric_direction("infer_decode_token_us") == "lower"
+    assert watch.metric_direction("infer_decode_token_us_median") \
+        == "lower"
+    assert watch.metric_direction("us_gather") == "lower"
+    assert watch.metric_direction("sec_per_step") == "lower"
+    assert watch.metric_direction("bert_sec_per_step_median") == "lower"
+    assert watch.metric_direction("moe_tokens_per_s") == "higher"
+    assert watch.metric_direction(
+        "gpt_train_tokens_per_sec_1chip") == "higher"
+    assert watch.metric_direction("layernorm_gbps") == "higher"
+    assert watch.metric_direction("mfu") == "higher"
+    assert watch.metric_direction("mfu_compiled") == "higher"
+    assert watch.metric_direction("bert_mfu") == "higher"
+    assert watch.metric_direction("adam_roofline") == "higher"
+    assert watch.metric_direction("flash_attn_speedup") == "higher"
+    # context, not measurements: shapes, knob stamps, SLO targets
+    assert watch.metric_direction("infer_shape") is None
+    assert watch.metric_direction("xent_chunk") is None
+    assert watch.metric_direction("infer_slo_ttft") is None
+    assert watch.metric_direction("infer_trace") is None
+    assert watch.metric_direction("adam_nelem") is None
+
+
+def test_shape_or_knob_change_starts_a_fresh_series(tmp_path):
+    """Same metric, different shape (or knob): no comparison — a
+    bigger model measuring slower is not a regression."""
+    _write(tmp_path, "r1_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 100.0,
+            "mini_shape": [2, 64], "mini_chunk": 8})
+    _write(tmp_path, "r2_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 900.0,
+            "mini_shape": [2, 1024], "mini_chunk": 8})
+    res = watch.analyze(str(tmp_path))
+    assert all(r["status"] == "no-prior" for r in res["rows"])
+    # knob change isolates the same way
+    _write(tmp_path, "r3_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 900.0,
+            "mini_shape": [2, 64], "mini_chunk": 64})
+    res = watch.analyze(str(tmp_path))
+    assert not res["regressions"]
+
+
+def test_modifier_prefixed_metrics_keep_their_leg_context(tmp_path):
+    """`fused_adam_us` belongs to the adam leg even though its first
+    token is the modifier: `adam_nelem` must key its comparability, so
+    a size change forks the series (review fix)."""
+    _write(tmp_path, "r1_a.json",
+           {"_leg": "adam", "backend": "tpu", "fused_adam_us": 4300.0,
+            "adam_nelem": 100000000})
+    _write(tmp_path, "r2_a.json",
+           {"_leg": "adam", "backend": "tpu", "fused_adam_us": 430.0,
+            "adam_nelem": 1000000})      # 100x smaller problem
+    res = watch.analyze(str(tmp_path))
+    rows = [r for r in res["rows"] if r["metric"] == "fused_adam_us"]
+    assert all(r["status"] == "no-prior" for r in rows)
+    # same nelem DOES compare
+    ctx1 = watch.context_for({"fused_adam_us": 1.0,
+                              "adam_nelem": 5}, "fused_adam_us")
+    assert ("adam_nelem", "5") in ctx1
+
+
+def test_backends_never_compare(tmp_path):
+    _write(tmp_path, "r1_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 100.0})
+    _write(tmp_path, "r2_a.json",
+           {"_leg": "x", "backend": "cpu", "mini_us": 5000.0})
+    assert not watch.analyze(str(tmp_path))["regressions"]
+
+
+def test_best_prior_not_previous(tmp_path):
+    """The baseline is the BEST earlier round: a slow r2 must not
+    lower the bar for r3."""
+    for rnd, us in ((1, 100.0), (2, 140.0), (3, 130.0)):
+        _write(tmp_path, f"r{rnd}_a.json",
+               {"_leg": "x", "backend": "tpu", "mini_us": us})
+    res = watch.analyze(str(tmp_path))
+    [row] = res["rows"]
+    assert row["best_prior"] == 100.0
+    assert row["status"] == "regressed"       # 130 > 100 * 1.15
+
+
+def test_higher_is_better_direction(tmp_path):
+    for rnd, tps in ((1, 1000.0), (2, 800.0)):
+        _write(tmp_path, f"r{rnd}_a.json",
+               {"_leg": "x", "backend": "tpu",
+                "mini_tokens_per_s": tps})
+    [row] = watch.analyze(str(tmp_path))["rows"]
+    assert row["status"] == "regressed"       # 800 < 1000 / 1.15
+
+
+def test_scrubbed_values_never_trend(tmp_path):
+    """An RTT-collapsed 0.0 µs 'best' must not become the ratchet bar
+    (the capture-hygiene rules apply before trending)."""
+    _write(tmp_path, "r1_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 0.0})
+    _write(tmp_path, "r2_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 120.0})
+    [row] = watch.analyze(str(tmp_path))["rows"]
+    assert row["status"] == "no-prior"
+
+
+def test_unstamped_legacy_captures_are_exempt_from_ordering(tmp_path):
+    _write(tmp_path, "r1_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 100.0})
+    _write(tmp_path, "r2_a.json",
+           {"_leg": "x", "backend": "tpu", "mini_us": 101.0,
+            "captured_at": "2026-08-01T00:00:00+00:00"})
+    res = watch.analyze(str(tmp_path))
+    assert res["rejected"] == []
+    [row] = res["rows"]
+    assert row["status"] == "ok"
+
+
+def test_full_capture_shape_flattens(tmp_path):
+    """Orchestrator captures ({metric, value, extras}) trend their
+    headline value under the metric name."""
+    for rnd, v in ((1, 100000.0), (2, 50000.0)):
+        _write(tmp_path, f"r{rnd}_full.json",
+               {"metric": "gpt_train_tokens_per_sec_1chip", "value": v,
+                "unit": "tokens/s",
+                "extras": {"backend": "tpu", "mfu": 0.4}})
+    res = watch.analyze(str(tmp_path))
+    by_metric = {r["metric"]: r for r in res["rows"]}
+    assert by_metric["gpt_train_tokens_per_sec_1chip"]["status"] \
+        == "regressed"
+
+
+def test_real_bench_captures_load_without_error():
+    """The committed history parses end to end (regressions there are
+    findings, not failures — PERF.md round 13 records them)."""
+    capdir = Path(__file__).parents[3] / "bench_captures"
+    res = watch.analyze(str(capdir))
+    assert res["captures"] >= 9
+    assert res["rejected"] == []

@@ -122,6 +122,61 @@ def test_serve_n_requests_metric_consistency(engine, tmp_path):
     assert 'serve_requests_finished_total{reason="length"} 5' in text
 
 
+def test_trace_and_slo_armed_add_zero_compiles(engine, tmp_path,
+                                               monkeypatch):
+    """ISSUE 13 acceptance: a warm engine serving a wave with
+    APEX_TPU_TRACE=1 and both SLO knobs armed adds ZERO compiles and
+    keeps the recompile counter at 0 — tracing and SLO accounting are
+    pure host bookkeeping.  The trace_span stream is schema-shaped,
+    every trace closes terminal, and the SLO window published burn
+    rates off the live histograms."""
+    monkeypatch.setenv("APEX_TPU_TRACE", "1")
+    monkeypatch.setenv("APEX_TPU_SLO_TTFT_US", "3600000000")
+    monkeypatch.setenv("APEX_TPU_SLO_DECODE_US", "1")
+    reg = MetricsRegistry()
+    jsonl = tmp_path / "telemetry.jsonl"
+    reg.add_sink(JsonlSink(str(jsonl)))
+    tel = ServeTelemetry(reg)              # trace armed from the env
+    assert tel.tracer.sample == 1
+
+    c0 = obs.compile_count()
+    sched = SlotScheduler(engine, telemetry=tel)   # SLO specs from env
+    uids = [sched.submit([1 + i, 2, 3], max_new_tokens=3)
+            for i in range(N_REQUESTS)]
+    out = sched.run()
+    assert obs.compile_count() == c0, \
+        "tracing/SLO accounting must compile NOTHING on a warm engine"
+    assert int(tel.recompiles.total()) == 0
+    assert sorted(out) == sorted(uids)
+
+    # span conservation at the wave boundary
+    sc = tel.tracer.conservation()
+    assert sc["started"] == sc["closed"] == N_REQUESTS
+    assert sc["dangling"] == [] and sc["orphan_terminals"] == []
+
+    # the JSONL stream carries schema-shaped trace spans for every uid
+    events = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    spans = [e for e in events if e["kind"] == "trace_span"]
+    declared = schema.EVENT_FIELDS["trace_span"]
+    assert {e["uid"] for e in spans} == set(uids)
+    for e in spans:
+        assert set(e) == {"ts", "kind"} | set(declared)
+    for uid in uids:
+        names = [e["span"] for e in spans if e["uid"] == uid]
+        assert names[0] == "queued" and names[-1] == "retired"
+        assert "first_token" in names and "decode" in names
+
+    # the wave boundary closed an SLO window: a 1h TTFT target is
+    # never violated, a 1µs decode target always is — burn rates off
+    # the same histograms the lifecycle methods fed
+    assert sched.slo.burn_rate.value(slo="ttft_p99") == 0.0
+    assert sched.slo.burn_rate.value(slo="decode_token_p99") == \
+        pytest.approx(100.0)
+    assert sched.slo.budget_remaining.value(slo="ttft_p99") == 1.0
+    assert any(e["kind"] == "slo_violation"
+               and e["slo"] == "decode_token_p99" for e in events)
+
+
 def test_serve_telemetry_summary_shape(engine):
     tel = ServeTelemetry(MetricsRegistry())
     sched = SlotScheduler(engine, telemetry=tel)
