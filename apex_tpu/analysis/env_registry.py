@@ -119,7 +119,11 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
         default="0",
         effect="profiler capture directory: a path arms observability."
                "profile_capture() — bench legs and examples/generate.py "
-               "drop jax.profiler (TensorBoard/xprof) traces there; 0 "
+               "drop jax.profiler (TensorBoard/xprof) traces there, and "
+               "the main bench leg re-ingests them (trace_ingest) into "
+               "measured attribution stamps; an unwritable or already-"
+               "populated dir degrades to a no-op with a "
+               "profile_skipped event (never shadows an old trace); 0 "
                "disables capture (the context manager is a no-op)",
         read_by="apex_tpu/observability/tracing.py"),
     EnvKnob(

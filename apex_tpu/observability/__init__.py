@@ -42,9 +42,22 @@ code (device scalars resolve one step late via the deferred collector).
                nonfinite — resolved one step late, zero added syncs
     xla_stats  compiled-truth extractor (ISSUE 10): XLA cost/memory
                analysis per executable, provenance-marked degradation
+    trace_ingest  measured-truth ingestion (ISSUE 14): parses the
+               ``trace.json.gz`` streams ``profile_capture()`` drops
+               under ``APEX_TPU_PROFILE_DIR`` into normalized,
+               categorized op events (CLI: ``python -m apex_tpu.
+               observability.trace_ingest <profile_dir>``)
+    attribution  measured per-category time accounting over ingested
+               traces: interval-union category times, exposed comm
+               (collective time NOT hidden by concurrent compute),
+               measured MFU (compiled FLOPs / measured compute time),
+               cross-rank straggler skew; published as ``trace_*``
+               families + the ``attribution`` JSONL event
     report     flight recorder: ``python -m apex_tpu.observability.
                report <run_dir>`` merges events + metrics + compiled
-               stats + comm-model estimates into one run report
+               stats + comm-model estimates + measured attribution
+               into one run report (``--attribution`` for the
+               measured detail view)
 
 Knobs (registered in ``analysis/env_registry.py``):
 
@@ -69,6 +82,7 @@ from __future__ import annotations
 
 import os
 
+from apex_tpu.observability.attribution import attribute, publish
 from apex_tpu.observability.deferred import DeferredScalarCollector
 from apex_tpu.observability.registry import (Counter, Gauge, Histogram,
                                              Metrics, MetricsRegistry,
@@ -88,9 +102,13 @@ from apex_tpu.observability.spans import (RequestTracer,
                                           default_trace_sample)
 from apex_tpu.observability.timers import StepSample, StepTimer, \
     compile_count
+from apex_tpu.observability.trace_ingest import (RankTrace, TraceEvent,
+                                                 load_profile_dirs,
+                                                 parse_trace_file)
 from apex_tpu.observability.tracing import (named_scope, profile_capture,
-                                            profile_dir, start_profile,
-                                            stop_profile,
+                                            profile_dir,
+                                            profile_dir_unusable,
+                                            start_profile, stop_profile,
                                             trace_annotation)
 from apex_tpu.observability.train import TrainTelemetry
 from apex_tpu.observability.xla_stats import (CompiledStats,
@@ -107,7 +125,9 @@ __all__ = [
     "DeferredScalarCollector",
     "StepTimer", "StepSample", "compile_count",
     "trace_annotation", "named_scope", "profile_capture", "profile_dir",
-    "start_profile", "stop_profile",
+    "profile_dir_unusable", "start_profile", "stop_profile",
+    "TraceEvent", "RankTrace", "parse_trace_file", "load_profile_dirs",
+    "attribute", "publish",
     "ServeTelemetry", "TrainTelemetry",
     "RequestTracer", "default_trace_sample",
     "SLOSpec", "SLOTracker", "OverloadDetector", "slo_specs_from_env",

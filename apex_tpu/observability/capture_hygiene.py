@@ -13,7 +13,8 @@ from __future__ import annotations
 import math
 
 __all__ = ["MAX_PLAUSIBLE_SPEEDUP", "MAX_PLAUSIBLE_TOKENS_PER_S",
-           "MAX_PLAUSIBLE_LATENCY_US", "is_us_key", "is_tokens_per_s_key",
+           "MAX_PLAUSIBLE_LATENCY_US", "MAX_PLAUSIBLE_MFU",
+           "is_us_key", "is_tokens_per_s_key", "is_mfu_key",
            "hbm_capacity_bound", "scrub_capture_values"]
 
 #: capture-hygiene bounds: a measured duration of exactly 0.0 µs means
@@ -41,6 +42,14 @@ MAX_PLAUSIBLE_TOKENS_PER_S = 1e8
 #: negatives are clock-skew garbage, 0.0 the RTT-collapse artifact.
 MAX_PLAUSIBLE_LATENCY_US = 3.6e9
 
+#: MFU sanity ceiling (ISSUE 14: the measured-attribution stamps add
+#: ``measured_mfu`` next to the model-derived ``mfu``/``mfu_compiled``).
+#: A model-FLOP utilisation above 1.0 is not physics — it is a wrong
+#: FLOP count, a wrong chip spec, or the us==0.0 RTT-collapse artifact
+#: wearing its throughput face (flops / ~0 s); 0 and negatives are the
+#: same artifact's other side.
+MAX_PLAUSIBLE_MFU = 1.0
+
 
 def is_us_key(key: str) -> bool:
     return key == "us" or key.endswith("_us") or key.startswith("us_")
@@ -48,6 +57,10 @@ def is_us_key(key: str) -> bool:
 
 def is_tokens_per_s_key(key: str) -> bool:
     return key == "tokens_per_s" or key.endswith("_tokens_per_s")
+
+
+def is_mfu_key(key: str) -> bool:
+    return key == "mfu" or key.endswith("_mfu") or key.startswith("mfu_")
 
 
 def hbm_capacity_bound(obj: dict) -> int:
@@ -73,7 +86,10 @@ def scrub_capture_values(obj):
     (covers the telemetry TTFT / decode-latency fields),
     ``*_speedup`` fields above :data:`MAX_PLAUSIBLE_SPEEDUP`,
     ``*tokens_per_s`` throughputs that are non-positive or beyond
-    :data:`MAX_PLAUSIBLE_TOKENS_PER_S`, and the ISSUE-10
+    :data:`MAX_PLAUSIBLE_TOKENS_PER_S`, ``mfu``/``*_mfu``/``mfu_*``
+    utilisations outside ``(0, 1]`` (ISSUE 14: covers the measured
+    ``measured_mfu`` stamp — the ``*_us`` rule already bounds the
+    measured attributed times at (0, 1 h]), and the ISSUE-10
     compiled-truth stamps — ``compiled_flops`` must be positive and
     ``compiled_peak_hbm_bytes`` must be positive and fit the chip's
     HBM (the ``chip`` field in the same dict selects the bound).
@@ -97,6 +113,8 @@ def scrub_capture_values(obj):
                     continue
                 if is_tokens_per_s_key(k) \
                         and not 0.0 < v <= MAX_PLAUSIBLE_TOKENS_PER_S:
+                    continue
+                if is_mfu_key(k) and not 0.0 < v <= MAX_PLAUSIBLE_MFU:
                     continue
                 if k == "compiled_flops" and v <= 0:
                     continue

@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["parse_prometheus", "percentile", "histogram_quantile",
            "build_report", "render_markdown", "build_traces",
-           "render_traces_markdown", "main"]
+           "render_traces_markdown", "build_attribution",
+           "render_attribution_markdown", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -406,6 +407,63 @@ def _slo_section(events: list, families: dict) -> Optional[dict]:
     return out
 
 
+#: attribution-event scalar keys copied verbatim into the measured
+#: section / detail view (render order).
+_MEASURED_KEYS = ("provenance", "ranks", "steps", "window_us",
+                  "step_us", "busy_us", "host_gap_us", "compute_us",
+                  "exposed_comm_us", "model_exposed_comm_us",
+                  "exposed_comm_drift_ratio", "mfu", "mfu_provenance",
+                  "coverage")
+
+
+def _measured_section(events: list, families: dict) -> Optional[dict]:
+    """The ISSUE 14 measured leg: the latest ``attribution`` event's
+    record (per-category times, exposed comm, measured MFU, skew),
+    falling back to the ``trace_*`` prom families when the JSONL was
+    lost.  Returns None when the run carried no measured signal at all
+    — every pre-PR-14 run dir renders byte-identically (the
+    back-compat golden pins it).  A degraded record keeps ONLY its
+    ``unavailable:`` provenance — the marker renders, never zeros."""
+    attrs = [e for e in events if e.get("kind") == "attribution"]
+    has_fams = any(f.startswith("trace_") for f in families)
+    if not (attrs or has_fams):
+        return None
+    out: dict = {"captures": len(attrs)}
+    if attrs:
+        a = attrs[-1]
+        for k in _MEASURED_KEYS:
+            if a.get(k) is not None:
+                out[k] = a[k]
+        for k in ("categories", "collectives", "skew"):
+            v = a.get(k)
+            if v:
+                out[k] = v
+        return out
+    for key, fam in (("window_us", "trace_window_us"),
+                     ("step_us", "trace_step_time_us"),
+                     ("mfu", "trace_mfu"),
+                     ("exposed_comm_us", "trace_exposed_comm_us")):
+        v = _family_total(families, fam)
+        if v is not None:
+            out[key] = v
+    cats = _family_by_label(families, "trace_category_time_us",
+                            "category")
+    if cats:
+        out["categories"] = dict(sorted(cats.items()))
+    skew: dict = {}
+    v = _family_total(families, "trace_rank_step_skew")
+    if v is not None:
+        skew["slowest_over_median"] = v
+    spread = _family_by_label(families,
+                              "trace_collective_start_spread_us",
+                              "collective")
+    if spread:
+        skew["collective_start_spread_us"] = dict(sorted(spread.items()))
+    if skew:
+        out["skew"] = skew
+    return out
+
+
 def _attribution_section(stats: Optional[dict],
                          budget: Optional[dict]) -> Optional[dict]:
     """Estimate-vs-compiled table: one row per executable, merged from
@@ -488,9 +546,66 @@ def build_report(events: list, prom_text: str,
         "numerics": _numerics_section(events, families),
         "serve": _serve_section(events, families),
         "slo": _slo_section(events, families),
+        "measured": _measured_section(events, families),
         "compiled_attribution": _attribution_section(stats, budget),
     }
     return {k: v for k, v in out.items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# measured-attribution tables + detail view (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def _measured_tables(rec: dict) -> List[str]:
+    """The category / collective / skew tables shared by the report's
+    Measured-attribution section and the ``--attribution`` detail
+    view (deterministic: sorted keys, ``_f`` formatting)."""
+    lines: List[str] = []
+    cats = rec.get("categories")
+    if cats:
+        lines += ["", "| category | time_us |", "|---|---|"]
+        for cat in sorted(cats):
+            lines.append(f"| {cat} | {_f(cats[cat])} |")
+    colls = rec.get("collectives")
+    if colls:
+        lines += ["", "| collective | time_us | count |", "|---|---|---|"]
+        for kind in sorted(colls):
+            c = colls[kind] or {}
+            lines.append(f"| {kind} | {_f(c.get('time_us'))} "
+                         f"| {_f(c.get('count'))} |")
+    skew = rec.get("skew")
+    if skew:
+        lines.append("")
+        lines.append(f"- **skew.slowest_over_median**: "
+                     f"{_f(skew.get('slowest_over_median'))}"
+                     + (f" (rank {_f(skew['slowest_rank'])})"
+                        if skew.get("slowest_rank") is not None else ""))
+        per = skew.get("per_rank_window_us")
+        if per:
+            lines.append("- **skew.per_rank_window_us**: "
+                         + ", ".join(_f(w) for w in per))
+        spread = skew.get("collective_start_spread_us")
+        if spread:
+            lines.append("- **skew.collective_start_spread_us**: "
+                         + ", ".join(f"{k}={_f(v)}"
+                                     for k, v in sorted(spread.items())))
+    return lines
+
+
+def build_attribution(events: list) -> List[dict]:
+    """Every ``attribution`` event in the run, oldest first (one per
+    ingested capture)."""
+    return [e for e in events if e.get("kind") == "attribution"]
+
+
+def render_attribution_markdown(attrs: List[dict]) -> str:
+    lines = ["# apex_tpu measured attribution", ""]
+    for i, a in enumerate(attrs):
+        lines += [f"## capture {i} — {a.get('profile_dir', '?')}", ""]
+        lines += _kv_lines(a, _MEASURED_KEYS)
+        lines += _measured_tables(a)
+        lines.append("")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
@@ -568,9 +683,12 @@ def render_traces_markdown(traces: List[dict]) -> str:
 
 def _f(v, digits: int = 6) -> str:
     """Deterministic number formatting: ints stay integral, floats get
-    ``digits`` significant digits, None renders an em-dash."""
+    ``digits`` significant digits, None renders an em-dash, strings
+    (provenance markers) pass through."""
     if v is None:
         return "—"
+    if isinstance(v, str):
+        return v
     if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
         v = int(v)
     if isinstance(v, int):
@@ -737,6 +855,14 @@ def render_markdown(report: dict) -> str:
                 f"{k}={_f(v)}" for k, v in sorted(sb.items())))
         lines.append("")
 
+    measured = report.get("measured")
+    if measured:
+        lines += ["## Measured attribution", ""]
+        lines += _kv_lines(measured,
+                           ("provenance", "captures") + _MEASURED_KEYS[1:])
+        lines += _measured_tables(measured)
+        lines.append("")
+
     attr = report.get("compiled_attribution")
     if attr:
         lines += ["## Compiled truth vs analytic estimates", "",
@@ -795,6 +921,12 @@ def main(argv=None) -> int:
                    help="render the per-request waterfall for this "
                         "uid's trace_span events instead of the run "
                         "report")
+    p.add_argument("--attribution", action="store_true",
+                   dest="attribution",
+                   help="render the measured-attribution detail view "
+                        "(every ingested profiler capture's category/"
+                        "collective/skew tables) instead of the run "
+                        "report")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the report as JSON instead of markdown")
     p.add_argument("--out", default=None,
@@ -847,6 +979,18 @@ def main(argv=None) -> int:
             text = json.dumps(traces, indent=1, sort_keys=True) + "\n"
         else:
             text = render_traces_markdown(traces)
+    elif args.attribution:
+        attrs = build_attribution(events)
+        if not attrs:
+            print("report: no attribution events in this run (arm "
+                  "APEX_TPU_PROFILE_DIR so a capture is ingested, or "
+                  "run python -m apex_tpu.observability.trace_ingest "
+                  "on the profile dir)", file=sys.stderr)
+            return 1
+        if args.as_json:
+            text = json.dumps(attrs, indent=1, sort_keys=True) + "\n"
+        else:
+            text = render_attribution_markdown(attrs)
     elif args.as_json:
         report = build_report(events, prom_text,
                               stats=_load_json(args.stats),

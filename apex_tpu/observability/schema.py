@@ -267,6 +267,39 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("train_loss_scale_growths_total", "counter",
                "dynamic loss-scale doublings (growth-interval growths) "
                "seen in the resolved loss-scale series"),
+    # -- measured attribution (ISSUE 14): profiler-trace ingestion.
+    #    Set only when a capture was ingested — a run with no trace
+    #    exposes none of these (the unavailable: marker rides the
+    #    attribution event instead; never a fabricated zero).
+    MetricSpec("trace_window_us", "gauge",
+               "measured profiler-trace extent (µs): first attributed "
+               "op start to last op end across the ingested capture "
+               "(slowest rank when several merge)"),
+    MetricSpec("trace_step_time_us", "gauge",
+               "measured per-step wall time (µs): trace window / the "
+               "caller-supplied dispatch count"),
+    MetricSpec("trace_mfu", "gauge",
+               "measured MFU: compiled FLOPs × steps / measured "
+               "compute time / chip peak (train_mfu divides by step "
+               "WALL time instead)"),
+    MetricSpec("trace_exposed_comm_us", "gauge",
+               "measured exposed collective time (µs): collective "
+               "intervals NOT covered by concurrent compute over the "
+               "trace window (interval-overlap math)"),
+    MetricSpec("trace_category_time_us", "gauge",
+               "wall time attributed to each op category over the "
+               "trace window (per-category interval union, µs; "
+               "host_gap = window minus busy)",
+               labels=("category",)),
+    MetricSpec("trace_rank_step_skew", "gauge",
+               "slowest/median rank trace-window ratio across merged "
+               "ranks (the straggler indicator; absent on single-rank "
+               "captures)"),
+    MetricSpec("trace_collective_start_spread_us", "gauge",
+               "max cross-rank start-time spread per collective type "
+               "(µs; k-th occurrence of the type, starts rebased to "
+               "each rank's first op)",
+               labels=("collective",)),
 ]}
 
 #: JSONL event stream: ``{"ts": float, "kind": str, ...kind fields}``.
@@ -319,6 +352,27 @@ EVENT_FIELDS: Dict[str, Dict[str, str]] = {
                          "nonfinite_elems": "float", "leaves": "list"},
     "profile_start": {"dir": "str", "tag": "str"},
     "profile_stop": {"dir": "str", "tag": "str"},
+    # profile_capture hardening (ISSUE 14 satellite): an ARMED capture
+    # that degraded to a no-op (stale/unwritable dir) instead of
+    # silently shadowing an old trace.
+    "profile_skipped": {"dir": "str", "tag": "str", "reason": "str"},
+    # measured attribution (ISSUE 14): one event per ingested profiler
+    # capture — the full record (per-category µs in ``categories``,
+    # per-type collectives, cross-rank skew); absent measurements are
+    # null next to the provenance marker, never zero.
+    "attribution": {"profile_dir": "str", "provenance": "str",
+                    "ranks": "int", "window_us": "float|null",
+                    "busy_us": "float|null",
+                    "host_gap_us": "float|null",
+                    "compute_us": "float|null",
+                    "exposed_comm_us": "float|null",
+                    "coverage": "float|null", "steps": "int|null",
+                    "step_us": "float|null", "mfu": "float|null",
+                    "mfu_provenance": "str|null",
+                    "model_exposed_comm_us": "float|null",
+                    "exposed_comm_drift_ratio": "float|null",
+                    "categories": "object", "collectives": "object",
+                    "skew": "object|null"},
 }
 
 COMMON_EVENT_FIELDS: Dict[str, str] = {"ts": "float", "kind": "str"}

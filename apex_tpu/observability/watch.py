@@ -17,9 +17,20 @@ Mechanics:
 
 * **measurement vs context** — a field is a measurement only if its
   name matches a known direction: lower-is-better (``*_us`` /
-  ``us_*`` latencies, ``*sec_per_step``) or higher-is-better
-  (``*tokens_per_s``/``*tokens_per_sec*``, ``*_gbps``, ``mfu*``/
-  ``*_mfu``, ``*_roofline``, ``*_speedup``, ``*_tflops``).  Every
+  ``us_*`` latencies, ``*sec_per_step``, and ``*_drift_ratio`` —
+  the ISSUE 14 measured-vs-model exposed-comm drift, where a
+  widening gap means the overlap model is losing touch with the
+  hardware and must fail the watch like any latency regression.
+  Lower-is-better is sound for this measured/model ratio because
+  the model term is a pure function of the series' shape/knob
+  context — constant WITHIN a comparability group — so the ratio
+  trends measured exposure alone) or
+  higher-is-better (``*tokens_per_s``/``*tokens_per_sec*``,
+  ``*_gbps``, ``mfu*``/``*_mfu``, ``*_roofline``, ``*_speedup``,
+  ``*_tflops``).  The measured-attribution stamps
+  (``measured_window_us``/``measured_step_us``/
+  ``measured_exposed_comm_us``/``measured_mfu``) trend through the
+  same rules — the model-vs-measured drift table IS these rows.  Every
   other scalar (shapes, knob stamps like ``xent_chunk`` /
   ``infer_page_size``, element counts) is CONTEXT: two captures are
   comparable for metric ``m`` only when the context fields sharing
@@ -70,7 +81,8 @@ def metric_direction(key: str) -> Optional[str]:
     """``"lower"`` / ``"higher"`` for measurement fields, ``None`` for
     context (shapes, knob stamps, counts)."""
     base = key[:-len("_median")] if key.endswith("_median") else key
-    if is_us_key(base) or base.endswith("sec_per_step"):
+    if is_us_key(base) or base.endswith("sec_per_step") \
+            or base.endswith("_drift_ratio"):
         return "lower"
     if (is_tokens_per_s_key(base) or "tokens_per_s" in base
             or base.endswith("_gbps") or base == "mfu"

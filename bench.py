@@ -462,6 +462,64 @@ def _stamp_step_time_model(extras: dict, jaxpr_thunk, mesh_axes) -> None:
         traceback.print_exc()
 
 
+def _stamp_measured_attribution(extras: dict, capture_dir: str,
+                                steps: int) -> None:
+    """Stamp the MEASURED attribution (ISSUE 14) into a capture when a
+    profiler trace was armed: ingest the ``trace.json.gz`` the leg's
+    ``profile_capture()`` just dropped under ``capture_dir``, attribute
+    the window into op categories, and stamp the fields the watch
+    trends — ``measured_window_us`` / ``measured_step_us`` /
+    ``measured_compute_us`` / ``measured_exposed_comm_us`` (only when
+    collectives were actually observed; the hygiene scrub drops
+    non-positive ``_us`` values) / ``measured_mfu`` (compiled FLOPs ÷
+    measured compute time) / ``exposed_comm_drift_ratio`` (measured
+    per-step exposed comm ÷ ``exposed_comm_model_us``, the
+    model-vs-measured comparison).  ``steps`` is the number of step
+    executions inside the captured window ((1 + reps) dispatches of
+    the iters-long scan).
+
+    The provenance marker ALWAYS lands: ``measured:trace`` on a
+    healthy ingest, ``unavailable:<reason>`` when the trace is
+    missing/malformed — never fabricated zeros.  The record is also
+    published to the telemetry registry (``trace_*`` gauges + the
+    ``attribution`` JSONL event) when sinks are armed."""
+    try:
+        from apex_tpu.observability import attribution, trace_ingest
+        rec = attribution.attribute(
+            trace_ingest.load_profile_dirs([capture_dir]),
+            steps=steps,
+            flops_per_step=extras.get("compiled_flops"),
+            device_kind=extras.get("chip"),
+            model_exposed_comm_us=extras.get("exposed_comm_model_us"))
+        attribution.publish(rec, profile_dir=capture_dir)
+        extras["measured_attribution_provenance"] = rec["provenance"]
+        # NOTE: no non-metric floats here (e.g. coverage) — a scalar
+        # without a watch direction becomes comparability CONTEXT and
+        # a run-varying one would fork every measured_* series
+        for src, dst in (("window_us", "measured_window_us"),
+                         ("step_us", "measured_step_us"),
+                         ("compute_us", "measured_compute_us")):
+            v = rec.get(src)
+            if v is not None:
+                extras[dst] = v
+        # zero-valued measurements are withheld from the capture: the
+        # hygiene scrub drops 0 µs on arrival anyway, and a 0.0 drift
+        # ratio would become the watch's unbeatable best-prior (ratio
+        # None -> the series never regresses again); the full record
+        # incl. honest zeros rides the attribution JSONL event instead
+        for src, dst in (("exposed_comm_us", "measured_exposed_comm_us"),
+                         ("mfu", "measured_mfu"),
+                         ("exposed_comm_drift_ratio",
+                          "exposed_comm_drift_ratio")):
+            v = rec.get(src)
+            if v:
+                extras[dst] = v
+    except Exception:  # noqa: BLE001 — the stamp is auxiliary
+        traceback.print_exc()
+        extras["measured_attribution_provenance"] = \
+            "unavailable:ingest-failed"
+
+
 def _zero_train_setup(loss_fn, tx, params, batch_specs, batch):
     """Shared ``--override zero=1`` machinery for the main/bert/llama
     legs: a ZeRO dp-sharded train step over a ``data`` mesh of the
@@ -1344,7 +1402,8 @@ def _bench_main(force_cpu: bool = False) -> None:
     # Fused leg is THE metric: hard-fail (after retries) if it can't run.
     # APEX_TPU_PROFILE_DIR=<dir> captures a jax.profiler trace of it.
     from apex_tpu.observability import profile_capture
-    with profile_capture(tag="bench_main_fused"):
+    from apex_tpu.observability.tracing import profile_dir as _prof_dir
+    with profile_capture(tag="bench_main_fused") as profiled:
         t_fused = _bench_loop(fused_step, fused_state, batch_args, iters,
                               rtt, shard=zero_shard)
     # Baseline + microbench legs are auxiliary: degrade to null.
@@ -1403,6 +1462,21 @@ def _bench_main(force_cpu: bool = False) -> None:
             extras["compiled_peak_hbm_bytes"] = int(stats.peak_hbm_bytes)
     except Exception:  # noqa: BLE001 — the stamp is auxiliary
         traceback.print_exc()
+    # measured-attribution stamp (ISSUE 14): when the profiler was
+    # armed, attribute the captured window into op categories and
+    # stamp the measured step/compute/exposed-comm/MFU fields next to
+    # their model/compiled counterparts.  An armed-but-skipped capture
+    # (stale dir) still stamps its unavailable: marker — the capture
+    # says WHY there is no measurement instead of omitting it.
+    if _prof_dir() is not None:
+        if profiled:
+            # the captured window saw the compile/warm dispatch plus
+            # _REPS timed dispatches, each an iters-long scan
+            _stamp_measured_attribution(extras, _prof_dir(),
+                                        steps=(1 + _REPS) * iters)
+        else:
+            extras["measured_attribution_provenance"] = \
+                "unavailable:capture-skipped"
     if _OVERRIDES:
         extras["overrides"] = dict(_OVERRIDES)   # capture self-describes
     print(json.dumps({

@@ -9,7 +9,8 @@ from pathlib import Path
 
 from apex_tpu.observability.report import (build_report,
                                            histogram_quantile, main,
-                                           parse_prometheus, percentile)
+                                           parse_prometheus, percentile,
+                                           render_markdown)
 
 FIXTURE = Path(__file__).parent / "fixtures" / "flight_run"
 
@@ -281,6 +282,132 @@ def test_pre_pr13_run_dirs_have_no_slo_section(capsys):
     main([str(NUMERICS_FIXTURE)])
     assert "## SLO" not in capsys.readouterr().out
     assert "slo" not in build_report(
+        [], (FIXTURE / "metrics.prom").read_text(encoding="utf-8"))
+
+
+# -- measured attribution (ISSUE 14) ----------------------------------------
+
+MEASURED_FIXTURE = Path(__file__).parent / "fixtures" / \
+    "flight_run_measured"
+ALL_PRE_PR14_FIXTURES = (FIXTURE, NUMERICS_FIXTURE, SLO_FIXTURE)
+
+
+def test_measured_golden_markdown_byte_stable(tmp_path, capsys):
+    """A run whose profiler capture was ingested renders the Measured
+    attribution section — category/collective tables, skew, the
+    model-vs-measured drift — and the committed golden reproduces
+    byte-for-byte."""
+    out = tmp_path / "report.md"
+    assert main([str(MEASURED_FIXTURE), "--out", str(out)]) == 0
+    capsys.readouterr()
+    got = out.read_text(encoding="utf-8")
+    assert got == (MEASURED_FIXTURE / "expected_report.md").read_text(
+        encoding="utf-8"), (
+        "the measured flight-recorder markdown drifted from the "
+        "committed golden — if intentional, regenerate "
+        "expected_report.md with the report CLI and commit it")
+    assert "## Measured attribution" in got
+    assert "measured:trace" in got
+    assert "skew.slowest_over_median" in got
+
+
+def test_measured_json_section_shape(capsys):
+    assert main([str(MEASURED_FIXTURE), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    m = report["measured"]
+    assert m["provenance"] == "measured:trace"
+    assert m["ranks"] == 2 and m["captures"] == 1
+    assert m["window_us"] == 160.0 and m["step_us"] == 80.0
+    assert m["exposed_comm_us"] == 30.0
+    assert m["model_exposed_comm_us"] == 10.0
+    assert m["exposed_comm_drift_ratio"] == 1.5
+    assert m["mfu"] == 0.249902
+    assert m["categories"]["dot"] == 100.0
+    assert m["skew"]["collective_start_spread_us"]["all_gather"] == 12.0
+
+
+def test_attribution_detail_view_golden(tmp_path, capsys):
+    """`report --attribution`: the per-capture detail view reproduces
+    its committed golden byte-for-byte."""
+    out = tmp_path / "attribution.md"
+    assert main([str(MEASURED_FIXTURE), "--attribution",
+                 "--out", str(out)]) == 0
+    capsys.readouterr()
+    got = out.read_text(encoding="utf-8")
+    assert got == (MEASURED_FIXTURE /
+                   "expected_attribution.md").read_text(
+        encoding="utf-8"), (
+        "the attribution detail-view markdown drifted from the "
+        "committed golden — if intentional, regenerate "
+        "expected_attribution.md with `report --attribution --out "
+        "...` and commit it")
+    for needle in ("| dot | 100 |", "| all_gather | 28 | 1 |",
+                   "skew.per_rank_window_us**: 130, 160"):
+        assert needle in got, needle
+
+
+def test_attribution_view_json_and_missing(capsys):
+    assert main([str(MEASURED_FIXTURE), "--attribution", "--json"]) == 0
+    [ev] = json.loads(capsys.readouterr().out)
+    assert ev["kind"] == "attribution"
+    assert ev["collectives"]["reduce_scatter"]["time_us"] == 20.0
+    # a run with no ingested capture fails loudly, naming the knob
+    assert main([str(FIXTURE), "--attribution"]) == 1
+    assert "APEX_TPU_PROFILE_DIR" in capsys.readouterr().err
+
+
+def test_measured_section_prom_fallback():
+    """A run whose JSONL was lost but whose prom snapshot survived:
+    the measured summary falls back to the trace_* families."""
+    from apex_tpu.observability import MetricsRegistry, render_prometheus
+    reg = MetricsRegistry()
+    reg.declared("trace_window_us").set(160.0)
+    reg.declared("trace_mfu").set(0.25)
+    reg.declared("trace_category_time_us").set(100.0, category="dot")
+    reg.declared("trace_rank_step_skew").set(1.23)
+    m = build_report([], render_prometheus(reg))["measured"]
+    assert m["captures"] == 0
+    assert m["window_us"] == 160.0 and m["mfu"] == 0.25
+    assert m["categories"] == {"dot": 100.0}
+    assert m["skew"]["slowest_over_median"] == 1.23
+
+
+def test_degraded_attribution_renders_marker_not_zeros(capsys):
+    """The acceptance contract: a run whose armed capture degraded
+    renders the unavailable: marker and NO fabricated numbers."""
+    events = [{"ts": 1.0, "kind": "attribution",
+               "profile_dir": "/tmp/p",
+               "provenance": "unavailable:no-trace-files", "ranks": 0,
+               "window_us": None, "busy_us": None, "host_gap_us": None,
+               "compute_us": None, "exposed_comm_us": None,
+               "coverage": None, "steps": None, "step_us": None,
+               "mfu": None, "mfu_provenance": None,
+               "model_exposed_comm_us": None,
+               "exposed_comm_drift_ratio": None, "categories": {},
+               "collectives": {}, "skew": None}]
+    report = build_report(events, "")
+    m = report["measured"]
+    assert m["provenance"] == "unavailable:no-trace-files"
+    for key in ("window_us", "mfu", "exposed_comm_us", "categories"):
+        assert key not in m, key
+    md = render_markdown(report)
+    assert "unavailable:no-trace-files" in md
+    assert "**window_us**" not in md
+
+
+def test_pre_pr14_run_dirs_render_byte_identically(capsys):
+    """Back-compat satellite: every pre-PR-14 golden run dir —
+    committed before measured attribution existed — renders NO
+    Measured-attribution section and reproduces its committed golden
+    byte-for-byte when no trace is present."""
+    for fixture in ALL_PRE_PR14_FIXTURES:
+        args = _fixture_args() if fixture is FIXTURE else [str(fixture)]
+        assert main(args) == 0
+        got = capsys.readouterr().out
+        assert "## Measured attribution" not in got, fixture.name
+        assert got == (fixture / "expected_report.md").read_text(
+            encoding="utf-8"), fixture.name
+    assert "measured" not in build_report(
         [], (FIXTURE / "metrics.prom").read_text(encoding="utf-8"))
 
 

@@ -61,6 +61,26 @@ def test_span_and_slo_families_are_pinned():
     assert "serve_requests_shed_total" in committed["prometheus"]
 
 
+def test_measured_attribution_families_are_pinned():
+    """ISSUE 14 satellite: the committed schema re-pin covers every
+    family and event the trace-ingestion/attribution layer emits — a
+    new measured family cannot ship unpinned."""
+    from apex_tpu.observability import attribution, tracing
+    committed = json.loads((REPO / schema.SCHEMA_NAME).read_text())
+    for fam in attribution.ATTRIBUTION_METRIC_FAMILIES:
+        assert fam in committed["prometheus"], fam
+        assert fam in schema.METRIC_SPECS, fam
+    for kind in attribution.ATTRIBUTION_EVENTS + tracing.PROFILE_EVENTS:
+        assert kind in committed["jsonl"]["events"], kind
+        assert kind in schema.EVENT_FIELDS, kind
+    # the attribution event keeps its nullable measurement fields next
+    # to the provenance marker (null is the explicit absence)
+    fields = committed["jsonl"]["events"]["attribution"]
+    assert fields["provenance"] == "str"
+    assert fields["window_us"] == "float|null"
+    assert fields["mfu"] == "float|null"
+
+
 def test_histogram_buckets_are_sorted_positive():
     """Non-physical bucket layouts (unsorted, non-positive bounds) are
     schema bugs — latencies cannot be <= 0."""

@@ -91,12 +91,42 @@ def test_metric_direction_classifier():
     assert watch.metric_direction("bert_mfu") == "higher"
     assert watch.metric_direction("adam_roofline") == "higher"
     assert watch.metric_direction("flash_attn_speedup") == "higher"
+    # ISSUE 14: the measured-attribution stamps trend too — the
+    # model-vs-measured drift ratio is lower-is-better (a widening
+    # exposed-comm gap is a regression), measured MFU higher
+    assert watch.metric_direction("exposed_comm_drift_ratio") == "lower"
+    assert watch.metric_direction("measured_step_us") == "lower"
+    assert watch.metric_direction("measured_exposed_comm_us") == "lower"
+    assert watch.metric_direction("measured_mfu") == "higher"
     # context, not measurements: shapes, knob stamps, SLO targets
     assert watch.metric_direction("infer_shape") is None
     assert watch.metric_direction("xent_chunk") is None
     assert watch.metric_direction("infer_slo_ttft") is None
     assert watch.metric_direction("infer_trace") is None
     assert watch.metric_direction("adam_nelem") is None
+    assert watch.metric_direction("measured_attribution_provenance") \
+        is None
+
+
+def test_widening_exposed_comm_drift_fails_the_watch(tmp_path):
+    """ISSUE 14 acceptance: the measured-vs-model exposed-comm drift
+    table trends across captures — a widening gap (overlap the model
+    claims but the hardware no longer delivers) fails the watch like
+    any latency regression."""
+    _write(tmp_path, "r1_a.json",
+           {"_leg": "x", "backend": "tpu",
+            "measured_attribution_provenance": "measured:trace",
+            "measured_step_us": 80.0,
+            "exposed_comm_drift_ratio": 1.1})
+    _write(tmp_path, "r2_a.json",
+           {"_leg": "x", "backend": "tpu",
+            "measured_attribution_provenance": "measured:trace",
+            "measured_step_us": 82.0,
+            "exposed_comm_drift_ratio": 1.6})    # gap widened 1.45x
+    res = watch.analyze(str(tmp_path))
+    by_metric = {r["metric"]: r for r in res["rows"]}
+    assert by_metric["exposed_comm_drift_ratio"]["status"] == "regressed"
+    assert by_metric["measured_step_us"]["status"] == "ok"
 
 
 def test_shape_or_knob_change_starts_a_fresh_series(tmp_path):
