@@ -223,6 +223,46 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "into infer bench captures as infer_slo_decode (µs)",
         read_by="apex_tpu/observability/slo.py"),
     EnvKnob(
+        name="APEX_TPU_DECODE_FUSION",
+        default="0",
+        effect="fused transformer-block decode for paged engines: 1 "
+               "lowers every decode-layer as ONE Pallas kernel (norm "
+               "+ qkv + RoPE + paged attention incl. the current "
+               "token + out-proj + MLP; weights resident in VMEM, "
+               "activations never round-trip HBM between sublayers), "
+               "0 (default) keeps the per-op XLA path bitwise, auto "
+               "fuses when the per-slot window reaches "
+               "APEX_TPU_FUSION_MIN_PAGES pages; resolved STATICALLY "
+               "at engine construction (one decode executable either "
+               "way); per-engine override: InferenceEngine("
+               "decode_fusion=); stamped into paged infer bench "
+               "captures as infer_decode_fusion",
+        read_by="apex_tpu/ops/paged_attention.py"),
+    EnvKnob(
+        name="APEX_TPU_FUSION_MIN_PAGES",
+        default="8",
+        effect="auto-mode crossover for APEX_TPU_DECODE_FUSION: fuse "
+               "the decode block when max_pages_per_slot is at least "
+               "this many pages (PROVISIONAL, stamped into paged "
+               "infer bench captures as infer_fusion_min_pages); "
+               "per-engine override: InferenceEngine("
+               "fusion_min_pages=)",
+        read_by="apex_tpu/ops/paged_attention.py"),
+    EnvKnob(
+        name="APEX_TPU_SPEC_K",
+        default="0",
+        effect="speculative decoding: drafted tokens per decode round "
+               "(0 = off).  Engines built with spec_k > 0 serve "
+               "decode through ONE compiled verify executable per k "
+               "(slab width k+1 is static) scoring all drafts + the "
+               "bonus token in one batched paged-attention step; "
+               "accept/reject is an in-program length rollback "
+               "(pages already reserved, rejection releases "
+               "nothing).  Per-engine override: InferenceEngine("
+               "spec_k=); stamped into infer bench captures as "
+               "infer_spec_k",
+        read_by="apex_tpu/inference/speculative.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

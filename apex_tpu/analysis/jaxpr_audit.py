@@ -240,6 +240,57 @@ def _builders():
         return (fn, (cache, params, s((4,), jnp.int32), s((4,), bool),
                      key, s((), jnp.int32)))
 
+    def fused_block_decode_op():
+        # the ISSUE 15 fused transformer-block decode kernel at an
+        # op-level GPT-shaped fixture (LN + qkv + paged attention incl.
+        # the current token + out proj + MLP in ONE pallas_call): the
+        # kernel body's precision discipline is audited directly, the
+        # whole-executable twin below covers the engine lowering
+        from apex_tpu.ops.paged_attention import fused_block_decode as op
+        hidden, heads, d, ps, mpps, slots = 64, 4, 16, 16, 4, 2
+        hd = heads * d
+        blk = {
+            "ln1_w": s((1, hidden), bf16), "ln1_b": s((1, hidden), bf16),
+            "wq": s((hidden, hd), bf16), "bq": s((1, hd), bf16),
+            "wk": s((hidden, hd), bf16), "bk": s((1, hd), bf16),
+            "wv": s((hidden, hd), bf16), "bv": s((1, hd), bf16),
+            "wo": s((hd, hidden), bf16), "bo": s((1, hidden), bf16),
+            "ln2_w": s((1, hidden), bf16), "ln2_b": s((1, hidden), bf16),
+            "wu": s((hidden, 4 * hidden), bf16),
+            "bu": s((1, 4 * hidden), bf16),
+            "wd": s((4 * hidden, hidden), bf16),
+            "bd": s((1, hidden), bf16),
+        }
+        pages = s((9, heads, ps, d), bf16)
+        return (lambda x, b, kp, vp, pt, ln: op(
+                    x, b, kp, vp, pt, ln, kind="gpt", eps=1e-5),
+                (s((slots, hidden), bf16), blk, pages, pages,
+                 s((slots, mpps), jnp.int32), s((slots,), jnp.int32)))
+
+    def inference_decode_fused_paged():
+        # the fused-block decode EXECUTABLE (APEX_TPU_DECODE_FUSION=1
+        # lowering of the one donated decode step): same signature and
+        # output pins as the per-op twin, params operand = (tree,
+        # fused layout)
+        from apex_tpu.inference import models
+        from apex_tpu.inference.engine import make_decode_fn
+        cfg, sampling, params, cache, key = _paged_engine_audit_pieces()
+        fused = jax.eval_shape(
+            lambda p: models.fused_layer_params("gpt", cfg, p), params)
+        fn = make_decode_fn("gpt", cfg, sampling, fused=True)
+        return (fn, (cache, (params, fused), s((4,), jnp.int32),
+                     s((4,), bool), key, s((), jnp.int32)))
+
+    def inference_verify_paged():
+        # the speculative verify step (ISSUE 15): k drafts + bonus
+        # scored in one batched executable, lengths advanced by the
+        # accepted count in-program (the rollback)
+        from apex_tpu.inference.engine import make_verify_fn
+        cfg, sampling, params, cache, key = _paged_engine_audit_pieces()
+        fn = make_verify_fn("gpt", cfg, sampling, k=4)
+        return (fn, (cache, params, s((4, 5), jnp.int32),
+                     s((4,), bool), key, s((), jnp.int32)))
+
     def inference_cow_page():
         # the ISSUE 12 copy-on-write barrier: one page duplicated
         # inside the donated pool — audited for precision/transfer
@@ -304,6 +355,29 @@ def _builders():
                                    ("bfloat16", "bfloat16", "int32",
                                     "int32", "int32", "int32",
                                     "float32", "bool"), None),
+        # ISSUE 15: the fused-block kernel (op-level; measured entry
+        # upcasts = 11: the norm gains/biases and the projection/MLP
+        # biases applied in fp32 by design — layer_norm's budget-2
+        # pattern across the whole block — plus the fp32 residual
+        # carry of x) + the two new serving executables.  The fused decode pins the SAME outputs as the
+        # unfused paged decode (one signature, two lowerings behind
+        # APEX_TPU_DECODE_FUSION); the verify step swaps logits for
+        # the emitted token slab + accepted counts.
+        "fused_block_decode": (fused_block_decode_op,
+                               "apex_tpu/ops/paged_attention.py",
+                               ("bfloat16", "bfloat16", "bfloat16"),
+                               11),
+        "inference_decode_fused_paged": (inference_decode_fused_paged,
+                                         "apex_tpu/inference/engine.py",
+                                         ("bfloat16", "bfloat16",
+                                          "int32", "int32", "int32",
+                                          "int32", "float32", "bool"),
+                                         None),
+        "inference_verify_paged": (inference_verify_paged,
+                                   "apex_tpu/inference/engine.py",
+                                   ("bfloat16", "bfloat16", "int32",
+                                    "int32", "int32", "int32",
+                                    "int32", "bool"), None),
         "inference_cow_page": (inference_cow_page,
                                "apex_tpu/inference/kv_cache.py",
                                ("bfloat16", "bfloat16", "int32",

@@ -526,6 +526,20 @@ def _builders():
             lambda: _inference("inference_decode_paged"),
             "apex_tpu/inference/engine.py", (0,), True, False, False,
             False),
+        # ISSUE 15: the fused-block decode lowering
+        # (APEX_TPU_DECODE_FUSION=1 twin of inference_decode_paged —
+        # same signature, same donation, one Pallas kernel per layer)
+        # and the speculative verify step (k=4 slab; lengths advance
+        # by the accepted count in-program = the rollback), both
+        # budgeted from day one like every serving executable
+        "inference_decode_fused_paged": (
+            lambda: _inference("inference_decode_fused_paged"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            False),
+        "inference_verify_paged": (
+            lambda: _inference("inference_verify_paged"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            False),
     }
 
 

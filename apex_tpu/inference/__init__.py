@@ -20,6 +20,9 @@ continuous-batching scheduler.  Two cache layouts (ISSUE 6):
     scheduler     SLO-aware continuous batching: shared-prefix
                   admission, chunked prefill, tenant fairness
     prefix_cache  host radix tree token ids -> KV page lists (ISSUE 12)
+    speculative   drafters for speculative decoding (ISSUE 15):
+                  prompt-lookup self-drafting, scripted replay, and a
+                  small draft model beside the target
 
 Quick start (see README "Inference")::
 
@@ -34,6 +37,7 @@ from apex_tpu.inference.engine import (
     InferenceEngine,
     make_decode_fn,
     make_prefill_fn,
+    make_verify_fn,
     prefill_bucket,
 )
 from apex_tpu.inference.kv_cache import (
@@ -47,8 +51,18 @@ from apex_tpu.inference.kv_cache import (
 from apex_tpu.inference.prefix_cache import PrefixCache
 from apex_tpu.inference.sampling import SamplingConfig, greedy, sample_token
 from apex_tpu.inference.scheduler import Request, SlotScheduler, generate
+from apex_tpu.inference.speculative import (
+    Drafter,
+    EngineDrafter,
+    NGramDrafter,
+    ReplayDrafter,
+)
 
 __all__ = [
+    "Drafter",
+    "EngineDrafter",
+    "NGramDrafter",
+    "ReplayDrafter",
     "InferenceEngine",
     "KVCache",
     "init_cache",
@@ -65,5 +79,6 @@ __all__ = [
     "generate",
     "make_prefill_fn",
     "make_decode_fn",
+    "make_verify_fn",
     "prefill_bucket",
 ]

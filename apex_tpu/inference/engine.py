@@ -51,10 +51,14 @@ import numpy as np
 
 from apex_tpu import observability as obs
 from apex_tpu.inference import kv_cache, models
-from apex_tpu.inference.sampling import SamplingConfig, sample_token
+from apex_tpu.inference.sampling import SamplingConfig, greedy, sample_token
+from apex_tpu.inference.speculative import default_spec_k
+from apex_tpu.ops.paged_attention import (decode_fusion as
+                                          resolve_fusion_mode,
+                                          resolve_decode_fusion)
 
 __all__ = ["InferenceEngine", "make_prefill_fn", "make_decode_fn",
-           "prefill_bucket"]
+           "make_verify_fn", "prefill_bucket"]
 
 
 def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
@@ -109,19 +113,29 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
     return prefill_paged_fn if paged else prefill_fn
 
 
-def make_decode_fn(kind: str, cfg, sampling: SamplingConfig):
+def make_decode_fn(kind: str, cfg, sampling: SamplingConfig,
+                   fused: bool = False):
     """Pure decode step: ``(cache, params, tokens [slots], active
     [slots], key, step) -> (cache, next_tokens, logits, truncated)``.
     Every slot computes (static shape); only active slots advance their
     length, and ``truncated`` flags active slots already at capacity
     whose emitted token could NOT be appended (the caller must retire
     them — nothing is clamped silently).  Serves both cache layouts:
-    the paged pool threads its page table through the same signature."""
+    the paged pool threads its page table through the same signature.
+
+    ``fused`` (ISSUE 15, paged engines): the ``params`` operand becomes
+    the pair ``(tree, fused_layers)`` and every transformer block runs
+    as ONE Pallas kernel (``fused_block_decode``) — still ONE donated
+    executable with the same outputs, selected statically at engine
+    construction by ``APEX_TPU_DECODE_FUSION``; fusion off keeps the
+    original per-op lowering bitwise."""
 
     def decode_fn(cache, params, tokens, active, key, step):
+        tree, fused_layers = params if fused else (params, None)
         with obs.named_scope("apex_decode_forward"):
-            logits, cache = models.decode_forward(kind, cfg, params,
-                                                  cache, tokens)
+            logits, cache = models.decode_forward(kind, cfg, tree,
+                                                  cache, tokens,
+                                                  fused=fused_layers)
         with obs.named_scope("apex_decode_sample"):
             logits = logits.astype(jnp.float32)
             toks = sample_token(logits, jax.random.fold_in(key, step),
@@ -131,6 +145,59 @@ def make_decode_fn(kind: str, cfg, sampling: SamplingConfig):
         return cache, toks, logits, truncated
 
     return decode_fn
+
+
+def make_verify_fn(kind: str, cfg, sampling: SamplingConfig, k: int):
+    """Pure speculative-verify step (ISSUE 15): ``(cache, params, slab
+    [slots, k+1], active [slots], key, step) -> (cache, tokens
+    [slots, k+1], n_emit [slots], truncated)``.
+
+    ``slab`` column 0 is each slot's last confirmed (pending) token,
+    columns ``1..k`` the drafted continuation.  ONE batched forward
+    scores every slab position against the cache (the slab's k/v land
+    at ``[lengths, lengths + k + 1)`` first — the paged layout makes
+    this the same one-scatter-per-layer write decode uses), the
+    longest draft prefix matching the target's own greedy tokens is
+    accepted, and ``tokens[:, :n_emit]`` is the emitted stream —
+    accepted drafts followed by the target's bonus/correction token,
+    i.e. ALWAYS the target's greedy stream (a bad draft costs
+    speculation upside, never output correctness; ``n_emit`` is in
+    ``[1, k+1]``).
+
+    Accept/reject is the length rollback the paged cache was built
+    for: lengths advance by ``n_emit`` (``kv_cache.advance_by``), so
+    the rejected tail's rows go dead-by-mask — pages were reserved at
+    admission, nothing is released device-side, and the page-table
+    rows are untouched.  Greedy-only in this round: rejection-sampled
+    verification for temperature > 0 needs the draft DISTRIBUTION,
+    which the drafter protocol does not carry yet.
+    """
+    if k < 1:
+        raise ValueError(f"speculative verify needs k >= 1, got {k}")
+    if not sampling.is_greedy:
+        raise ValueError(
+            "speculative verify is greedy-only (acceptance compares "
+            "drafts against argmax; rejection sampling for "
+            "temperature > 0 needs draft probabilities the drafter "
+            "protocol does not carry)")
+
+    def verify_fn(cache, params, slab, active, key, step):
+        with obs.named_scope("apex_verify_forward"):
+            logits, cache = models.verify_forward(kind, cfg, params,
+                                                  cache, slab)
+        with obs.named_scope("apex_verify_accept"):
+            toks = greedy(logits.astype(jnp.float32))    # [slots, k+1]
+            match = (toks[:, :-1] == slab[:, 1:]).astype(jnp.int32)
+            # leading-match count: cumprod zeroes everything after the
+            # first mismatch
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            n_emit = (n_acc + 1).astype(jnp.int32)
+        with obs.named_scope("apex_verify_advance"):
+            cache, truncated = kv_cache.advance_by(cache, active,
+                                                   n_emit)
+        return cache, toks, n_emit, truncated
+
+    return verify_fn
 
 
 def prefill_bucket(n: int, max_seq: int, min_bucket: int = 64) -> int:
@@ -161,7 +228,9 @@ class InferenceEngine:
                  seed: int = 0, paged: bool = False,
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 paged_attn_max_pages: Optional[int] = None):
+                 paged_attn_max_pages: Optional[int] = None,
+                 decode_fusion=None, fusion_min_pages=None,
+                 spec_k: Optional[int] = None):
         if kind not in ("gpt", "llama", "bert"):
             raise ValueError(f"unknown model kind {kind!r}")
         if kind != "bert":
@@ -220,14 +289,47 @@ class InferenceEngine:
         self._tel_registry = None
         self._refresh_dispatch_counters()
         if kind == "bert":
+            # resolve the spelling so every fusion-off value ("0",
+            # "off", "false", and "auto" — which can only resolve
+            # unfused on a cache-less engine) passes; only an explicit
+            # fusion-ON request is a configuration error here
+            if spec_k or (decode_fusion is not None
+                          and resolve_fusion_mode(decode_fusion) == "1"):
+                raise ValueError("speculative decoding / fused-block "
+                                 "decode are generative-path features; "
+                                 "BERT is the encode-only path")
+            self.spec_k = 0
+            self.decode_fused = False
             self._encode = jax.jit(self._make_bert_encode())
         else:
             self.dims = models.model_dims(kind, cfg)
+            # fused-block decode (ISSUE 15): resolved STATICALLY here —
+            # the knob selects which of two lowerings the ONE decode
+            # executable compiles, never a per-step branch.  The fused
+            # layout is a one-time device-side re-copy of the layer
+            # weights (prefill keeps the original tree) — HBM for
+            # decode latency, documented beside the knob.
+            self.decode_fused = resolve_decode_fusion(
+                decode_fusion, paged=self.paged,
+                max_pages=self.max_pages_per_slot,
+                min_pages=fusion_min_pages)
+            self._fused_layers = (
+                models.fused_layer_params(kind, cfg, self.params)
+                if self.decode_fused else None)
             self._prefill = jax.jit(
                 make_prefill_fn(kind, cfg, sampling, paged=self.paged),
                 donate_argnums=(0,))
             self._decode = jax.jit(
-                make_decode_fn(kind, cfg, sampling), donate_argnums=(0,))
+                make_decode_fn(kind, cfg, sampling,
+                               fused=self.decode_fused),
+                donate_argnums=(0,))
+            # speculative decoding (ISSUE 15): ONE verify executable
+            # per (k, engine) — the slab width is static
+            self.spec_k = int(spec_k if spec_k is not None
+                              else default_spec_k())
+            self._verify = (jax.jit(
+                make_verify_fn(kind, cfg, sampling, self.spec_k),
+                donate_argnums=(0,)) if self.spec_k else None)
             if self.paged:
                 # the COW write barrier (ISSUE 12): one donated page
                 # copy, compiled once, dispatched only when a slot must
@@ -245,6 +347,10 @@ class InferenceEngine:
                 "infer_decode_dispatch_total")
             self._cow_dispatches = reg.declared(
                 "infer_cow_dispatch_total")
+            self._fused_decode_dispatches = reg.declared(
+                "infer_decode_fused_dispatch_total")
+            self._verify_dispatches = reg.declared(
+                "infer_verify_dispatch_total")
 
     # -- cache ---------------------------------------------------------------
     def init_cache(self):
@@ -400,9 +506,44 @@ class InferenceEngine:
             active = np.ones((self.slots,), bool)
         self._refresh_dispatch_counters()
         self._decode_dispatches.inc()
+        if self.decode_fused:
+            self._fused_decode_dispatches.inc()
+        params = ((self.params, self._fused_layers) if self.decode_fused
+                  else self.params)
         with obs.trace_annotation("apex_tpu.inference.decode"):
-            return self._decode(cache, self.params,
+            return self._decode(cache, params,
                                 np.asarray(last_tokens, np.int32),
+                                np.asarray(active, bool),
+                                self._key, self._next_step())
+
+    def verify(self, cache, slab, active=None):
+        """One speculative-verify step (ISSUE 15): ``slab [slots,
+        spec_k + 1]`` (column 0 = each slot's last confirmed token,
+        the rest drafts) -> ``(cache, tokens [slots, spec_k + 1],
+        n_emit [slots], truncated)``.  ``tokens[:, :n_emit]`` per slot
+        is the emitted stream — the target's own greedy continuation
+        (accepted drafts + bonus token); lengths advanced by
+        ``n_emit`` in-program (the accept/reject rollback).  The same
+        capacity contract as :meth:`decode`: the caller clamps emitted
+        tokens to the slot's remaining capacity and retires truncated
+        slots."""
+        if not self.spec_k:
+            raise ValueError(
+                "speculative decoding is off for this engine; build it "
+                "with spec_k > 0 (or APEX_TPU_SPEC_K)")
+        slab = np.asarray(slab, np.int32)
+        if slab.shape != (self.slots, self.spec_k + 1):
+            raise ValueError(
+                f"verify slab must be [{self.slots}, "
+                f"{self.spec_k + 1}] (last token + {self.spec_k} "
+                f"drafts), got {tuple(slab.shape)}")
+        if active is None:
+            active = np.ones((self.slots,), bool)
+        self._refresh_dispatch_counters()
+        self._verify_dispatches.inc()
+        with obs.trace_annotation("apex_tpu.inference.verify",
+                                  k=self.spec_k):
+            return self._verify(cache, self.params, slab,
                                 np.asarray(active, bool),
                                 self._key, self._next_step())
 

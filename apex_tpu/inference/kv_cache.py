@@ -64,7 +64,7 @@ import numpy as np
 
 __all__ = ["KVCache", "init_cache", "PagedKVCache", "init_paged_cache",
            "PageAllocator", "default_page_size", "insert_tokens",
-           "cow_page"]
+           "cow_page", "append_slab", "advance_by", "set_lengths"]
 
 _PAGE_SIZE_ENV = "APEX_TPU_PAGE_SIZE"
 _DEFAULT_PAGE_SIZE = 64
@@ -188,6 +188,91 @@ def append_layer(cache, layer: int, k_tok, v_tok):
     new_v = cache.v.at[:, layer].set(
         upd(cache.v[:, layer], v_tok, cache.lengths))
     return cache.replace(k=new_k, v=new_v)
+
+
+def append_slab(cache, layer: int, k_slab, v_slab):
+    """Speculative-verify write for ONE layer (ISSUE 15): each slot's
+    ``S`` drafted-token rows land at that slot's positions
+    ``[lengths, lengths + S)``.
+
+    ``k_slab``/``v_slab``: ``[slots, kv_heads, S, head_dim]`` — the
+    whole verify slab's k/v per slot.  ``S = 1`` is exactly
+    :func:`append_layer`'s write.  Lengths do NOT advance here — the
+    verify step advances by the ACCEPTED count once after the last
+    layer (:func:`advance_by`), which is what makes rejection a length
+    rollback: rows past the accepted length are dead-by-mask and the
+    next append overwrites them.  Rows past a slot's virtual window
+    are DROPPED (paged: an out-of-bounds page sentinel; dense: an
+    out-of-bounds position), never clamped onto live rows — the same
+    bounded-damage discipline as :func:`insert_tokens`.
+    """
+    slots, kvh, s, d = k_slab.shape
+    if k_slab.shape != v_slab.shape or slots != cache.slots \
+            or kvh != cache.kv_heads or d != cache.head_dim:
+        raise ValueError(
+            f"slab k/v must be [slots={cache.slots}, "
+            f"kv_heads={cache.kv_heads}, S, head_dim={cache.head_dim}] "
+            f"and equal-shaped; got k {tuple(k_slab.shape)} v "
+            f"{tuple(v_slab.shape)}")
+    pos = cache.lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    # [slots, S, kv_heads, d]: advanced indices lead, interior follow
+    rows_k = jnp.moveaxis(k_slab, 2, 1).astype(cache.k.dtype)
+    rows_v = jnp.moveaxis(v_slab, 2, 1).astype(cache.v.dtype)
+    if isinstance(cache, PagedKVCache):
+        ps, mpps = cache.page_size, cache.max_pages_per_slot
+        ordinal = jnp.minimum(pos // ps, jnp.int32(mpps - 1))
+        pages = jnp.take_along_axis(cache.page_table, ordinal, axis=1)
+        # past the virtual window: OOB page sentinel -> mode="drop"
+        # discards the row (clamping would clobber the last live token)
+        pages = jnp.where(pos < jnp.int32(mpps * ps), pages,
+                          jnp.int32(cache.pages))
+        offs = jnp.minimum(pos - ordinal * ps, jnp.int32(ps - 1))
+        new_k = cache.k.at[pages, layer, :, offs, :].set(rows_k,
+                                                         mode="drop")
+        new_v = cache.v.at[pages, layer, :, offs, :].set(rows_v,
+                                                         mode="drop")
+        return cache.replace(k=new_k, v=new_v)
+    sid = jnp.arange(slots, dtype=jnp.int32)[:, None]
+    # past max_seq: OOB position -> dropped (dynamic_update_slice would
+    # clamp the whole slab backwards over live rows instead)
+    posd = jnp.where(pos < jnp.int32(cache.max_seq), pos,
+                     jnp.int32(cache.max_seq))
+    new_k = cache.k.at[sid, layer, :, posd, :].set(rows_k, mode="drop")
+    new_v = cache.v.at[sid, layer, :, posd, :].set(rows_v, mode="drop")
+    return cache.replace(k=new_k, v=new_v)
+
+
+def advance_by(cache, active, delta):
+    """Advance the active slots' lengths by a PER-SLOT count — the
+    speculative verify step's accept/rollback in one move (ISSUE 15):
+    ``delta[slot]`` is the number of tokens the slot confirmed
+    (accepted drafts + the bonus token), so rows appended beyond
+    ``lengths + delta`` — the rejected tail of the slab — fall back to
+    dead-by-mask without any data movement.  Returns
+    ``(cache, truncated)`` with the same clamp/flag semantics as
+    :func:`advance` (``delta = 1`` everywhere is exactly ``advance``):
+    lengths clamp at capacity and ``truncated`` flags active slots
+    whose confirmed tokens could not all be appended."""
+    act = jnp.asarray(active)
+    delta = jnp.asarray(delta, jnp.int32)
+    cap = (cache.capacity if isinstance(cache, PagedKVCache)
+           else jnp.int32(cache.max_seq))
+    want = cache.lengths + act.astype(jnp.int32) * delta
+    truncated = act.astype(bool) & (want > cap) & (cap > 0)
+    return cache.replace(lengths=jnp.minimum(want, cap)), truncated
+
+
+def set_lengths(cache, new_lengths):
+    """Directly set every slot's length (clamped to capacity) — the
+    host-driven rollback primitive a DRAFT engine needs (ISSUE 15):
+    after the target verifies, the drafter rolls its own cache back to
+    the pre-draft lengths so only CONFIRMED tokens ever stay resident.
+    Rows beyond the restored length are dead-by-mask, exactly like a
+    retired slot's rows."""
+    new_lengths = jnp.asarray(new_lengths, jnp.int32)
+    cap = (cache.capacity if isinstance(cache, PagedKVCache)
+           else jnp.int32(cache.max_seq))
+    return cache.replace(lengths=jnp.clip(new_lengths, 0, cap))
 
 
 def advance(cache, active):

@@ -28,15 +28,20 @@ from apex_tpu.ops.attention import (
     decode_attention,
     flash_attention,
     prefix_window_attention,
+    slab_decode_attention,
 )
-from apex_tpu.ops.paged_attention import paged_decode_attention
+from apex_tpu.ops.paged_attention import (
+    fused_block_decode,
+    paged_decode_attention,
+    paged_slab_attention,
+)
 from apex_tpu.transformer.functional.fused_rope import (
     fused_apply_rotary_pos_emb_cached,
 )
 from apex_tpu.transformer.testing.standalone_llama import _rope_cos_sin
 
 __all__ = ["model_dims", "check_supported", "prefill_forward",
-           "decode_forward"]
+           "decode_forward", "verify_forward", "fused_layer_params"]
 
 
 def model_dims(kind: str, cfg) -> dict:
@@ -118,6 +123,22 @@ def _suffix_attend(cache, layer: int, row, q, k, v, start):
                         cache.k[:, layer], cache.v[:, layer])
 
 
+def _slab_attend(cache, layer: int, q, lengths):
+    """Verify-slab attention against ONE layer of whichever cache
+    layout the engine runs: the dense slot window scored directly
+    (:func:`~apex_tpu.ops.attention.slab_decode_attention`) or the
+    paged pool gathered through the slot page table
+    (:func:`~apex_tpu.ops.paged_attention.paged_slab_attention`).
+    ``lengths`` is the live count BEFORE the slab was appended (the
+    causal offset)."""
+    if isinstance(cache, kv_cache.PagedKVCache):
+        return paged_slab_attention(q, cache.k[:, layer],
+                                    cache.v[:, layer], cache.page_table,
+                                    lengths)
+    return slab_decode_attention(q, cache.k[:, layer], cache.v[:, layer],
+                                 lengths)
+
+
 def _cache_attend(cache, layer: int, q, live):
     """Single-token attention against ONE layer of whichever cache
     layout the engine runs: the dense slot window
@@ -130,6 +151,81 @@ def _cache_attend(cache, layer: int, q, live):
             q, cache.k[:, layer], cache.v[:, layer], cache.page_table,
             live, xla_max_pages=cache.attn_max_pages)
     return decode_attention(q, cache.k[:, layer], cache.v[:, layer], live)
+
+
+def _fused_bias(p, width):
+    """A linear's bias as the fused layout's ``[1, width]`` row (zeros
+    when the layer was built bias-free)."""
+    if "bias" in p:
+        return p["bias"].reshape(1, width)
+    return jnp.zeros((1, width), p["weight"].dtype)
+
+
+def fused_layer_params(kind: str, cfg, params):
+    """The per-layer weights re-laid-out for the fused-block decode
+    kernel (ISSUE 15): matmul-ready ``[in, out]`` arrays with q/k/v
+    split into head-major planes, built ONCE at engine construction so
+    no transpose/gather ever runs inside the decode step.
+
+    GPT's interleaved ``query_key_value`` columns (per head:
+    ``[q(d), k(d), v(d)]``) deinterleave into ``wq``/``wk``/``wv``;
+    LLaMA's packed ``kv_proj`` splits the same way.  The layout is a
+    one-time device-side copy of the layer weights — the engine then
+    holds BOTH layouts (prefill keeps the original tree), a deliberate
+    HBM-for-latency trade the README documents next to the knob.
+    """
+    p = _params_subtree(params)
+    dims = model_dims(kind, cfg)
+    heads, kvh, d = dims["heads"], dims["kv_heads"], dims["head_dim"]
+    hidden = cfg.hidden_size
+    out = []
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        if kind == "gpt":
+            att = lp["self_attention"]
+            w = jnp.transpose(att["query_key_value"]["weight"])
+            w = w.reshape(hidden, heads, 3, d)
+            b = _fused_bias(att["query_key_value"],
+                            3 * heads * d).reshape(heads, 3, d)
+            blk = {
+                "ln1_w": lp["input_layernorm"]["weight"].reshape(
+                    1, hidden),
+                "ln1_b": lp["input_layernorm"]["bias"].reshape(1, hidden),
+                "wq": w[:, :, 0, :].reshape(hidden, heads * d),
+                "bq": b[:, 0, :].reshape(1, heads * d),
+                "wk": w[:, :, 1, :].reshape(hidden, heads * d),
+                "bk": b[:, 1, :].reshape(1, heads * d),
+                "wv": w[:, :, 2, :].reshape(hidden, heads * d),
+                "bv": b[:, 2, :].reshape(1, heads * d),
+                "wo": jnp.transpose(att["dense"]["weight"]),
+                "bo": _fused_bias(att["dense"], hidden),
+                "ln2_w": lp["post_attention_layernorm"][
+                    "weight"].reshape(1, hidden),
+                "ln2_b": lp["post_attention_layernorm"][
+                    "bias"].reshape(1, hidden),
+                "wu": jnp.transpose(lp["mlp"]["dense_h_to_4h"]["weight"]),
+                "bu": _fused_bias(lp["mlp"]["dense_h_to_4h"], cfg.ffn),
+                "wd": jnp.transpose(lp["mlp"]["dense_4h_to_h"]["weight"]),
+                "bd": _fused_bias(lp["mlp"]["dense_4h_to_h"], hidden),
+            }
+        else:
+            att = lp["attention"]
+            kvw = jnp.transpose(att["kv_proj"]["weight"]).reshape(
+                hidden, kvh, 2, d)
+            blk = {
+                "ln1_w": lp["input_norm"]["weight"].reshape(1, hidden),
+                "wq": jnp.transpose(att["q_proj"]["weight"]),
+                "wk": kvw[:, :, 0, :].reshape(hidden, kvh * d),
+                "wv": kvw[:, :, 1, :].reshape(hidden, kvh * d),
+                "wo": jnp.transpose(att["o_proj"]["weight"]),
+                "ln2_w": lp["post_attention_norm"]["weight"].reshape(
+                    1, hidden),
+                "wg": jnp.transpose(lp["mlp"]["gate_proj"]["weight"]),
+                "wu": jnp.transpose(lp["mlp"]["up_proj"]["weight"]),
+                "wd": jnp.transpose(lp["mlp"]["down_proj"]["weight"]),
+            }
+        out.append(blk)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -212,7 +308,7 @@ def _gpt_prefill(cfg, params, tokens, length=None, cache=None, row=None,
     return logits, jnp.stack(ks), jnp.stack(vs)
 
 
-def _gpt_decode(cfg, params, cache, tokens):
+def _gpt_decode(cfg, params, cache, tokens, fused=None):
     p = _params_subtree(params)
     dims = model_dims("gpt", cfg)
     heads, head_dim = dims["heads"], dims["head_dim"]
@@ -225,6 +321,15 @@ def _gpt_decode(cfg, params, cache, tokens):
 
     live = positions + 1                    # incl. the token written now
     for i in range(cfg.num_layers):
+        if fused is not None:
+            # ISSUE 15: the whole block in ONE kernel (norm1 -> qkv ->
+            # paged attention incl. this token -> out proj -> norm2 ->
+            # MLP); only the pool append leaves the per-op path
+            h, k_tok, v_tok = fused_block_decode(
+                h, fused[i], cache.k[:, i], cache.v[:, i],
+                cache.page_table, positions, kind="gpt", eps=1e-5)
+            cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
+            continue
         lp = p[f"layer_{i}"]
         x = h
         h1 = layer_norm(x, lp["input_layernorm"]["weight"],
@@ -241,6 +346,48 @@ def _gpt_decode(cfg, params, cache, tokens):
     h = layer_norm(h, p["final_layernorm"]["weight"],
                    p["final_layernorm"]["bias"])
     logits = jnp.einsum("bh,vh->bv", h, emb_w)
+    return logits, cache
+
+
+def _gpt_verify(cfg, params, cache, tokens):
+    """Speculative verify (ISSUE 15): score an ``S``-token drafted slab
+    per slot in ONE batched step — logits at EVERY slab position, the
+    slab's k/v appended at ``[lengths, lengths + S)``.  Lengths do not
+    advance here; the verify step advances by the accepted count
+    (:func:`kv_cache.advance_by`) so rejection is a pure length
+    rollback."""
+    p = _params_subtree(params)
+    dims = model_dims("gpt", cfg)
+    heads, head_dim = dims["heads"], dims["head_dim"]
+    slots, s = tokens.shape
+    base = cache.lengths                                    # [slots]
+    pos = base[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+
+    emb_w = p["embedding"]["word_embeddings"]["weight"]
+    pos_tab = p["embedding"]["position_embeddings"]
+    h = jnp.take(emb_w, tokens, axis=0)                     # [b, S, hid]
+    h = h + jnp.take(pos_tab,
+                     jnp.minimum(pos, jnp.int32(pos_tab.shape[0] - 1)),
+                     axis=0)
+
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        x = h
+        h1 = layer_norm(x, lp["input_layernorm"]["weight"],
+                        lp["input_layernorm"]["bias"])
+        q, k, v = _gpt_attn_proj(lp, h1, heads, head_dim)   # [b,S,n,d]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        cache = kv_cache.append_slab(cache, i, k, v)
+        ctx = _slab_attend(cache, i, q, base)               # [b,h,S,d]
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(slots, s, -1)
+        x = x + _linear(lp["self_attention"]["dense"], ctx)
+        h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
+                        lp["post_attention_layernorm"]["bias"])
+        h = x + _gpt_mlp(lp, h2)
+
+    h = layer_norm(h, p["final_layernorm"]["weight"],
+                   p["final_layernorm"]["bias"])
+    logits = jnp.einsum("bsh,vh->bsv", h, emb_w)
     return logits, cache
 
 
@@ -332,7 +479,7 @@ def _llama_prefill(cfg, params, tokens, length=None, cache=None,
     return logits, jnp.stack(ks), jnp.stack(vs)
 
 
-def _llama_decode(cfg, params, cache, tokens):
+def _llama_decode(cfg, params, cache, tokens, fused=None):
     p = _params_subtree(params)
     dims = model_dims("llama", cfg)
     heads, kv_heads = dims["heads"], dims["kv_heads"]
@@ -341,11 +488,19 @@ def _llama_decode(cfg, params, cache, tokens):
 
     h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
     cos_t, sin_t = _llama_rope_table(cfg, head_dim, cache.max_seq)
-    cos = jnp.take(cos_t, positions, axis=0)[:, None, :]    # [slots, 1, d]
-    sin = jnp.take(sin_t, positions, axis=0)[:, None, :]
+    cos2 = jnp.take(cos_t, positions, axis=0)               # [slots, d]
+    sin2 = jnp.take(sin_t, positions, axis=0)
+    cos, sin = cos2[:, None, :], sin2[:, None, :]           # [slots, 1, d]
 
     live = positions + 1
     for i in range(cfg.num_layers):
+        if fused is not None:
+            h, k_tok, v_tok = fused_block_decode(
+                h, fused[i], cache.k[:, i], cache.v[:, i],
+                cache.page_table, positions, kind="llama",
+                eps=cfg.rms_eps, cos=cos2, sin=sin2)
+            cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
+            continue
         lp = p[f"layer_{i}"]
         x = h
         h1 = rms_norm(x, lp["input_norm"]["weight"], eps=cfg.rms_eps)
@@ -364,6 +519,45 @@ def _llama_decode(cfg, params, cache, tokens):
 
     h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
     logits = _linear(p["lm_head"], h)                       # [slots, v]
+    return logits, cache
+
+
+def _llama_verify(cfg, params, cache, tokens):
+    """LLaMA twin of :func:`_gpt_verify`: RoPE at each slab row's
+    absolute position, GQA/MQA slab scoring straight off the
+    per-kv-head cache/pool."""
+    p = _params_subtree(params)
+    dims = model_dims("llama", cfg)
+    heads, kv_heads = dims["heads"], dims["kv_heads"]
+    head_dim = dims["head_dim"]
+    slots, s = tokens.shape
+    base = cache.lengths
+    pos = base[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    pos = jnp.minimum(pos, jnp.int32(cache.max_seq - 1))
+
+    h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
+    cos_t, sin_t = _llama_rope_table(cfg, head_dim, cache.max_seq)
+    cos = jnp.take(cos_t, pos, axis=0)[:, :, None, :]     # [b, S, 1, d]
+    sin = jnp.take(sin_t, pos, axis=0)[:, :, None, :]
+
+    for i in range(cfg.num_layers):
+        lp = p[f"layer_{i}"]
+        x = h
+        h1 = rms_norm(x, lp["input_norm"]["weight"], eps=cfg.rms_eps)
+        q, k, v = _llama_proj(lp, h1, cfg, heads, kv_heads, head_dim)
+        q = fused_apply_rotary_pos_emb_cached(q, cos, sin)
+        k = fused_apply_rotary_pos_emb_cached(k, cos, sin)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        cache = kv_cache.append_slab(cache, i, k, v)
+        ctx = _slab_attend(cache, i, q, base)               # [b,h,S,d]
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(slots, s, -1)
+        x = x + _linear(lp["attention"]["o_proj"], ctx)
+        h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
+                      eps=cfg.rms_eps)
+        h = x + _llama_mlp(lp, h1)
+
+    h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
+    logits = _linear(p["lm_head"], h)                     # [b, S, v]
     return logits, cache
 
 
@@ -406,10 +600,34 @@ def prefill_forward(kind: str, cfg, params, tokens, length=None, *,
               start=prefill_from)
 
 
-def decode_forward(kind: str, cfg, params, cache, tokens):
+def decode_forward(kind: str, cfg, params, cache, tokens, fused=None):
     """One-token step for every slot: ``tokens [slots]`` ->
     ``(logits [slots, v], cache)`` with the new k/v appended at each
     slot's position.  Lengths do not advance here (the engine advances
-    active slots once per step)."""
+    active slots once per step).
+
+    ``fused`` (ISSUE 15) is the per-layer fused weight layout from
+    :func:`fused_layer_params`: when present (paged engines under
+    ``APEX_TPU_DECODE_FUSION``), every transformer block runs as ONE
+    Pallas kernel (:func:`~apex_tpu.ops.paged_attention.
+    fused_block_decode`) instead of the per-op XLA sequence — same
+    embed/head, same pool append, same signature, tolerance-level
+    numerics (the in-kernel residual chain stays fp32 where the
+    unfused path rounds to bf16 at each sublayer)."""
     fn = _gpt_decode if kind == "gpt" else _llama_decode
+    return fn(cfg, params, cache, tokens, fused=fused)
+
+
+def verify_forward(kind: str, cfg, params, cache, tokens):
+    """Speculative-verify step (ISSUE 15): ``tokens [slots, S]`` (the
+    last confirmed token followed by ``S - 1`` drafts, per slot) ->
+    ``(logits [slots, S, v], cache)`` with the slab's k/v appended at
+    positions ``[lengths, lengths + S)``.  Lengths do NOT advance —
+    the verify fn advances by the accepted count, which IS the
+    page-table/length rollback (rejected rows go dead-by-mask; pages
+    were already reserved, so rejection releases nothing)."""
+    if tokens.ndim != 2:
+        raise ValueError(
+            f"verify takes a [slots, S] slab, got {tuple(tokens.shape)}")
+    fn = _gpt_verify if kind == "gpt" else _llama_verify
     return fn(cfg, params, cache, tokens)

@@ -78,6 +78,7 @@ import numpy as np
 
 from apex_tpu.inference import kv_cache
 from apex_tpu.inference.prefix_cache import PrefixCache, prefix_cache_enabled
+from apex_tpu.inference.speculative import Drafter, NGramDrafter
 from apex_tpu.observability import ServeTelemetry
 from apex_tpu.observability.slo import SLOTracker
 
@@ -208,7 +209,8 @@ class SlotScheduler:
                  tenant_priority: Optional[Dict[str, int]] = None,
                  max_chunks_per_pass: int = 1,
                  slo: Optional[SLOTracker] = None,
-                 shed_on_overload: bool = False):
+                 shed_on_overload: bool = False,
+                 drafter: Optional[Drafter] = None):
         self.engine = engine
         self.queue: collections.deque = collections.deque()
         self._next_uid = 0
@@ -251,6 +253,14 @@ class SlotScheduler:
         self.slo = (slo if slo is not None
                     else SLOTracker(self.telemetry.registry))
         self.shed_on_overload = bool(shed_on_overload)
+        # speculative decoding (ISSUE 15): engines built with
+        # spec_k > 0 serve their decode tokens through the batched
+        # verify step; the drafter proposes, the target disposes.
+        # Default drafter = prompt-lookup self-drafting (zero device
+        # work); pass drafter= for a scripted/model drafter.
+        self.drafter: Optional[Drafter] = drafter
+        if getattr(engine, "spec_k", 0) and self.drafter is None:
+            self.drafter = NGramDrafter()
         self._admit_clock = 0
         self._tenant_last_admit: Dict[str, int] = {}
         # the scheduler OWNS one cache for its lifetime (lazily built):
@@ -459,6 +469,8 @@ class SlotScheduler:
             slots[slot] = None
             free.append(slot)          # eviction = metadata; insert
             # on re-admit overwrites the stale cache rows
+            if self.drafter is not None:
+                self.drafter.retire(slot)
             tel.request_finished(st.uid, reason, len(gen))
 
         def prefill_piece(slot):
@@ -487,6 +499,8 @@ class SlotScheduler:
             tel.first_token(st.uid)
             st.generated.append(tok)
             last[slot] = tok
+            if self.drafter is not None and eng.spec_k:
+                self.drafter.begin(slot, st.prompt, tok)
             if self.prefix is not None:
                 ps = eng.page_size
                 new = self.prefix.insert(
@@ -612,6 +626,68 @@ class SlotScheduler:
             # requests that actually decode concurrently this step
             n_active = int(active.sum())
             self.peak_active = max(self.peak_active, n_active)
+            if getattr(eng, "spec_k", 0):
+                # speculative wave (ISSUE 15): drafts in, the verify
+                # step scores one (k+1)-slab per slot, accepted drafts
+                # + bonus come out.  The emitted stream is ALWAYS the
+                # target's own greedy stream; rejection already rolled
+                # the device lengths back in-program, and pages were
+                # reserved at admission so nothing is released here.
+                k = eng.spec_k
+                slab = np.zeros((eng.slots, k + 1), np.int32)
+                slab[:, 0] = last
+                slab[:, 1:] = self.drafter.draft_batch(active, k)
+                with tel.verify_step(n_active,
+                                     capacity=eng.slots) as vstep:
+                    cache, toks, n_emit, truncated = eng.verify(
+                        cache, slab, active)
+                    toks = np.asarray(toks)
+                    n_emit = np.asarray(n_emit)
+                    truncated = np.asarray(truncated)
+                    # per-token latency back-channel: the bracket's
+                    # histogram sample divides by mean emitted/slot.
+                    # Clamped the way the consumption loop below will
+                    # clamp (capacity AND token budget) so a final
+                    # short round cannot under-report per-token
+                    # latency; only an eos landing mid-slab (terminal
+                    # for the stream) escapes the host-side mirror.
+                    vstep["tokens"] = float(sum(
+                        min(int(n_emit[s]),
+                            slots[s].capacity - slots[s].cache_len(),
+                            slots[s].max_new_tokens
+                            - len(slots[s].generated))
+                        for s in range(eng.slots)
+                        if slots[s] is not None and active[s]))
+                for slot, st in enumerate(slots):
+                    if st is None or not active[slot]:
+                        continue
+                    # the host capacity mirror clamps exactly like the
+                    # device's advance_by did (same inputs, same min)
+                    remaining = st.capacity - st.cache_len()
+                    usable = int(min(int(n_emit[slot]), remaining))
+                    emitted = []
+                    reason = None
+                    for t in toks[slot, :usable]:
+                        st.generated.append(int(t))
+                        emitted.append(int(t))
+                        if st.done():
+                            reason = REASON_LENGTH
+                            break
+                    # emitted counts tokens that actually reached the
+                    # request (capacity- AND budget-clamped), so
+                    # spec_emitted == tokens_generated minus the
+                    # prefill-sampled firsts — conservation-testable
+                    tel.speculation(k, int(n_emit[slot]) - 1,
+                                    len(emitted))
+                    if emitted:
+                        last[slot] = emitted[-1]
+                        self.drafter.observe(slot, emitted)
+                    if reason is not None:
+                        retire(slot, reason)
+                    elif usable < int(n_emit[slot]) or truncated[slot]:
+                        # capacity cut the emitted stream short
+                        retire(slot, REASON_TRUNCATED)
+                continue
             # the decode bracket closes after the token host-read the
             # loop performs anyway, so the histogram sample is the true
             # per-token latency (dispatch + sync), and its recompile

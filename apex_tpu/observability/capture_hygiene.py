@@ -15,7 +15,8 @@ import math
 __all__ = ["MAX_PLAUSIBLE_SPEEDUP", "MAX_PLAUSIBLE_TOKENS_PER_S",
            "MAX_PLAUSIBLE_LATENCY_US", "MAX_PLAUSIBLE_MFU",
            "is_us_key", "is_tokens_per_s_key", "is_mfu_key",
-           "hbm_capacity_bound", "scrub_capture_values"]
+           "is_acceptance_rate_key", "hbm_capacity_bound",
+           "scrub_capture_values"]
 
 #: capture-hygiene bounds: a measured duration of exactly 0.0 µs means
 #: the whole timing loop collapsed inside the tunnel's RTT jitter (r5:
@@ -63,6 +64,10 @@ def is_mfu_key(key: str) -> bool:
     return key == "mfu" or key.endswith("_mfu") or key.startswith("mfu_")
 
 
+def is_acceptance_rate_key(key: str) -> bool:
+    return key == "acceptance_rate" or key.endswith("_acceptance_rate")
+
+
 def hbm_capacity_bound(obj: dict) -> int:
     """Physical ceiling for a ``compiled_peak_hbm_bytes`` field: the
     capture's own chip's HBM when the ``chip`` stamp matches the spec
@@ -93,6 +98,14 @@ def scrub_capture_values(obj):
     compiled-truth stamps — ``compiled_flops`` must be positive and
     ``compiled_peak_hbm_bytes`` must be positive and fit the chip's
     HBM (the ``chip`` field in the same dict selects the bound).
+    ISSUE 15 speculation stats: ``*acceptance_rate`` outside
+    ``(0, 1]`` is not physics (accepted drafts are a subset of
+    drafted), and a ``*spec_effective_tokens_per_s`` BELOW its
+    same-capture ``*spec_floor_tokens_per_s`` sibling (the 1-token-
+    per-verify-step floor measured on the same clock) is a
+    measurement artifact — every verify step emits at least the
+    bonus token, so effective >= floor by construction.
+
     Returns a scrubbed copy; containers are preserved, only the
     corrupt scalar fields vanish."""
     if isinstance(obj, dict):
@@ -116,6 +129,14 @@ def scrub_capture_values(obj):
                     continue
                 if is_mfu_key(k) and not 0.0 < v <= MAX_PLAUSIBLE_MFU:
                     continue
+                if is_acceptance_rate_key(k) and not 0.0 < v <= 1.0:
+                    continue
+                if k.endswith("spec_effective_tokens_per_s"):
+                    floor = obj.get(k.replace("effective", "floor"))
+                    if isinstance(floor, (int, float)) \
+                            and not isinstance(floor, bool) \
+                            and math.isfinite(floor) and v < floor:
+                        continue
                 if k == "compiled_flops" and v <= 0:
                     continue
                 if k == "compiled_peak_hbm_bytes":

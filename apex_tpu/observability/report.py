@@ -348,6 +348,26 @@ def _serve_section(events: list, families: dict) -> Optional[dict]:
                                "tenant")
     if tenants:
         out["tenants_admitted"] = dict(sorted(tenants.items()))
+    # speculative decoding (ISSUE 15): verify rounds + acceptance.
+    # Rendered only when a verify step actually ran, so pre-PR-15 run
+    # dirs stay byte-identical (the back-compat goldens pin it).
+    spec = {}
+    for key, fam in (("verify_steps", "serve_spec_verify_steps_total"),
+                     ("drafted", "serve_spec_drafted_tokens_total"),
+                     ("accepted", "serve_spec_accepted_tokens_total"),
+                     ("emitted", "serve_spec_emitted_tokens_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            spec[key] = v
+    if spec.get("verify_steps"):
+        rate = _family_total(families, "serve_spec_acceptance_rate")
+        if rate is None and spec.get("drafted"):
+            # fallback for a foreign/partial prom file: our emitter
+            # always writes the gauge beside the counters
+            rate = spec.get("accepted", 0.0) / spec["drafted"]
+        if rate is not None:
+            spec["acceptance_rate"] = rate
+        out["speculation"] = spec
     return out
 
 
@@ -817,6 +837,14 @@ def render_markdown(report: dict) -> str:
                       "evictions", "cow_copies", "prefill_chunks"):
                 if k in px:
                     lines.append(f"| {k} | {_f(px[k])} |")
+        sp = serve.get("speculation")
+        if sp:
+            lines += ["",
+                      "| speculation | value |", "|---|---|"]
+            for k in ("verify_steps", "drafted", "accepted", "emitted",
+                      "acceptance_rate"):
+                if k in sp:
+                    lines.append(f"| {k} | {_f(sp[k])} |")
         tn = serve.get("tenants_admitted")
         if tn:
             lines.append("- **tenants_admitted**: " + ", ".join(

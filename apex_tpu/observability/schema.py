@@ -153,6 +153,26 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("serve_tenant_rejected_total", "counter",
                "submissions rejected at validation, keyed by tenant",
                labels=("tenant",)),
+    # -- speculative decoding (ISSUE 15): the verify step's accept/
+    #    reject accounting.  Drafted counts what the verify executable
+    #    SCORED (k per active slot per round, padding drafts
+    #    included); accepted excludes the bonus token; emitted =
+    #    accepted + bonus = tokens handed to requests by verify steps.
+    MetricSpec("serve_spec_verify_steps_total", "counter",
+               "batched speculative verify executions (one slab of "
+               "k drafts + bonus per active slot)"),
+    MetricSpec("serve_spec_drafted_tokens_total", "counter",
+               "draft tokens scored by verify steps (k per active "
+               "slot per round)"),
+    MetricSpec("serve_spec_accepted_tokens_total", "counter",
+               "draft tokens accepted (matched the target's greedy "
+               "token; bonus tokens not counted)"),
+    MetricSpec("serve_spec_emitted_tokens_total", "counter",
+               "tokens emitted by verify steps (accepted drafts + "
+               "one bonus/correction per slot per round)"),
+    MetricSpec("serve_spec_acceptance_rate", "gauge",
+               "lifetime accepted/drafted ratio, 0..1 (set after "
+               "every verify round)"),
     # -- request tracing + SLO accounting (ISSUE 13) ----------------------
     MetricSpec("serve_trace_spans_total", "counter",
                "trace_span events emitted by the request tracer "
@@ -189,6 +209,13 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("infer_cow_dispatch_total", "counter",
                "InferenceEngine.cow_page dispatches (copy-on-write "
                "page duplications)"),
+    MetricSpec("infer_decode_fused_dispatch_total", "counter",
+               "decode dispatches lowered through the fused "
+               "transformer-block kernel (APEX_TPU_DECODE_FUSION; a "
+               "subset of infer_decode_dispatch_total)"),
+    MetricSpec("infer_verify_dispatch_total", "counter",
+               "InferenceEngine.verify dispatches (speculative "
+               "verify steps)"),
     # -- training (TrainTelemetry) ----------------------------------------
     MetricSpec("train_steps_total", "counter",
                "instrumented train steps dispatched"),

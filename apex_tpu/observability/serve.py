@@ -34,7 +34,20 @@ from apex_tpu.observability.registry import MetricsRegistry
 from apex_tpu.observability.spans import RequestTracer
 from apex_tpu.observability.timers import StepTimer
 
-__all__ = ["ServeTelemetry"]
+__all__ = ["ServeTelemetry", "SPEC_METRIC_FAMILIES"]
+
+#: the ISSUE 15 speculation families (schema-guard tested: every name
+#: here must be pinned in ``.telemetry_schema.json`` — the
+#: NUMERICS_METRIC_FAMILIES pattern)
+SPEC_METRIC_FAMILIES = (
+    "serve_spec_verify_steps_total",
+    "serve_spec_drafted_tokens_total",
+    "serve_spec_accepted_tokens_total",
+    "serve_spec_emitted_tokens_total",
+    "serve_spec_acceptance_rate",
+    "infer_decode_fused_dispatch_total",
+    "infer_verify_dispatch_total",
+)
 
 
 class ServeTelemetry:
@@ -87,6 +100,16 @@ class ServeTelemetry:
         self.tenant_admitted = d("serve_tenant_admitted_total")
         self.tenant_rejected = d("serve_tenant_rejected_total")
         self.shed = d("serve_requests_shed_total")
+        # speculative decoding (ISSUE 15): verify-round accounting.
+        # spec_step_seconds is a host-side wall-clock tally of RAW
+        # verify-step time (the histogram carries per-token samples),
+        # read by the bench speculation leg — not an exported family.
+        self.spec_verify_steps = d("serve_spec_verify_steps_total")
+        self.spec_drafted = d("serve_spec_drafted_tokens_total")
+        self.spec_accepted = d("serve_spec_accepted_tokens_total")
+        self.spec_emitted = d("serve_spec_emitted_tokens_total")
+        self.spec_acceptance = d("serve_spec_acceptance_rate")
+        self.spec_step_seconds = 0.0
         # request tracing (ISSUE 13): spans ride the SAME host
         # boundaries the methods below already occupy — arming the
         # tracer (trace= or APEX_TPU_TRACE) adds zero device work
@@ -244,24 +267,83 @@ class ServeTelemetry:
                                  ttft_s=round(ttft, 9))
 
     @contextlib.contextmanager
+    def _step_bracket(self, counter, active: int,
+                      capacity: Optional[int], spec: bool):
+        """One shared bracket for the decode/verify dispatch + token
+        read: gauges, the step timer, the per-token histogram sample,
+        the recompile counter and the idle-slot badput — one copy so
+        the two step kinds cannot silently diverge.  The yielded dict
+        is the verify path's back-channel: the scheduler drops the
+        step's emitted-token count into ``holder["tokens"]`` so the
+        histogram sample stays PER-TOKEN (step seconds divided by mean
+        tokens per active slot) — the semantics the SLO tracker's
+        decode_token_p99 objective and every dashboard assume."""
+        self.active_slots.set(active)
+        self.peak_active.set_max(active)
+        holder: dict = {}
+        self._decode_timer.start()
+        try:
+            yield holder
+        finally:
+            sample = self._decode_timer.stop()
+            counter.inc()
+            if spec:
+                self.spec_step_seconds += sample.seconds
+                per_slot = (holder.get("tokens", float(active))
+                            / max(active, 1))
+                self.decode_token_seconds.observe(
+                    sample.seconds / max(per_slot, 1.0))
+            else:
+                self.decode_token_seconds.observe(sample.seconds)
+            if sample.recompiled:
+                self.recompiles.inc()
+            if capacity is not None and capacity > active:
+                self.idle_slot_tokens.inc(capacity - active)
+
+    @contextlib.contextmanager
     def decode_step(self, active: int, capacity: Optional[int] = None):
         """Bracket one batched decode: dispatch + the scheduler's token
         read.  One sample = one token per active slot.  ``capacity``
         (the executable's slot width) feeds the idle-slot badput
         counter: inactive slots compute masked garbage every step."""
-        self.active_slots.set(active)
-        self.peak_active.set_max(active)
-        self._decode_timer.start()
-        try:
+        with self._step_bracket(self.decode_steps, active, capacity,
+                                spec=False):
             yield
-        finally:
-            sample = self._decode_timer.stop()
-            self.decode_steps.inc()
-            self.decode_token_seconds.observe(sample.seconds)
-            if sample.recompiled:
-                self.recompiles.inc()
-            if capacity is not None and capacity > active:
-                self.idle_slot_tokens.inc(capacity - active)
+
+    @contextlib.contextmanager
+    def verify_step(self, active: int, capacity: Optional[int] = None):
+        """Bracket one batched speculative-verify dispatch + the
+        scheduler's token read (ISSUE 15).  Yields the holder dict the
+        scheduler fills with ``"tokens"`` (the step's emitted count
+        across active slots) so the decode-latency histogram sample is
+        the EFFECTIVE per-token latency (step seconds / mean tokens
+        per active slot) — arming speculation must not read as a
+        per-token latency regression to the SLO tracker, whose
+        decode_token_p99 objective consumes this histogram.  Raw step
+        wall time accumulates in :attr:`spec_step_seconds` (host-side,
+        the bench speculation leg's clock); the recompile flag feeds
+        the same pinned-zero counter, because the verify step is as
+        much ONE donated executable as decode is."""
+        with self._step_bracket(self.spec_verify_steps, active,
+                                capacity, spec=True) as holder:
+            yield holder
+
+    def speculation(self, drafted: int, accepted: int,
+                    emitted: int) -> None:
+        """One slot's accept/reject outcome for one verify round:
+        ``drafted`` tokens were scored, ``accepted`` of them matched
+        the target's greedy stream, ``emitted`` tokens (accepted +
+        bonus, capacity-clamped) reached the request.  The acceptance
+        gauge tracks the lifetime ratio."""
+        if drafted:
+            self.spec_drafted.inc(drafted)
+        if accepted:
+            self.spec_accepted.inc(accepted)
+        if emitted:
+            self.spec_emitted.inc(emitted)
+        total = self.spec_drafted.total()
+        if total:
+            self.spec_acceptance.set(self.spec_accepted.total() / total)
 
     def backpressured(self) -> None:
         self.backpressure_waits.inc()
@@ -333,6 +415,15 @@ class ServeTelemetry:
             out["cow_copies"] = int(self.cow_copies.total())
         if self.prefill_chunks.total():
             out["prefill_chunks"] = int(self.prefill_chunks.total())
+        if self.spec_verify_steps.total():
+            out["verify_steps"] = int(self.spec_verify_steps.total())
+            out["spec_drafted"] = int(self.spec_drafted.total())
+            out["spec_accepted"] = int(self.spec_accepted.total())
+            out["spec_emitted"] = int(self.spec_emitted.total())
+            if self.spec_drafted.total():
+                out["spec_acceptance_rate"] = round(
+                    self.spec_accepted.total()
+                    / self.spec_drafted.total(), 4)
         if self.tracer.enabled():
             out["trace_spans"] = int(self.tracer.spans.total())
         if self.shed.total():

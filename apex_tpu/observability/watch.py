@@ -88,7 +88,11 @@ def metric_direction(key: str) -> Optional[str]:
             or base.endswith("_gbps") or base == "mfu"
             or base.endswith("_mfu") or base.startswith("mfu_")
             or base.endswith("_roofline") or base.endswith("_speedup")
-            or base.endswith("_tflops")):
+            or base.endswith("_tflops")
+            # ISSUE 15: drafting quality is a measurement within a
+            # comparability group (same leg shape + spec_k) — an
+            # acceptance-rate drop is a drafter regression
+            or base.endswith("_acceptance_rate")):
         return "higher"
     return None
 

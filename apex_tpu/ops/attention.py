@@ -61,7 +61,7 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu.utils import cdiv, interpret_mode
 
 __all__ = ["flash_attention", "mha_reference", "decode_attention",
-           "prefix_window_attention"]
+           "prefix_window_attention", "slab_decode_attention"]
 
 _NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
 # The kernels work in BASE-2 log domain: the dot's scalar scale absorbs
@@ -1038,6 +1038,78 @@ def decode_attention(q, k, v, lengths, *, sm_scale: Optional[float] = None,
         preferred_element_type=jnp.float32)             # [b, kvh, group, d]
     out = out.reshape(b, h, 1, d).astype(q.dtype)
     return out[:, :, 0] if squeezed else out
+
+
+def slab_decode_attention(q, win_k, win_v, lengths,
+                          *, sm_scale: Optional[float] = None):
+    """Verify-step attention (ISSUE 15): a small slab of ``S`` drafted
+    tokens per slot scores the slot's cache window, causally within the
+    slab.
+
+    The q_len = S generalization of :func:`decode_attention`'s XLA
+    grouped-einsum chain, shaped for speculative decoding: the slab's
+    own k/v have ALREADY been appended to the cache at positions
+    ``[lengths, lengths + S)``, so query row ``r`` (absolute position
+    ``lengths + r``) attends to window columns ``j <= lengths + r`` —
+    the cached context plus the draft prefix up to and including
+    itself.  S = 1 degenerates to exactly ``decode_attention``'s
+    masking (``j < lengths + 1``).
+
+    * ``q``: ``[slots, h, S, d]`` — the drafted tokens' query heads.
+    * ``win_k``/``win_v``: ``[slots, kv_heads, W, d]`` — the slot's
+      full cache window (dense cache directly; paged via the page
+      gather in :func:`~apex_tpu.ops.paged_attention.
+      paged_slab_attention`).
+    * ``lengths``: ``[slots]`` int32 — live tokens BEFORE the slab was
+      appended.
+
+    Rows whose absolute position falls outside the window (a slot at
+    the end of its virtual window — its slab rows were dropped by the
+    append) are fully masked and emit zeros, mirroring the kernels'
+    fully-masked-row convention; their emitted tokens are garbage the
+    caller retires as truncated.  Numerics mirror
+    :func:`decode_attention`: input-dtype MXU operands with fp32
+    accumulation, fp32 softmax, no kv broadcast materialized.
+    """
+    slots, h, sq, d = q.shape
+    if win_k.shape != win_v.shape or win_k.ndim != 4 \
+            or win_k.shape[0] != slots or win_k.shape[3] != d:
+        raise ValueError(
+            f"window k/v must be [{slots}, kv_heads, W, {d}] and "
+            f"equal-shaped; got win_k {tuple(win_k.shape)} win_v "
+            f"{tuple(win_v.shape)}")
+    kvh, w = win_k.shape[1], win_k.shape[2]
+    if kvh == 0 or h % kvh:
+        raise ValueError(
+            f"kv_heads ({kvh}) must divide query heads ({h})")
+    if lengths.shape != (slots,):
+        raise ValueError(
+            f"lengths must be [{slots}], got {tuple(lengths.shape)}")
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    lengths = lengths.astype(jnp.int32)
+    group = h // kvh
+    qg = q.reshape(slots, kvh, group, sq, d)
+    s = jax.lax.dot_general(
+        qg, win_k, (((4,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32) * scale  # [b, kvh, g, S, W]
+    col = jnp.arange(w, dtype=jnp.int32)[None, None, :]       # [1, 1, W]
+    row = jnp.arange(sq, dtype=jnp.int32)[None, :, None]      # [1, S, 1]
+    pos = lengths[:, None, None] + row            # absolute row position
+    # rows past the virtual window (their append was dropped) mask
+    # FULLY: without the pos < w term they would attend to the whole
+    # window minus themselves and emit plausible-looking garbage
+    live = (col <= pos) & (pos < jnp.int32(w))                # [b, S, W]
+    s = jnp.where(live[:, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    # rows past the virtual window (dropped appends) are fully masked —
+    # emit zeros, not softmax-of-constant's uniform artifact
+    p = jnp.where(m <= _MASKED_ROW_THRESH, 0.0, p)
+    out = jax.lax.dot_general(
+        p.astype(win_v.dtype), win_v, (((4,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32)          # [b, kvh, g, S, d]
+    return out.reshape(slots, h, sq, d).astype(q.dtype)
 
 
 def prefix_window_attention(q, k, v, win_k, win_v, start,

@@ -52,10 +52,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops.attention import (_LOG2E, _NEG_INF, decode_attention)
+from apex_tpu.ops.attention import (_LOG2E, _NEG_INF, decode_attention,
+                                    slab_decode_attention)
 from apex_tpu.utils import interpret_mode
 
-__all__ = ["paged_decode_attention", "paged_xla_max_pages"]
+__all__ = ["paged_decode_attention", "paged_xla_max_pages",
+           "paged_slab_attention", "fused_block_decode", "decode_fusion",
+           "fusion_min_pages", "resolve_decode_fusion"]
 
 #: paged kernel/XLA crossover, in PAGES per slot (the paged analog of
 #: ``_DECODE_XLA_MAX_SEQ``; ~4096 tokens at the default page size 64).
@@ -264,3 +267,441 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
     out = _paged_kernel_call(q[:, :, 0, :], k_pages, v_pages, page_table,
                              lengths, scale)
     return out if squeezed else out[:, :, None, :]
+
+
+# --------------------------------------------------------------------------
+# verify-slab attention (ISSUE 15): q_len = S against the paged pool
+# --------------------------------------------------------------------------
+
+def paged_slab_attention(q, k_pages, v_pages, page_table, lengths, *,
+                         sm_scale: Optional[float] = None):
+    """Speculative-verify attention against the paged pool: ``S``
+    drafted tokens per slot (already appended to the slot's pages at
+    positions ``[lengths, lengths + S)``) score the slot's virtual
+    window, causally within the slab.
+
+    The q_len = S sibling of :func:`paged_decode_attention`'s XLA
+    gather path: the slot's pages gather into the dense
+    ``[slots, kv_heads, max_seq, d]`` window (position for position the
+    dense cache's view) and
+    :func:`~apex_tpu.ops.attention.slab_decode_attention` scores it —
+    numerically IDENTICAL to the dense cache's verify path, which is
+    what keeps the speculative parity suite bitwise across cache
+    layouts.  ``S`` is the engine's static ``spec_k + 1``, so one
+    compiled verify step serves every wave.
+
+    Scope note: unlike the q_len = 1 decode, the verify step has ONLY
+    this gather lowering today — at very long virtual windows (where
+    decode crosses to the Pallas streaming kernel) every verify round
+    materializes the full window per layer, which erodes the
+    speculation win.  The q_len = S streaming-kernel extension (the
+    ``_paged_kernel`` grid with an S-row score block and causal
+    masking on the final pages) is the PERF.md round-15 follow-up
+    alongside the fused block's weight-tile streaming.
+    """
+    slots, h, sq, d = q.shape
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4 \
+            or k_pages.shape[3] != d:
+        raise ValueError(
+            f"k/v pages must be [pages, kv_heads, page_size, {d}] and "
+            f"equal-shaped; got k {tuple(k_pages.shape)} v "
+            f"{tuple(v_pages.shape)}")
+    kvh = k_pages.shape[1]
+    if kvh == 0 or h % kvh:
+        raise ValueError(
+            f"kv_heads ({kvh}) must divide query heads ({h})")
+    if page_table.ndim != 2 or page_table.shape[0] != slots:
+        raise ValueError(
+            f"page_table must be [{slots}, max_pages_per_slot], got "
+            f"{tuple(page_table.shape)}")
+    mpps, ps = page_table.shape[1], k_pages.shape[2]
+    page_table = page_table.astype(jnp.int32)
+
+    def window(pages):
+        g = jnp.take(pages, page_table, axis=0)
+        return jnp.moveaxis(g, 2, 1).reshape(slots, kvh, mpps * ps, d)
+
+    return slab_decode_attention(q, window(k_pages), window(v_pages),
+                                 lengths, sm_scale=sm_scale)
+
+
+# --------------------------------------------------------------------------
+# fused transformer-block decode (ISSUE 15 tentpole)
+# --------------------------------------------------------------------------
+#
+# One Pallas kernel per layer covering the decode hot path end to end:
+#
+#     norm1 -> qkv projection (+RoPE) -> paged attention over the
+#     slot's live pages INCLUDING the current token -> output
+#     projection -> residual -> [norm2 -> MLP -> residual]
+#
+# Grid (slots, pages), page table + lengths as scalar prefetch exactly
+# like the attention-only kernel above.  The layer's weights ride in
+# whole-array VMEM blocks with CONSTANT index maps, so Pallas DMAs each
+# weight from HBM once and keeps it resident for every slot and page
+# of the grid — the q_len = 1 activations (x, q, the fresh k/v, the
+# online-softmax state) never leave VMEM between sublayers.  The
+# unfused path round-trips five intermediates per layer through HBM
+# (norm1 out, qkv, attention context, attn-out residual, norm2 out);
+# here only the block output and the one token's k/v (for the pool
+# append that follows) cross the HBM boundary.
+#
+# The current token's k/v are folded into the online softmax as one
+# extra column FROM SCRATCH (the unfused path appends to the pool
+# first and reads the row back); the caller appends them after the
+# kernel, so the pool write stays the existing one-scatter-per-layer
+# program and the kernel needs no aliased outputs.
+#
+# Numerics: fp32 norm statistics, bf16 operands into the MXU with fp32
+# accumulation, fp32 online softmax in the base-2 log domain — the
+# same discipline as the attention kernels.  The residual chain stays
+# fp32 inside the kernel (the unfused path rounds to bf16 at each
+# sublayer boundary), so fused vs unfused parity is tolerance, not
+# bitwise; bitwise belongs to the XLA fallback (fusion off == the
+# original per-op path, untouched).
+
+_DECODE_FUSION_ENV = "APEX_TPU_DECODE_FUSION"
+
+#: fused-block/unfused crossover in PAGES per slot, used when
+#: ``APEX_TPU_DECODE_FUSION=auto``: short virtual windows are dominated
+#: by the projections (XLA's fused matvecs are already good there);
+#: long windows are where streaming pages through one kernel with the
+#: weights resident wins.  PROVISIONAL like every crossover at
+#: introduction — override with ``APEX_TPU_FUSION_MIN_PAGES``; bench
+#: infer captures stamp the effective value.
+_FUSION_MIN_PAGES = 8
+
+_FUSION_MIN_PAGES_ENV = "APEX_TPU_FUSION_MIN_PAGES"
+
+
+def decode_fusion(override=None) -> str:
+    """Effective fused-block decode mode: explicit override >
+    ``APEX_TPU_DECODE_FUSION`` env var > ``"0"`` (unfused default).
+    ``"0"`` = the per-op XLA path, ``"1"`` = the fused-block kernel,
+    ``"auto"`` = fuse when the engine's window is at least
+    :func:`fusion_min_pages` pages."""
+    val = override if override is not None \
+        else (os.environ.get(_DECODE_FUSION_ENV) or "0")
+    val = str(val).strip().lower() or "0"
+    if val in ("0", "false", "off"):
+        return "0"
+    if val in ("1", "true", "on"):
+        return "1"
+    if val == "auto":
+        return "auto"
+    raise ValueError(
+        f"{_DECODE_FUSION_ENV} must be 0, 1 or auto, got {val!r}")
+
+
+def fusion_min_pages(override=None) -> int:
+    """Effective auto-fusion crossover: explicit kwarg override >
+    ``APEX_TPU_FUSION_MIN_PAGES`` env var > the provisional default."""
+    if override is not None:
+        return int(override)
+    env = os.environ.get(_FUSION_MIN_PAGES_ENV)
+    if env:
+        try:
+            return int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_FUSION_MIN_PAGES_ENV} must be an int, got "
+                f"{env!r}") from e
+    return _FUSION_MIN_PAGES
+
+
+def resolve_decode_fusion(mode=None, *, paged: bool,
+                          max_pages: Optional[int] = None,
+                          min_pages: Optional[int] = None) -> bool:
+    """Engine-side dispatch: does THIS engine run the fused-block
+    decode kernel?  The fused kernel streams the slot's pages via the
+    page table, so it rides the paged cache only — ``mode="1"`` on a
+    dense engine is a configuration error, while ``"auto"`` quietly
+    resolves to the (only available) unfused path."""
+    mode = decode_fusion(mode)
+    if mode == "0":
+        return False
+    if not paged:
+        if mode == "1":
+            raise ValueError(
+                "fused-block decode streams the slot's KV pages via "
+                "the page table (APEX_TPU_DECODE_FUSION=1 needs a "
+                "paged engine); this engine runs the dense slot cache")
+        return False
+    if mode == "1":
+        return True
+    return int(max_pages or 0) >= fusion_min_pages(min_pages)
+
+
+def _fused_block_kernel(kind, scale, kvh, group, ps, mpps, hidden, d,
+                        eps, fuse_mlp, *refs):
+    gpt = kind == "gpt"
+    h = kvh * group
+    f32 = jnp.float32
+    it = iter(refs)
+    pt_ref, len_ref = next(it), next(it)
+    x_ref = next(it)
+    cos_ref = sin_ref = None
+    if not gpt:
+        cos_ref, sin_ref = next(it), next(it)
+    ln1_w = next(it)
+    ln1_b = next(it) if gpt else None
+    wq = next(it)
+    bq = next(it) if gpt else None
+    wk = next(it)
+    bk = next(it) if gpt else None
+    wv = next(it)
+    bv = next(it) if gpt else None
+    k_ref, v_ref = next(it), next(it)
+    wo = next(it)
+    bo = next(it) if gpt else None
+    ln2_w = ln2_b = wg = wu = bu = wd = bd = None
+    if fuse_mlp:
+        ln2_w = next(it)
+        ln2_b = next(it) if gpt else None
+        if not gpt:
+            wg = next(it)
+        wu = next(it)
+        bu = next(it) if gpt else None
+        wd = next(it)
+        bd = next(it) if gpt else None
+    o_ref, kt_ref, vt_ref = next(it), next(it), next(it)
+    q_scr, kn_scr, vn_scr, s_scr, m_scr, l_scr, acc_scr = it
+
+    sid = pl.program_id(0)
+    p = pl.program_id(1)
+
+    def norm(x, w_ref, b_ref):
+        w = w_ref[...].astype(f32)
+        if gpt:
+            mu = jnp.mean(x, axis=1, keepdims=True)
+            var = jnp.mean((x - mu) ** 2, axis=1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + eps) * w \
+                + b_ref[...].astype(f32)
+        ms = jnp.mean(x * x, axis=1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + eps) * w
+
+    def matmul(x2d, w_ref, b_ref):
+        y = jax.lax.dot(x2d.astype(w_ref.dtype), w_ref[...],
+                        preferred_element_type=f32)
+        if b_ref is not None:
+            y = y + b_ref[...].astype(f32)
+        return y
+
+    @pl.when(p == 0)
+    def _project():
+        # norm1 + the three projections run ONCE per slot; everything
+        # they produce stays in VMEM scratch across the page loop
+        xv = x_ref[...].astype(f32)                      # [1, hidden]
+        h1 = norm(xv, ln1_w, ln1_b)
+        qh = matmul(h1, wq, bq).reshape(h, d)
+        kh = matmul(h1, wk, bk).reshape(kvh, d)
+        vh = matmul(h1, wv, bv).reshape(kvh, d)
+        if not gpt:
+            cos = cos_ref[...].astype(f32)               # [1, d]
+            sin = sin_ref[...].astype(f32)
+
+            def rot(t):
+                t1, t2 = jnp.split(t, 2, axis=-1)
+                return jnp.concatenate((-t2, t1), axis=-1)
+
+            qh = qh * cos + rot(qh) * sin
+            kh = kh * cos + rot(kh) * sin
+        q_scr[...] = qh
+        kn_scr[...] = kh
+        vn_scr[...] = vh
+        kt_ref[...] = kh.reshape(1, kvh * d).astype(kt_ref.dtype)
+        vt_ref[...] = vh.reshape(1, kvh * d).astype(vt_ref.dtype)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[sid]
+    live_pages = (length + ps - 1) // ps
+
+    @pl.when(p < live_pages)
+    def _pages():
+        # the attention-only paged kernel's page loop, with q from the
+        # in-VMEM projection instead of an HBM operand
+        for i in range(kvh):
+            seg = slice(i * group, (i + 1) * group)
+            s_scr[seg, :] = jax.lax.dot_general(
+                q_scr[seg, :].astype(k_ref.dtype), k_ref[0, i],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=f32) * (scale * _LOG2E)
+        cols = p * ps + jax.lax.broadcasted_iota(jnp.int32, (h, ps), 1)
+        s = jnp.where(cols < length, s_scr[...], _NEG_INF)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        pmat = jnp.exp2(s - m_new)
+        l_scr[...] = l_scr[...] * alpha + \
+            jnp.sum(pmat, axis=1, keepdims=True)
+        for i in range(kvh):
+            seg = slice(i * group, (i + 1) * group)
+            acc_scr[seg, :] = acc_scr[seg, :] * alpha[seg] + jax.lax.dot(
+                pmat[seg, :].astype(v_ref.dtype), v_ref[0, i],
+                preferred_element_type=f32)
+        m_scr[...] = m_new
+
+    @pl.when(p == mpps - 1)
+    def _finish():
+        # fold the CURRENT token as one extra online-softmax column
+        # (the unfused path appends it to the pool first and reads the
+        # row back; live = length + 1 either way), then run the whole
+        # back half of the block on the VMEM-resident context
+        q_ = q_scr[...]
+        kn = kn_scr[...]
+        s_new = jnp.sum(q_.reshape(kvh, group, d) * kn[:, None, :],
+                        axis=-1).reshape(h, 1) * (scale * _LOG2E)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, s_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        p_new = jnp.exp2(s_new - m_new)                  # [h, 1]
+        l = l_scr[...] * alpha + p_new
+        vb = jnp.broadcast_to(vn_scr[...][:, None, :],
+                              (kvh, group, d)).reshape(h, d)
+        acc = acc_scr[...] * alpha + p_new * vb
+        ctx = acc / l            # the current token is always live: l > 0
+        attn = matmul(ctx.reshape(1, h * d), wo, bo)
+        x2 = x_ref[...].astype(f32) + attn               # [1, hidden]
+        if fuse_mlp:
+            h2 = norm(x2, ln2_w, ln2_b)
+            if gpt:
+                u = jax.nn.gelu(matmul(h2, wu, bu))
+                y = x2 + matmul(u, wd, bd)
+            else:
+                g = matmul(h2, wg, None)
+                u = matmul(h2, wu, None)
+                y = x2 + matmul(jax.nn.silu(g) * u, wd, None)
+        else:
+            y = x2
+        o_ref[...] = y.astype(o_ref.dtype)
+
+
+def fused_block_decode(x, blk, k_pages, v_pages, page_table, lengths, *,
+                       kind: str, eps: float, cos=None, sin=None,
+                       sm_scale: Optional[float] = None,
+                       fuse_mlp: bool = True):
+    """One fused transformer-block decode step against the paged pool.
+
+    * ``x``: ``[slots, hidden]`` — the block's input activations (the
+      residual stream), one token per slot.
+    * ``blk``: the layer's weights in the FUSED layout
+      (:func:`apex_tpu.inference.models.fused_layer_params` builds it
+      once at engine construction): matmul-ready ``[in, out]`` arrays
+      ``wq [hidden, h*d]`` / ``wk``/``wv [hidden, kv_heads*d]`` /
+      ``wo [h*d, hidden]`` (+ GPT biases ``bq/bk/bv/bo`` as ``[1, n]``
+      rows and LayerNorm ``ln1_w/ln1_b``; LLaMA carries RMSNorm
+      ``ln1_w`` only), plus — under ``fuse_mlp`` — the MLP half
+      (``ln2_*``, GPT ``wu/bu/wd/bd``, LLaMA ``wg/wu/wd``).
+    * ``k_pages``/``v_pages``: ONE layer's ``[pages, kv_heads,
+      page_size, d]`` slice of the pool; ``page_table``/``lengths`` as
+      in :func:`paged_decode_attention`.
+    * ``cos``/``sin``: ``[slots, d]`` RoPE rows at each slot's current
+      position (LLaMA only).
+
+    Returns ``(y [slots, hidden], k_tok [slots, kv_heads, d], v_tok)``
+    — the block output plus the current token's k/v for the caller's
+    one-scatter-per-layer pool append (``kv_cache.append_layer``).
+    Always the Pallas kernel (interpret mode off-TPU); the engine-level
+    XLA fallback is the original unfused per-op path, selected by
+    ``APEX_TPU_DECODE_FUSION`` / the ``auto`` crossover
+    (:func:`resolve_decode_fusion`).
+    """
+    if kind not in ("gpt", "llama"):
+        raise ValueError(f"unknown block kind {kind!r}")
+    gpt = kind == "gpt"
+    slots, hidden = x.shape
+    if k_pages.shape != v_pages.shape or k_pages.ndim != 4:
+        raise ValueError(
+            f"k/v pages must be [pages, kv_heads, page_size, d] and "
+            f"equal-shaped; got k {tuple(k_pages.shape)} v "
+            f"{tuple(v_pages.shape)}")
+    _, kvh, ps, d = k_pages.shape
+    hd = blk["wq"].shape[1]
+    if hd % d:
+        raise ValueError(
+            f"wq width {hd} must be a multiple of head_dim {d}")
+    h = hd // d
+    if h % kvh:
+        raise ValueError(
+            f"kv_heads ({kvh}) must divide query heads ({h})")
+    group = h // kvh
+    mpps = page_table.shape[1]
+    if page_table.shape[0] != slots or lengths.shape != (slots,):
+        raise ValueError(
+            f"page_table/lengths must cover {slots} slots; got "
+            f"{tuple(page_table.shape)} / {tuple(lengths.shape)}")
+    if (not gpt) and (cos is None or sin is None):
+        raise ValueError("llama fused block needs cos/sin RoPE rows")
+    scale = (d ** -0.5) if sm_scale is None else sm_scale
+    page_table = page_table.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    const = lambda s, p, pt, ln: (0, 0)                  # noqa: E731
+    slot = lambda s, p, pt, ln: (s, 0)                   # noqa: E731
+
+    def page_index(s, p, pt, ln):
+        last = jnp.maximum((ln[s] + ps - 1) // ps - 1, 0)
+        return (pt[s, jnp.minimum(p, last)], 0, 0, 0)
+
+    def wspec(a):
+        return pl.BlockSpec(a.shape, const)
+
+    operands = [x]
+    in_specs = [pl.BlockSpec((1, hidden), slot)]
+
+    def add_w(*names):
+        for n in names:
+            operands.append(blk[n])
+            in_specs.append(wspec(blk[n]))
+
+    if not gpt:
+        operands.extend([cos, sin])
+        in_specs.extend([pl.BlockSpec((1, d), slot)] * 2)
+        add_w("ln1_w", "wq", "wk", "wv")
+    else:
+        add_w("ln1_w", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv")
+    operands.extend([k_pages, v_pages])
+    in_specs.extend([pl.BlockSpec((1, kvh, ps, d), page_index)] * 2)
+    add_w(*(("wo", "bo") if gpt else ("wo",)))
+    if fuse_mlp:
+        if gpt:
+            add_w("ln2_w", "ln2_b", "wu", "bu", "wd", "bd")
+        else:
+            add_w("ln2_w", "wg", "wu", "wd")
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, mpps),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, hidden), slot),
+            pl.BlockSpec((1, kvh * d), slot),
+            pl.BlockSpec((1, kvh * d), slot),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),      # q (RoPE'd, unscaled)
+            pltpu.VMEM((kvh, d), jnp.float32),    # fresh k
+            pltpu.VMEM((kvh, d), jnp.float32),    # fresh v
+            pltpu.VMEM((h, ps), jnp.float32),     # score block
+            pltpu.VMEM((h, 1), jnp.float32),      # running max (base 2)
+            pltpu.VMEM((h, 1), jnp.float32),      # running normalizer
+            pltpu.VMEM((h, d), jnp.float32),      # fp32 output accum
+        ],
+    )
+    kernel = functools.partial(_fused_block_kernel, kind, scale, kvh,
+                               group, ps, mpps, hidden, d, eps, fuse_mlp)
+    y, kt, vt = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, hidden), x.dtype),
+            jax.ShapeDtypeStruct((slots, kvh * d), x.dtype),
+            jax.ShapeDtypeStruct((slots, kvh * d), x.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret_mode(),
+    )(page_table, lengths, *operands)
+    return y, kt.reshape(slots, kvh, d), vt.reshape(slots, kvh, d)

@@ -902,12 +902,18 @@ def _microbench_infer(rtt: float, on_tpu: bool):
                 f"--override pages={num_pages} cannot hold this leg's "
                 f"warm state: {slots} slots x {pages_per_req} pages "
                 f"per request needs >= {slots * pages_per_req}")
+        # spec_k pinned 0: this engine is every non-speculative
+        # measurement's baseline — an ambient APEX_TPU_SPEC_K must not
+        # silently turn the base legs speculative (the dedicated spec
+        # leg builds its own spec_k engine; decode_fusion stays
+        # env-inherited so the serve-path stamps can ride the fused
+        # executable when the on-chip queue arms it)
         engine = InferenceEngine("gpt", cfg, params, slots=slots,
                                  max_seq=max_seq, page_size=page_size,
-                                 num_pages=num_pages)
+                                 num_pages=num_pages, spec_k=0)
     else:
         engine = InferenceEngine("gpt", cfg, params, slots=slots,
-                                 max_seq=max_seq)
+                                 max_seq=max_seq, spec_k=0)
     sampling = SamplingConfig()                      # greedy
     prefill_fn = make_prefill_fn("gpt", cfg, sampling, paged=paged)
     decode_fn = make_decode_fn("gpt", cfg, sampling)
@@ -1142,6 +1148,122 @@ def _microbench_infer(rtt: float, on_tpu: bool):
         out["infer_burst_decode_gap_chunked_us"] = round(
             chunked * 1e6, 1)
         out["infer_burst_chunk_tokens"] = chunk
+
+        # fused-block decode A/B (ISSUE 15, paged only): the SAME warm
+        # decode loop through the fused transformer-block lowering
+        # (one Pallas kernel per layer, APEX_TPU_DECODE_FUSION=1) next
+        # to the per-op baseline above; knob stamps self-describe the
+        # capture (same contract as page_size)
+        from apex_tpu.inference import models as _inf_models
+        from apex_tpu.ops.paged_attention import (decode_fusion,
+                                                  fusion_min_pages)
+
+        out["infer_decode_fusion"] = decode_fusion()
+        out["infer_fusion_min_pages"] = fusion_min_pages()
+        fused_layers = _inf_models.fused_layer_params("gpt", cfg,
+                                                      engine.params)
+        fused_decode_fn = make_decode_fn("gpt", cfg, sampling,
+                                         fused=True)
+        alloc_f = engine.new_allocator()
+        cache_f = engine.init_cache()
+        for slot in range(slots):
+            cache_f, _, _ = engine.prefill(
+                cache_f, np.asarray(prompt), slot,
+                pages=alloc_f.acquire(pages_per_req))
+
+        def fused_decode_step(state, batch):
+            cache_, toks, step = state
+            active, key_ = batch
+            cache_, toks, _, _ = fused_decode_fn(
+                cache_, (engine.params, fused_layers), toks, active,
+                key_, step)
+            return (cache_, toks, step + 1)
+
+        t_fdec = _bench_loop(
+            fused_decode_step,
+            (cache_f, jnp.zeros((slots,), jnp.int32), jnp.int32(0)),
+            (jnp.ones((slots,), bool), key), decode_iters, rtt)
+        out["infer_decode_token_us_fused"] = round(t_fdec.best * 1e6, 1)
+        out["infer_decode_token_us_fused_median"] = round(
+            t_fdec.median * 1e6, 1)
+        out["infer_decode_fused_tokens_per_s"] = round(
+            slots / t_fdec.best, 1)
+
+        # speculation leg (ISSUE 15): greedy speculative decoding on a
+        # REPEATED-STRUCTURE workload (period-4 prompts).  Rates come
+        # from the telemetry step histograms (decode/verify dispatch +
+        # token read), not wall clock, so prefill/queueing noise never
+        # rides the stamp.  Three numbers: the non-speculative
+        # baseline, the prompt-lookup (self-drafting) run, and the
+        # replay-drafter run whose script is the base run's own output
+        # — acceptance ~1, the machinery ceiling any draft model is
+        # bounded by.  infer_spec_floor_tokens_per_s is the 1-token-
+        # per-verify-step floor on the same clock (effective >= floor
+        # by construction — the capture scrubber enforces it).
+        from apex_tpu.inference import ReplayDrafter
+        from apex_tpu.inference.speculative import default_spec_k
+
+        # effective-k precedence: bench override > APEX_TPU_SPEC_K > 4
+        spec_k = int(_ov("spec_k", default_spec_k() or 4))
+        pat = (3, 1, 4, 1)
+        rep_len = min(prefill_len, max_seq // 2)
+        rep_prompts = [
+            [(pat[i % 4] + 7 * s) % cfg.vocab_size
+             for i in range(rep_len)] for s in range(slots)]
+        spec_new = max(spec_k + 1,
+                       min(16, max_seq - rep_len - spec_k - 2))
+
+        def _spec_wave(eng_, drafter=None):
+            tel_ = ServeTelemetry(MetricsRegistry())
+            sched_ = SlotScheduler(eng_, telemetry=tel_,
+                                   prefix_cache=False, drafter=drafter)
+            for p in rep_prompts:
+                sched_.submit(p, max_new_tokens=spec_new)
+            res = sched_.run()
+            return res, tel_
+
+        eng_spec = InferenceEngine(
+            "gpt", cfg, params, slots=slots, max_seq=max_seq,
+            page_size=page_size, num_pages=engine.num_pages,
+            spec_k=spec_k)
+        _spec_wave(engine)        # warm the base buckets
+        _spec_wave(eng_spec)      # warm the verify step
+        base_res, tel_b = _spec_wave(engine)
+        base_secs = tel_b.decode_token_seconds.sum()
+        base_toks = (int(tel_b.tokens_generated.total())
+                     - int(tel_b.finished.total()))  # prefill's firsts
+        script = {tuple(p): base_res[u]
+                  for u, p in enumerate(rep_prompts)}
+
+        def _spec_stats(tel_):
+            s_ = tel_.summary()
+            # RAW verify wall time (the histogram carries per-token
+            # samples since the SLO-semantics fix; the host tally is
+            # the speculation leg's clock)
+            secs = tel_.spec_step_seconds
+            emitted = s_.get("spec_emitted", 0)
+            drafted = s_.get("spec_drafted", 0)
+            return {
+                "accept": s_.get("spec_acceptance_rate", 0.0),
+                "eff": emitted / secs if secs > 0 else 0.0,
+                "floor": ((drafted / spec_k) / secs
+                          if secs > 0 and spec_k else 0.0),
+                "steps": s_.get("verify_steps", 0),
+            }
+
+        _, tel_n = _spec_wave(eng_spec)                  # prompt-lookup
+        _, tel_o = _spec_wave(eng_spec,
+                              drafter=ReplayDrafter(script))  # ceiling
+        ng, oc = _spec_stats(tel_n), _spec_stats(tel_o)
+        out["infer_spec_k"] = spec_k
+        out["infer_spec_verify_steps"] = ng["steps"]
+        out["infer_spec_base_tokens_per_s"] = round(
+            base_toks / base_secs, 1) if base_secs > 0 else 0.0
+        out["infer_spec_acceptance_rate"] = ng["accept"]
+        out["infer_spec_effective_tokens_per_s"] = round(ng["eff"], 1)
+        out["infer_spec_floor_tokens_per_s"] = round(ng["floor"], 1)
+        out["infer_spec_oracle_acceptance_rate"] = oc["accept"]
+        out["infer_spec_oracle_tokens_per_s"] = round(oc["eff"], 1)
     return out
 
 

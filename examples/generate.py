@@ -68,6 +68,14 @@ def parse_args(argv=None):
                         "slot cache and a paged pool of the SAME KV "
                         "HBM and report how many requests each admits "
                         "concurrently")
+    p.add_argument("--spec-k", type=int, default=None,
+                   help="speculative decoding: drafted tokens per "
+                        "decode round via the n-gram prompt-lookup "
+                        "drafter (None reads APEX_TPU_SPEC_K; 0 off)")
+    p.add_argument("--decode-fusion", default=None,
+                   help="fused transformer-block decode: 0/1/auto "
+                        "(paged engines; None reads "
+                        "APEX_TPU_DECODE_FUSION)")
     p.add_argument("--prompts", type=int, default=6)
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--temperature", type=float, default=0.0,
@@ -183,6 +191,10 @@ def main(argv=None):
     if args.page_size is not None or args.num_pages is not None:
         paged_kw = dict(page_size=args.page_size,
                         num_pages=args.num_pages)
+    if args.spec_k is not None:
+        paged_kw["spec_k"] = args.spec_k
+    if args.decode_fusion is not None:
+        paged_kw["decode_fusion"] = args.decode_fusion
     if args.train_steps:
         state = quick_train(model, params, args)
         engine = InferenceEngine.from_train_state(

@@ -179,6 +179,38 @@ def test_numerics_json_section_shape(capsys):
     assert nx["overflow_leaves"] == {"['w1']": 64.0}
 
 
+def test_serve_section_renders_speculation_accept_rate():
+    """ISSUE 15 satellite: a run whose metrics carry the speculation
+    families gets a speculation block in the serve section (verify
+    rounds, drafted/accepted/emitted, acceptance rate) — and a run
+    WITHOUT them (every pre-PR-15 run dir) renders none, which the
+    byte-stable goldens above already pin."""
+    from apex_tpu.observability.report import render_markdown
+    prom = "\n".join([
+        "serve_requests_submitted_total 4",
+        "serve_requests_finished_total{reason=\"length\"} 4",
+        "serve_spec_verify_steps_total 9",
+        "serve_spec_drafted_tokens_total 36",
+        "serve_spec_accepted_tokens_total 27",
+        "serve_spec_emitted_tokens_total 33",
+        "serve_spec_acceptance_rate 0.75",
+        "",
+    ])
+    report = build_report([], prom)
+    spec = report["serve"]["speculation"]
+    assert spec["verify_steps"] == 9.0
+    assert spec["drafted"] == 36.0
+    assert spec["accepted"] == 27.0
+    assert spec["emitted"] == 33.0
+    assert spec["acceptance_rate"] == 0.75
+    md = render_markdown(report)
+    assert "| speculation | value |" in md
+    assert "| acceptance_rate | 0.75 |" in md
+    # no verify steps -> no block (the pre-PR-15 predicate)
+    bare = build_report([], "serve_requests_submitted_total 4\n")
+    assert "speculation" not in bare["serve"]
+
+
 def test_report_without_numerics_stays_byte_stable(capsys):
     """Back-compat (ISSUE 11 satellite): a pre-PR-11 run dir — the
     ISSUE 10 fixture, committed before numerics existed — renders NO

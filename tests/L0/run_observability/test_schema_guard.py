@@ -61,6 +61,17 @@ def test_span_and_slo_families_are_pinned():
     assert "serve_requests_shed_total" in committed["prometheus"]
 
 
+def test_speculation_families_are_pinned():
+    """ISSUE 15 satellite: the committed schema re-pin covers every
+    speculation/fused-dispatch family the serve telemetry and engine
+    emit — a new family cannot ship unpinned."""
+    from apex_tpu.observability import serve
+    committed = json.loads((REPO / schema.SCHEMA_NAME).read_text())
+    for fam in serve.SPEC_METRIC_FAMILIES:
+        assert fam in committed["prometheus"], fam
+        assert fam in schema.METRIC_SPECS, fam
+
+
 def test_measured_attribution_families_are_pinned():
     """ISSUE 14 satellite: the committed schema re-pin covers every
     family and event the trace-ingestion/attribution layer emits — a

@@ -148,6 +148,41 @@ def test_capture_scrubber_rejects_nonphysical_ttft_and_latency():
     assert out["infer_serve_recompiles"] == 0      # 0 is a VALUE here
 
 
+def test_capture_scrubber_rejects_nonphysical_speculation_stats():
+    """ISSUE 15 satellite: speculation stats get the physicality
+    check — an acceptance rate outside (0, 1] (accepted is a subset
+    of drafted) and an effective tokens/s BELOW its same-capture
+    floor stamp (every verify step emits at least the bonus token, so
+    effective >= floor on the same clock) are measurement artifacts;
+    plausible values and the non-measurement stamps survive."""
+    payload = {
+        "infer_spec_acceptance_rate": 1.7,            # > 1: impossible
+        "infer_spec_oracle_acceptance_rate": -0.2,    # negative
+        "infer_spec_effective_tokens_per_s": 400.0,   # below its floor
+        "infer_spec_floor_tokens_per_s": 650.0,
+        "infer_spec_base_tokens_per_s": 768.6,        # plausible
+        "infer_spec_k": 4,                            # knob stamp
+        "infer_spec_verify_steps": 9,                 # counter
+        "nested": [{"spec_acceptance_rate": 0.31}],   # plausible
+    }
+    out = bench._scrub_capture_values(payload)
+    assert "infer_spec_acceptance_rate" not in out
+    assert "infer_spec_oracle_acceptance_rate" not in out
+    assert "infer_spec_effective_tokens_per_s" not in out
+    assert out["infer_spec_floor_tokens_per_s"] == 650.0
+    assert out["infer_spec_base_tokens_per_s"] == 768.6
+    assert out["infer_spec_k"] == 4
+    assert out["infer_spec_verify_steps"] == 9
+    assert out["nested"][0]["spec_acceptance_rate"] == 0.31
+    # a consistent pair passes through untouched
+    ok = bench._scrub_capture_values(
+        {"infer_spec_effective_tokens_per_s": 1154.1,
+         "infer_spec_floor_tokens_per_s": 632.9,
+         "infer_spec_acceptance_rate": 0.21})
+    assert ok["infer_spec_effective_tokens_per_s"] == 1154.1
+    assert ok["infer_spec_acceptance_rate"] == 0.21
+
+
 def test_degraded_capture_carries_value_tpu_best_top_level():
     """The recorded on-chip throughput must surface as a first-class
     top-level sibling of `value` on the degraded path — and never on the

@@ -27,7 +27,11 @@ from apex_tpu.observability import MetricsRegistry, ServeTelemetry
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
 
-BUDGETED_EXECUTABLES = 18
+# 18 at ISSUE 12; ISSUE 15 consciously added the fused-block decode
+# twin and the speculative verify step (the only legitimate way this
+# number moves: a new REGISTERED executable, never a serving-path
+# side effect)
+BUDGETED_EXECUTABLES = 20
 
 
 def _engine():
@@ -100,7 +104,8 @@ def test_budget_ledger_untouched_by_prefix_sharing():
     inference_entries = {k for k in committed if "inference" in k}
     assert inference_entries == {
         "inference_prefill", "inference_decode",
-        "inference_prefill_paged", "inference_decode_paged"}
+        "inference_prefill_paged", "inference_decode_paged",
+        "inference_decode_fused_paged", "inference_verify_paged"}
     # the serving-side program set is closed: the COW copy rides the
     # jaxpr audit (precision/transfer) without a budget entry, and no
     # "prefix" executable exists anywhere in the registry
