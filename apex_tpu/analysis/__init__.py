@@ -1,6 +1,6 @@
 """apex_tpu.analysis — JAX-aware static analysis.
 
-Three engines (see README "Static analysis"):
+Four engines (see README "Static analysis"):
 
 * :mod:`~apex_tpu.analysis.lint` — AST rules over the whole package
   (host syncs under jit, PRNG key reuse, traced Python branching,
@@ -18,16 +18,30 @@ Three engines (see README "Static analysis"):
   donation against the lowered executables, and the comm/HBM budget
   ledger (:mod:`~apex_tpu.analysis.comm_model`) ratcheted by
   ``.analysis_budget.json``.
+* :mod:`~apex_tpu.analysis.pallas_audit` — decomposes every registered
+  ``pallas_call`` (grid, BlockSpecs, scratch, scalar prefetch) into a
+  static per-grid-step VMEM footprint priced against the chip's VMEM
+  capacity, with soundness checks (fp32 reduction accumulators,
+  grid/shape divisibility, index-map discipline) and the
+  ``.analysis_kernel_budget.json`` ledger ratchet; also the
+  fused-decode envelope model behind ``--mesh tp=N``.
 
 CLI: ``python -m apex_tpu.analysis`` or the ``apex-tpu-analyze`` entry
-point (``--spmd`` adds the third engine); findings are gated by
-``.analysis_baseline.json`` so only NEW violations fail the run.
+point (``--spmd`` adds the third engine, ``--kernels`` the fourth);
+findings are gated by ``.analysis_baseline.json`` so only NEW
+violations fail the run.
 """
 from apex_tpu.analysis.finding import Finding
 from apex_tpu.analysis.lint import lint_paths, lint_source
 
 __all__ = ["Finding", "lint_paths", "lint_source", "run_jaxpr_audit",
-           "run_spmd_audit"]
+           "run_spmd_audit", "run_kernel_audit"]
+
+
+def run_kernel_audit(*args, **kwargs):
+    """Lazy proxy — the kernel auditor traces Pallas ops under jax."""
+    from apex_tpu.analysis.pallas_audit import run_kernel_audit as _run
+    return _run(*args, **kwargs)
 
 
 def run_jaxpr_audit(*args, **kwargs):

@@ -6,14 +6,20 @@ the ratchet pattern: pre-existing debt is pinned, regressions fail CI.
 ``--spmd`` adds the SPMD soundness auditor + the comm/HBM budget
 ledger, ratcheted against the committed ``.analysis_budget.json``
 (exit nonzero only when a registered executable's collective bytes or
-peak-live estimate GROWS).
+peak-live estimate GROWS).  ``--kernels`` adds the Pallas kernel VMEM
+auditor + the kernel budget ledger, ratcheted the same way against
+``.analysis_kernel_budget.json`` (exit nonzero only when a kernel's
+modeled VMEM footprint grows or a kernel is unbudgeted).
 
     apex-tpu-analyze                       # lint + jaxpr audit, baseline-gated
     apex-tpu-analyze --spmd                # + SPMD audit, budget-gated
     apex-tpu-analyze --spmd --json         # machine-readable (schema: README)
+    apex-tpu-analyze --kernels             # + Pallas VMEM audit, budget-gated
+    apex-tpu-analyze --kernels --mesh tp=2 # + 1/tp-sharded fused-decode envelope
     apex-tpu-analyze path/ other.py        # restrict lint to paths
     apex-tpu-analyze --write-baseline      # re-pin current findings
     apex-tpu-analyze --spmd --write-budget # re-pin the comm/HBM ledger
+    apex-tpu-analyze --kernels --write-budget  # re-pin the kernel VMEM ledger
     apex-tpu-analyze --no-baseline         # show everything, exit 1 if any
     apex-tpu-analyze --list-rules
 """
@@ -96,9 +102,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--budget", type=Path, default=None,
                    help="comm/HBM ledger file (default: "
                         "<root>/.analysis_budget.json)")
+    p.add_argument("--kernels", action="store_true",
+                   help="run the Pallas kernel VMEM auditor + the "
+                        "kernel budget ledger over the registered "
+                        "Pallas kernel ops")
+    p.add_argument("--kernel-ops", default=None,
+                   help="comma-separated op names for the kernel audit "
+                        "(default: all registered)")
+    p.add_argument("--kernel-budget", type=Path, default=None,
+                   help="kernel VMEM ledger file (default: "
+                        "<root>/.analysis_kernel_budget.json)")
+    p.add_argument("--mesh", default=None, metavar="tp=N",
+                   help="with --kernels: also price the 1/tp-sharded "
+                        "fused_block_decode VMEM envelope (ROADMAP "
+                        "item 1's static feasibility check)")
+    p.add_argument("--chip", default=None,
+                   help="chip generation for VMEM capacity (default: "
+                        "the chip_specs default)")
     p.add_argument("--write-budget", action="store_true",
-                   help="pin the current comm/HBM ledger as the new "
-                        "budget (implies --spmd)")
+                   help="pin the current ledger(s) as the new budget "
+                        "(implies --spmd when --kernels is absent)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true")
@@ -142,9 +165,23 @@ def main(argv: Optional[list] = None) -> int:
         print("APX218 compiled-drift              spmd audit: compiled-"
               "stats attribution missing/degraded, or the estimate-vs-"
               "compiled drift ratio left the committed band")
+        print("APX300 kernel-trace-failure        pallas audit: kernel "
+              "fixture failed to trace")
+        print("APX301 vmem-envelope               pallas audit: modeled "
+              "per-grid-step VMEM footprint exceeds chip capacity or "
+              "grew past .analysis_kernel_budget.json")
+        print("APX302 non-fp32-accumulator        pallas audit: reduction "
+              "kernel's scratch / revisited output block is not fp32")
+        print("APX303 grid-divisibility           pallas audit: block dim "
+              "doesn't divide its operand dim and the kernel declares "
+              "no masked tail")
+        print("APX304 traced-index-map            pallas audit: BlockSpec "
+              "index map captures a traced value")
+        print("APX305 unbudgeted-kernel           pallas audit: reachable "
+              "Pallas kernel has no kernel-budget entry")
         return 0
 
-    if args.write_budget:
+    if args.write_budget and not args.kernels:
         args.spmd = True
     if args.spmd:
         # must run before ANY engine touches the backend: the audit
@@ -199,6 +236,61 @@ def main(argv: Optional[list] = None) -> int:
                 encoding="utf-8")) if budget_path.is_file() else None)
             findings.extend(compare_budget(spmd_report, committed))
 
+    kernel_report = None
+    mesh_report = None
+    if args.kernels:
+        from apex_tpu.analysis.pallas_audit import (
+            BUDGET_NAME as KERNEL_BUDGET_NAME, compare_kernel_budget,
+            predict_fusion_max_hidden, run_kernel_audit)
+        kernel_ops = (args.kernel_ops.split(",") if args.kernel_ops
+                      else None)
+        kernel_findings, kernel_report = run_kernel_audit(
+            kernel_ops, chip=args.chip)
+        findings.extend(kernel_findings)
+        kernel_budget_path = (args.kernel_budget
+                              or (root / KERNEL_BUDGET_NAME))
+        if args.write_budget:
+            if kernel_ops and args.kernel_budget is None:
+                print("apex-tpu-analyze: refusing --write-budget for a "
+                      "restricted --kernel-ops run targeting the shared "
+                      f"{KERNEL_BUDGET_NAME}; pass --kernel-budget "
+                      "<file> or run all kernel ops", file=sys.stderr)
+                return 2
+            kernel_budget_path.write_text(
+                json.dumps(kernel_report, indent=1) + "\n",
+                encoding="utf-8")
+            print(f"kernel budget written: {kernel_budget_path} "
+                  f"({len(kernel_report['ops'])} op(s) pinned)",
+                  file=sys.stderr if args.as_json else sys.stdout)
+        else:
+            committed = (json.loads(kernel_budget_path.read_text(
+                encoding="utf-8"))
+                if kernel_budget_path.is_file() else None)
+            findings.extend(
+                compare_kernel_budget(kernel_report, committed))
+
+        if args.mesh:
+            key, _, val = args.mesh.partition("=")
+            if key.strip() != "tp" or not val.strip().isdigit() \
+                    or int(val) < 1:
+                print(f"apex-tpu-analyze: --mesh expects tp=N (got "
+                      f"{args.mesh!r})", file=sys.stderr)
+                return 2
+            tp = int(val)
+            mesh_report = {
+                "unsharded": predict_fusion_max_hidden(
+                    tp=1, chip=args.chip),
+                "sharded": predict_fusion_max_hidden(
+                    tp=tp, chip=args.chip),
+            }
+            if not args.as_json:
+                u, s = mesh_report["unsharded"], mesh_report["sharded"]
+                print(f"fused_block_decode VMEM envelope on "
+                      f"{u['chip']}: tp=1 max_hidden={u['max_hidden']} "
+                      f"(crossover {u['crossover_hidden']}); tp={tp} "
+                      f"max_hidden={s['max_hidden']} (crossover "
+                      f"{s['crossover_hidden']})")
+
     baseline_path = args.baseline or (root / BASELINE_NAME)
     if args.write_baseline:
         # a restricted scan must not silently replace the shared
@@ -232,6 +324,10 @@ def main(argv: Optional[list] = None) -> int:
         }
         if spmd_report is not None:
             out["budget"] = spmd_report
+        if kernel_report is not None:
+            out["kernel_budget"] = kernel_report
+        if mesh_report is not None:
+            out["mesh"] = mesh_report
         print(json.dumps(out, indent=1))
     else:
         if not args.quiet:

@@ -50,6 +50,7 @@ from apex_tpu.analysis.rules import (  # noqa: E402,F401
     donation,
     env_knobs,
     host_sync,
+    pallas_flags,
     precision,
     prng,
     side_effects,

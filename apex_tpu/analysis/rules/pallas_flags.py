@@ -1,0 +1,56 @@
+"""APX111 — Pallas debug flags left on in package code.
+
+``pallas_call(..., interpret=True)`` silently swaps the Mosaic kernel
+for a pure-Python interpreter (orders of magnitude slower, and a
+different numerics path), and ``debug=True`` dumps lowering artifacts
+on every trace.  Both are development switches: shipping one in
+package code means production runs the interpreter.  Test/fixture
+files are exempt — the sanctioned toggle for CPU CI is
+``apex_tpu.utils.interpret_mode()``, which resolves the
+``APEX_TPU_INTERPRET`` knob instead of hard-coding ``True``.
+"""
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from apex_tpu.analysis.rules import Rule, register
+
+_PALLAS_CALL = "jax.experimental.pallas.pallas_call"
+_FLAGS = ("interpret", "debug")
+
+
+def _is_test_path(path: str) -> bool:
+    parts = posixpath.normpath(path.replace("\\", "/")).split("/")
+    if any(p in ("tests", "test") for p in parts[:-1]):
+        return True
+    base = parts[-1]
+    return base.startswith("test_") or base.endswith("_test.py")
+
+
+@register
+class PallasDebugFlags(Rule):
+    id = "APX111"
+    name = "pallas-debug-flag"
+    description = ("interpret=True/debug=True left on a pallas_call in "
+                   "package (non-test) code — use interpret_mode()")
+
+    def check_module(self, ctx):
+        if _is_test_path(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.resolve(node.func) != _PALLAS_CALL:
+                continue
+            for kw in node.keywords:
+                if kw.arg in _FLAGS and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is True:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"pallas_call({kw.arg}=True) in package code "
+                        f"ships the {'interpreter' if kw.arg == 'interpret' else 'lowering dumps'}"
+                        f" to production — gate it on "
+                        f"apex_tpu.utils.interpret_mode() (the "
+                        f"APEX_TPU_INTERPRET knob) or move it to a test")

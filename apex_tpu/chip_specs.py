@@ -25,21 +25,29 @@ __all__ = ["ChipSpec", "CHIP_SPECS", "DEFAULT_CHIP", "match_spec",
 _GiB = 1024 ** 3
 
 
+_MiB = 1024 ** 2
+
+
 @dataclass(frozen=True)
 class ChipSpec:
     key: str                 # substring matched against device_kind
     bf16_tflops: float       # peak bf16 matmul TFLOP/s per chip
     hbm_gbps: float          # peak HBM bandwidth GB/s per chip
     hbm_bytes: int           # HBM capacity per chip
+    vmem_bytes: int          # VMEM capacity per core (the pallas_audit
+    #                          envelope bound; the ceiling production
+    #                          kernels compile against via
+    #                          vmem_limit_bytes, NOT the compiler's
+    #                          conservative per-buffer scoping default)
 
 
 CHIP_SPECS: Dict[str, ChipSpec] = {s.key: s for s in [
-    ChipSpec("v4", 275.0, 1228.0, 32 * _GiB),
-    ChipSpec("v5e", 197.0, 819.0, 16 * _GiB),
-    ChipSpec("v5lite", 197.0, 819.0, 16 * _GiB),
-    ChipSpec("v5p", 459.0, 2765.0, 95 * _GiB),
-    ChipSpec("v6e", 918.0, 1640.0, 32 * _GiB),
-    ChipSpec("v6lite", 918.0, 1640.0, 32 * _GiB),
+    ChipSpec("v4", 275.0, 1228.0, 32 * _GiB, 128 * _MiB),
+    ChipSpec("v5e", 197.0, 819.0, 16 * _GiB, 128 * _MiB),
+    ChipSpec("v5lite", 197.0, 819.0, 16 * _GiB, 64 * _MiB),
+    ChipSpec("v5p", 459.0, 2765.0, 95 * _GiB, 128 * _MiB),
+    ChipSpec("v6e", 918.0, 1640.0, 32 * _GiB, 128 * _MiB),
+    ChipSpec("v6lite", 918.0, 1640.0, 32 * _GiB, 64 * _MiB),
 ]}
 
 #: the generation assumed when the device kind matches nothing (CPU

@@ -16,6 +16,7 @@ __all__ = ["MAX_PLAUSIBLE_SPEEDUP", "MAX_PLAUSIBLE_TOKENS_PER_S",
            "MAX_PLAUSIBLE_LATENCY_US", "MAX_PLAUSIBLE_MFU",
            "is_us_key", "is_tokens_per_s_key", "is_mfu_key",
            "is_acceptance_rate_key", "hbm_capacity_bound",
+           "vmem_capacity_bound", "is_vmem_model_key",
            "scrub_capture_values"]
 
 #: capture-hygiene bounds: a measured duration of exactly 0.0 µs means
@@ -80,6 +81,23 @@ def hbm_capacity_bound(obj: dict) -> int:
     return max(s.hbm_bytes for s in CHIP_SPECS.values())
 
 
+def vmem_capacity_bound(obj: dict) -> int:
+    """Physical ceiling for ``*vmem_model_bytes`` fields (ISSUE 16:
+    the pallas_audit envelope stamp): the capture's own chip's VMEM
+    when the ``chip`` stamp matches, else the largest in the table —
+    the same miss policy as :func:`hbm_capacity_bound`."""
+    from apex_tpu.chip_specs import CHIP_SPECS, match_spec
+    spec = match_spec(str(obj.get("chip", "")))
+    if spec is not None:
+        return spec.vmem_bytes
+    return max(s.vmem_bytes for s in CHIP_SPECS.values())
+
+
+def is_vmem_model_key(key: str) -> bool:
+    return (key == "vmem_model_bytes"
+            or key.endswith("_vmem_model_bytes"))
+
+
 def scrub_capture_values(obj):
     """Drop physically impossible values from a capture payload
     (recursively): NaN/Inf in ANY numeric field (NaN passes every
@@ -104,7 +122,10 @@ def scrub_capture_values(obj):
     same-capture ``*spec_floor_tokens_per_s`` sibling (the 1-token-
     per-verify-step floor measured on the same clock) is a
     measurement artifact — every verify step emits at least the
-    bonus token, so effective >= floor by construction.
+    bonus token, so effective >= floor by construction.  ISSUE 16
+    VMEM-model stamps: a ``*vmem_model_bytes`` field must be positive
+    and fit the chip's VMEM capacity (same chip-selected bound policy
+    as the HBM rule).
 
     Returns a scrubbed copy; containers are preserved, only the
     corrupt scalar fields vanish."""
@@ -144,6 +165,11 @@ def scrub_capture_values(obj):
                         hbm_bound = hbm_capacity_bound(obj)
                     if not 0 < v <= hbm_bound:
                         continue
+                if is_vmem_model_key(k) and \
+                        not 0 < v <= vmem_capacity_bound(obj):
+                    # a modeled VMEM envelope <= 0 or beyond the chip's
+                    # VMEM is a wrong geometry / wrong chip stamp
+                    continue
             out[k] = v
         return out
     if isinstance(obj, list):

@@ -63,6 +63,16 @@ from apex_tpu.utils import cdiv, interpret_mode
 __all__ = ["flash_attention", "mha_reference", "decode_attention",
            "prefix_window_attention", "slab_decode_attention"]
 
+#: pallas_audit registration (analysis hook only, no behavior change):
+#: every attention kernel carries online-softmax (m/l/acc) or wgrad
+#: accumulators whose scratch must be fp32 (APX302).
+PALLAS_AUDIT = {
+    "_fwd_kernel": {"reduction": True},
+    "_dq_kernel": {"reduction": True},
+    "_dkv_kernel": {"reduction": True},
+    "_bwd_fused_kernel": {"reduction": True},
+}
+
 _NEG_INF = -1e30          # finite "masked" score: keeps exp()/where() NaN-free
 # The kernels work in BASE-2 log domain: the dot's scalar scale absorbs
 # log2(e), and every softmax exp is jnp.exp2.  The VPU lowers exp(x) as

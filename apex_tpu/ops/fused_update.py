@@ -63,6 +63,22 @@ __all__ = [
 _LANES = 128
 _BLOCK = 512 * 128  # 1-D block: 256 KiB fp32 per operand tile
 
+#: pallas_audit registration (analysis hook only, no behavior change):
+#: flat arrays are padded up to the lane-aligned block, so the block
+#: intentionally exceeds short operands — the tail is masked in-kernel
+#: via the n scalar (APX303 masked_tail); _l2norm's sum-of-squares
+#: accumulates in fp32 scratch (APX302).
+PALLAS_AUDIT = {
+    "_scale_kernel": {"masked_tail": True},
+    "_axpby_kernel": {"masked_tail": True},
+    "_l2norm_kernel": {"reduction": True, "masked_tail": True},
+    "_l2norm_scale_kernel": {"reduction": True, "masked_tail": True},
+    "_adam_kernel": {"masked_tail": True},
+    "_adagrad_kernel": {"masked_tail": True},
+    "_sgd_kernel": {"masked_tail": True},
+    "_lamb1_kernel": {"masked_tail": True},
+}
+
 ADAM_MODE_L2 = 0  # classic Adam: weight decay folded into the gradient
 ADAM_MODE_ADAMW = 1  # decoupled weight decay
 

@@ -42,6 +42,14 @@ __all__ = [
     "rms_norm_reference",
 ]
 
+#: pallas_audit registration (analysis hook only, no behavior change):
+#: both kernels reduce over the hidden dim — mean/var (fwd) and dw/db
+#: partials (bwd) must accumulate in fp32 (APX302).
+PALLAS_AUDIT = {
+    "_ln_fwd_kernel": {"reduction": True},
+    "_ln_bwd_kernel": {"reduction": True},
+}
+
 _MAX_BLOCK_ROWS = 512
 _VMEM_BUDGET_BYTES = 3 * 1024 * 1024  # per fp32 operand tile
 

@@ -60,6 +60,16 @@ __all__ = ["paged_decode_attention", "paged_xla_max_pages",
            "paged_slab_attention", "fused_block_decode", "decode_fusion",
            "fusion_min_pages", "resolve_decode_fusion"]
 
+#: pallas_audit registration (analysis hook only, no behavior change):
+#: both kernels run online-softmax in fp32 scratch (APX302) and mask
+#: beyond-length pages in-kernel — the page grid intentionally covers
+#: the slot's max_pages even when length doesn't fill the last page
+#: (APX303 masked_tail).
+PALLAS_AUDIT = {
+    "_paged_kernel": {"reduction": True, "masked_tail": True},
+    "_fused_block_kernel": {"reduction": True, "masked_tail": True},
+}
+
 #: paged kernel/XLA crossover, in PAGES per slot (the paged analog of
 #: ``_DECODE_XLA_MAX_SEQ``; ~4096 tokens at the default page size 64).
 #: Below it the XLA gather fallback materializes the slot windows —

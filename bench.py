@@ -1160,6 +1160,16 @@ def _microbench_infer(rtt: float, on_tpu: bool):
 
         out["infer_decode_fusion"] = decode_fusion()
         out["infer_fusion_min_pages"] = fusion_min_pages()
+        # the pallas_audit VMEM envelope for THIS measured geometry —
+        # the static model rides the capture so observed fusion
+        # wins/losses can be read against the predicted residency
+        # (capture_hygiene bounds it to (0, chip VMEM capacity])
+        from apex_tpu.analysis.pallas_audit import fused_block_envelope
+        out["fused_vmem_model_bytes"] = fused_block_envelope(
+            cfg.hidden_size,
+            head_dim=cfg.hidden_size // cfg.num_attention_heads,
+            page_size=page_size, max_pages=pages_per_req,
+            slots=slots)["vmem_bytes"]
         fused_layers = _inf_models.fused_layer_params("gpt", cfg,
                                                       engine.params)
         fused_decode_fn = make_decode_fn("gpt", cfg, sampling,

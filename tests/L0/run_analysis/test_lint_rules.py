@@ -201,6 +201,38 @@ def run(state, batches):
     return state, timer.last.seconds
 ''',
     ),
+    "APX111": (
+        '''
+import jax
+from jax.experimental import pallas as pl
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+def scale(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,
+    )(x)
+''',
+        '''
+import jax
+from jax.experimental import pallas as pl
+
+from apex_tpu.utils import interpret_mode
+
+def _scale_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+def scale(x):
+    return pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret_mode(),   # the APEX_TPU_INTERPRET knob
+    )(x)
+''',
+    ),
     "APX109": (
         '''
 import jax

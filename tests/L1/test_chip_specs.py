@@ -19,6 +19,9 @@ def test_table_shape_and_physics():
         assert spec.bf16_tflops > 0
         assert spec.hbm_gbps > 0
         assert spec.hbm_bytes >= 8 * 1024 ** 3   # no chip under 8 GiB
+        # VMEM (ISSUE 16: the pallas_audit envelope bound): on-chip
+        # vector memory is MiB-scale, orders of magnitude under HBM
+        assert 16 * 1024 ** 2 <= spec.vmem_bytes < spec.hbm_bytes // 8
 
 
 def test_find_spec_matches_device_kind_spellings():
@@ -106,6 +109,35 @@ def test_scrub_rejects_nonphysical_compiled_fields():
     assert bench._scrub_capture_values(unknown) == unknown
     over = {"chip": "FutureTPU", "compiled_peak_hbm_bytes": big + 1}
     assert "compiled_peak_hbm_bytes" not in \
+        bench._scrub_capture_values(over)
+
+
+def test_scrub_rejects_nonphysical_vmem_model_fields():
+    """ISSUE 16 satellite: a ``*vmem_model_bytes`` stamp (the
+    pallas_audit envelope riding the fused-decode capture) must be
+    positive and fit the capture's chip's VMEM — a poisoned value
+    vanishes, a valid one survives."""
+    import bench
+
+    v5e = chip_specs.CHIP_SPECS["v5e"]
+    good = {"chip": "TPU v5e",
+            "fused_vmem_model_bytes": v5e.vmem_bytes // 2}
+    assert bench._scrub_capture_values(good) == good
+
+    poisoned = {"chip": "TPU v5e",
+                "fused_vmem_model_bytes": v5e.vmem_bytes + 1,
+                "other_vmem_model_bytes": 0,
+                "spec_vmem_model_bytes": -4096}
+    scrubbed = bench._scrub_capture_values(poisoned)
+    assert scrubbed == {"chip": "TPU v5e"}
+
+    # unknown chip: permissive largest-capacity bound, same policy as
+    # the HBM rule
+    big = max(s.vmem_bytes for s in chip_specs.CHIP_SPECS.values())
+    unknown = {"chip": "FutureTPU", "fused_vmem_model_bytes": big}
+    assert bench._scrub_capture_values(unknown) == unknown
+    over = {"chip": "FutureTPU", "fused_vmem_model_bytes": big + 1}
+    assert "fused_vmem_model_bytes" not in \
         bench._scrub_capture_values(over)
 
 

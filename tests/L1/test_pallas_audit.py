@@ -1,0 +1,154 @@
+"""Tier-1 gate: ``apex-tpu-analyze --kernels --json`` runs the Pallas
+VMEM auditor over ALL registered kernel ops clean against the committed
+``.analysis_kernel_budget.json``, the ledger covers the registered set
+exactly, the ratchet ratchets, and the footprint model actually
+PREDICTS the fused-decode hidden-size cap (the ISSUE 16 acceptance:
+crossover brackets ~2048, tp=2 prices below unsharded)."""
+import json
+
+import pytest
+
+from apex_tpu.analysis.cli import main, repo_root
+from apex_tpu.analysis.pallas_audit import BUDGET_NAME
+
+REPO = repo_root()
+
+# the kernel-bearing ops the auditor must cover (xentropy/fused_lm_xent
+# are XLA-lowered today — their zero-kernel entries pin that fact, and
+# a Pallas rewrite lands in the ledger through them)
+REQUIRED_OPS = {
+    "layer_norm", "rms_norm", "flash_attention", "decode_attention",
+    "paged_decode_attention", "fused_block_decode", "fused_update",
+    "xentropy", "fused_lm_xent",
+}
+
+
+def test_kernels_cli_clean_json_schema(capsys):
+    """One in-process run gates the whole kernel engine: zero findings
+    vs the committed kernel budget, and the documented --json schema.
+    (--no-lint/--no-jaxpr: those engines have their own tier-1 gate.)"""
+    rc = main(["--kernels", "--no-lint", "--no-jaxpr", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["new"]
+
+    assert set(out) == {"new", "suppressed", "total", "kernel_budget"}
+    assert out["new"] == []
+    budget = out["kernel_budget"]
+    assert budget["version"] == 1
+    assert budget["vmem_capacity_bytes"] > 0
+    ops = budget["ops"]
+    assert REQUIRED_OPS <= set(ops), sorted(ops)
+    for name, entry in ops.items():
+        assert {"kernels", "max_kernel_vmem_bytes"} <= set(entry), name
+        for kname, k in entry["kernels"].items():
+            assert {"grid", "vmem_bytes", "resident_bytes",
+                    "scratch_bytes", "prefetch_bytes",
+                    "blocks"} <= set(k), (name, kname)
+            # the model is an envelope: every kernel must fit the chip
+            assert 0 < k["vmem_bytes"] <= budget["vmem_capacity_bytes"]
+
+    # the load-bearing kernels are actually seen
+    assert "_fused_block_kernel" in \
+        ops["fused_block_decode"]["kernels"]
+    assert "_fwd_kernel" in ops["flash_attention"]["kernels"]
+    # the backward kernels ride the vjp fixtures
+    assert "_ln_bwd_kernel" in ops["layer_norm"]["kernels"]
+    # XLA-lowered ops pin their zero-kernel status
+    assert ops["xentropy"]["kernels"] == {}
+
+
+def test_kernel_budget_covers_every_registered_kernel_exactly():
+    """CI guard (ISSUE 16 satellite, the PR 7 budget-guard pattern):
+    the committed ledger's op set == the registered kernel-op set AND
+    each op's kernel set matches a fresh audit — a new kernel can't
+    ship unbudgeted, a deleted one can't linger stale."""
+    from apex_tpu.analysis.pallas_audit import (kernel_specs,
+                                                run_kernel_audit)
+    committed = json.loads((REPO / BUDGET_NAME).read_text())
+    registered = {s.name for s in kernel_specs()}
+    budgeted = set(committed["ops"])
+    assert registered == budgeted, (
+        f"registered-not-budgeted={sorted(registered - budgeted)}, "
+        f"budgeted-not-registered={sorted(budgeted - registered)} — "
+        f"run apex-tpu-analyze --kernels --write-budget and commit")
+
+    findings, report = run_kernel_audit()
+    assert findings == []
+    for name, entry in report["ops"].items():
+        assert set(entry["kernels"]) == \
+            set(committed["ops"][name]["kernels"]), (
+            f"{name}: kernel set drifted vs {BUDGET_NAME} — re-pin "
+            f"with apex-tpu-analyze --kernels --write-budget")
+
+
+def test_kernel_budget_ratchet_fires_on_growth(tmp_path, capsys):
+    """A budget pinned BELOW the current model fails the run (VMEM
+    growth detected); re-pinning with --write-budget clears it."""
+    budget = tmp_path / "kernel_budget.json"
+    args = ["--kernels", "--kernel-ops", "layer_norm", "--no-lint",
+            "--no-jaxpr", "--kernel-budget", str(budget)]
+    assert main(args + ["--write-budget"]) == 0
+    capsys.readouterr()
+
+    pinned = json.loads(budget.read_text())
+    kernels = pinned["ops"]["layer_norm"]["kernels"]
+    k = kernels["_ln_fwd_kernel"]
+    assert k["vmem_bytes"] > 0
+    k["vmem_bytes"] -= 1            # yesterday's kernel was leaner
+    budget.write_text(json.dumps(pinned))
+    rc = main(args)
+    out = capsys.readouterr().out
+    assert rc == 1 and "APX301" in out and "grew" in out
+
+    # re-pin -> clean
+    assert main(args + ["--write-budget"]) == 0
+    capsys.readouterr()
+    assert main(args) == 0
+
+
+def test_write_budget_refuses_restricted_kernel_scan():
+    # a --kernel-ops-restricted run must not replace the shared ledger
+    rc = main(["--kernels", "--kernel-ops", "layer_norm", "--no-lint",
+               "--no-jaxpr", "--write-budget"])
+    assert rc == 2
+
+
+def test_mesh_flag_rejects_garbage():
+    assert main(["--kernels", "--kernel-ops", "layer_norm", "--no-lint",
+                 "--no-jaxpr", "--mesh", "dp=2"]) == 2
+
+
+def test_fusion_crossover_brackets_observed_cap():
+    """THE acceptance check: sweeping hidden sizes through the static
+    model must predict the fused_block_decode fusion cap observed at
+    hidden ~2048 (PERF.md round-15/16).  Tolerance (documented in
+    PERF.md round-16): one sweep step either side — the predicted
+    max_hidden lands in [1024, 4096] with the crossover directly
+    above it."""
+    from apex_tpu.analysis.pallas_audit import predict_fusion_max_hidden
+    pred = predict_fusion_max_hidden()
+    assert pred["max_hidden"] is not None
+    assert 1024 <= pred["max_hidden"] <= 4096, pred
+    assert pred["crossover_hidden"] is not None
+    assert pred["crossover_hidden"] > pred["max_hidden"]
+    # the sweep itself is monotone in hidden (a sanity check on the
+    # model: bigger blocks can't cost less VMEM)
+    sizes = sorted(pred["sweep"])
+    costs = [pred["sweep"][h] for h in sizes]
+    assert costs == sorted(costs)
+
+
+def test_tp2_envelope_prices_below_unsharded():
+    """ISSUE 16 acceptance / ROADMAP item 1's static feasibility: the
+    1/tp-sharded weight blocks shrink the envelope (weights dominate),
+    and the sharded fusion cap moves UP."""
+    from apex_tpu.analysis.pallas_audit import (fused_block_envelope,
+                                                predict_fusion_max_hidden)
+    e1 = fused_block_envelope(2048)
+    e2 = fused_block_envelope(2048, tp=2)
+    assert e2["vmem_bytes"] < e1["vmem_bytes"]
+    # the weight residency roughly halves (attention + mlp weights are
+    # the bulk of the resident set)
+    assert e2["resident_bytes"] < 0.75 * e1["resident_bytes"]
+    assert predict_fusion_max_hidden(tp=2)["max_hidden"] >= \
+        predict_fusion_max_hidden()["max_hidden"]
