@@ -181,6 +181,19 @@ def main(argv: Optional[list] = None) -> int:
               "Pallas kernel has no kernel-budget entry")
         return 0
 
+    # arg-syntax validation happens before ANY engine runs or file is
+    # written: a typo in --mesh must not exit 2 having already rewritten
+    # the kernel-budget ledger under --write-budget
+    mesh_tp = None
+    if args.mesh is not None:
+        key, _, val = args.mesh.partition("=")
+        if key.strip() != "tp" or not val.strip().isdigit() \
+                or int(val) < 1:
+            print(f"apex-tpu-analyze: --mesh expects tp=N (got "
+                  f"{args.mesh!r})", file=sys.stderr)
+            return 2
+        mesh_tp = int(val)
+
     if args.write_budget and not args.kernels:
         args.spmd = True
     if args.spmd:
@@ -242,10 +255,14 @@ def main(argv: Optional[list] = None) -> int:
         from apex_tpu.analysis.pallas_audit import (
             BUDGET_NAME as KERNEL_BUDGET_NAME, compare_kernel_budget,
             predict_fusion_max_hidden, run_kernel_audit)
-        kernel_ops = (args.kernel_ops.split(",") if args.kernel_ops
-                      else None)
-        kernel_findings, kernel_report = run_kernel_audit(
-            kernel_ops, chip=args.chip)
+        kernel_ops = ([s.strip() for s in args.kernel_ops.split(",")
+                       if s.strip()] if args.kernel_ops else None)
+        try:
+            kernel_findings, kernel_report = run_kernel_audit(
+                kernel_ops, chip=args.chip)
+        except ValueError as e:   # unknown --kernel-ops / --chip name
+            print(f"apex-tpu-analyze: {e}", file=sys.stderr)
+            return 2
         findings.extend(kernel_findings)
         kernel_budget_path = (args.kernel_budget
                               or (root / KERNEL_BUDGET_NAME))
@@ -269,14 +286,8 @@ def main(argv: Optional[list] = None) -> int:
             findings.extend(
                 compare_kernel_budget(kernel_report, committed))
 
-        if args.mesh:
-            key, _, val = args.mesh.partition("=")
-            if key.strip() != "tp" or not val.strip().isdigit() \
-                    or int(val) < 1:
-                print(f"apex-tpu-analyze: --mesh expects tp=N (got "
-                      f"{args.mesh!r})", file=sys.stderr)
-                return 2
-            tp = int(val)
+        if mesh_tp is not None:
+            tp = mesh_tp
             mesh_report = {
                 "unsharded": predict_fusion_max_hidden(
                     tp=1, chip=args.chip),

@@ -44,10 +44,14 @@ class ChipSpec:
 CHIP_SPECS: Dict[str, ChipSpec] = {s.key: s for s in [
     ChipSpec("v4", 275.0, 1228.0, 32 * _GiB, 128 * _MiB),
     ChipSpec("v5e", 197.0, 819.0, 16 * _GiB, 128 * _MiB),
-    ChipSpec("v5lite", 197.0, 819.0, 16 * _GiB, 64 * _MiB),
+    # "v5lite"/"v6lite" are alternate device_kind SPELLINGS of v5e/v6e
+    # ("TPU v5 lite" is what real v5e hosts report — PERF.md round-3),
+    # not smaller parts: every figure must match the e-series twin or
+    # capacity-bound scrubs resolve differently by spelling.
+    ChipSpec("v5lite", 197.0, 819.0, 16 * _GiB, 128 * _MiB),
     ChipSpec("v5p", 459.0, 2765.0, 95 * _GiB, 128 * _MiB),
     ChipSpec("v6e", 918.0, 1640.0, 32 * _GiB, 128 * _MiB),
-    ChipSpec("v6lite", 918.0, 1640.0, 32 * _GiB, 64 * _MiB),
+    ChipSpec("v6lite", 918.0, 1640.0, 32 * _GiB, 128 * _MiB),
 ]}
 
 #: the generation assumed when the device kind matches nothing (CPU
