@@ -263,6 +263,20 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "infer_spec_k",
         read_by="apex_tpu/inference/speculative.py"),
     EnvKnob(
+        name="APEX_TPU_SERVE_TP",
+        default="0",
+        effect="tensor-parallel serving width (ISSUE 17): 0/unset = "
+               "single chip; N > 1 shards the engine's param mirrors "
+               "column/row-wise and the paged kv pool over kv heads "
+               "across an N-chip mesh — each step stays ONE donated "
+               "executable (a shard_map mesh program), the page "
+               "table/allocator/prefix cache stay replicated host-side "
+               "logic.  Requires the paged cache; needs tp | heads and "
+               "tp | kv_heads or kv_heads | tp (GQA/MQA replicate "
+               "below tp).  Per-engine override: InferenceEngine(tp=); "
+               "stamped into infer bench captures as infer_serve_tp",
+        read_by="apex_tpu/inference/engine.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

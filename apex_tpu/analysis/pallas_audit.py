@@ -234,6 +234,15 @@ def _builders():
                                     page_size=16, max_pages=4, slots=2,
                                     pages=9)
 
+    def fused_block_decode_tp2():
+        # ISSUE 17: the tp=2 SERVING shard of the same fixture — the
+        # --mesh pricing as a committed ledger row.  fuse_mlp off and
+        # partial_out on, exactly the variant the sharded decode
+        # dispatches (the out-proj psum + MLP tail run outside)
+        return _fused_block_fixture(hidden=64, head_dim=16,
+                                    page_size=16, max_pages=4, slots=2,
+                                    pages=9, tp=2, partial_out=True)
+
     def fused_update():
         from apex_tpu.ops.fused_update import (
             fused_adagrad_flat, fused_adam_flat, fused_axpby,
@@ -290,6 +299,9 @@ def _builders():
         "fused_block_decode": (fused_block_decode,
                                "apex_tpu/ops/paged_attention.py",
                                ops + "paged_attention"),
+        "fused_block_decode_tp2": (fused_block_decode_tp2,
+                                   "apex_tpu/ops/paged_attention.py",
+                                   ops + "paged_attention"),
         "fused_update": (fused_update, "apex_tpu/ops/fused_update.py",
                          ops + "fused_update"),
         "xentropy": (xentropy, "apex_tpu/ops/xentropy.py",
@@ -592,7 +604,7 @@ def _fused_block_fixture(hidden: int, head_dim: int = 64,
                          kv_heads: Optional[int] = None,
                          page_size: int = 64, max_pages: int = 8,
                          slots: int = 8, pages: Optional[int] = None,
-                         tp: int = 1):
+                         tp: int = 1, partial_out: bool = False):
     """Abstract GPT fused-block fixture at the given geometry, with the
     head and ffn dims sharded 1/tp (the TP layout: wq/wk/wv shard
     out-features, wo in-features, wu/wd the ffn dim — each chip holds
@@ -632,11 +644,18 @@ def _fused_block_fixture(hidden: int, head_dim: int = 64,
         "wd": s((ffn, hidden)), "bd": s((1, hidden)),
     }
     pg = s((npages, kvh // tp, page_size, head_dim))
+    args = (s((slots, hidden)), blk, pg, pg,
+            s((slots, max_pages), jnp.int32),
+            s((slots,), jnp.int32))
+    if partial_out:
+        # the SERVED tp shard (ISSUE 17): MLP out of the kernel, the
+        # rank-partial out-proj product emitted for the external psum
+        return (lambda x, b, kp, vp, pt, ln: op(
+            x, b, kp, vp, pt, ln, kind="gpt", eps=1e-5,
+            fuse_mlp=False, partial_out=True), args)
     return (lambda x, b, kp, vp, pt, ln: op(x, b, kp, vp, pt, ln,
                                             kind="gpt", eps=1e-5),
-            (s((slots, hidden)), blk, pg, pg,
-             s((slots, max_pages), jnp.int32),
-             s((slots,), jnp.int32)))
+            args)
 
 
 def fused_block_envelope(hidden: int, *, tp: int = 1,

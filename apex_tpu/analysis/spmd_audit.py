@@ -444,6 +444,54 @@ def _builders():
         fn, args = jaxpr_audit._builders()[which][0]()
         return fn, args, {}
 
+    def _inference_tp2(which):
+        """Tensor-parallel serving executables (ISSUE 17): build a REAL
+        ``InferenceEngine(tp=2)`` on forced host devices and audit its
+        own ``_*_raw`` shard_map step bodies with its own placed
+        operands — the audited mesh program IS the one the engine
+        dispatches, not a re-derived fixture.  GPT at the jaxpr-audit
+        paged fixture geometry; the fused-decode entry compiles the
+        sharded Pallas block (partial_out) + the out-of-kernel psum
+        tail, the verify entry the k=4 sharded slab scoring."""
+        from apex_tpu.inference import kv_cache
+        from apex_tpu.inference.engine import InferenceEngine
+        from apex_tpu.inference.sampling import SamplingConfig
+        from apex_tpu.transformer.testing.standalone_gpt import (
+            GPTConfig, gpt_model_provider)
+        ps.destroy_model_parallel()
+        ps.initialize_model_parallel(1)     # model.init's tp=1 world
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                        num_attention_heads=4, max_seq_length=256,
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        params_dtype=jnp.bfloat16)
+        model = gpt_model_provider(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+        eng = InferenceEngine(
+            "gpt", cfg, params, slots=4, paged=True, page_size=16,
+            num_pages=20, sampling=SamplingConfig(),
+            decode_fusion="1" if which == "decode_fused" else "0",
+            spec_k=4 if which == "verify" else 0, tp=2)
+        cache = eng.init_cache()
+        key, step = eng._key, np.int32(0)
+        shape = dict(eng.mesh.shape)
+        if which == "prefill":
+            row = kv_cache.page_row(list(range(4)),
+                                    eng.max_pages_per_slot,
+                                    eng.num_pages)
+            return eng._prefill_raw, (
+                cache, eng.params, np.zeros((64,), np.int32),
+                np.int32(0), np.int32(10), row, np.int32(0), key,
+                step), shape
+        if which == "decode_fused":
+            return eng._decode_raw, (
+                cache, (eng.params, eng._fused_layers),
+                np.zeros((4,), np.int32), np.ones((4,), bool), key,
+                step), shape
+        return eng._verify_raw, (
+            cache, eng.params, np.zeros((4, 5), np.int32),
+            np.ones((4,), bool), key, step), shape
+
     return {
         # name: (builder, path, donate, flag_undonated, update_unif,
         #        rs_ag, overlap)
@@ -538,6 +586,22 @@ def _builders():
             False),
         "inference_verify_paged": (
             lambda: _inference("inference_verify_paged"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            False),
+        # ISSUE 17: the tensor-parallel serving executables — the
+        # engine's own shard_map mesh programs at tp=2, donated pool
+        # and all; APX217 overlap verified on the sharded fused decode
+        # (per-layer row psums vs the independent pool appends)
+        "inference_prefill_paged_tp2": (
+            lambda: _inference_tp2("prefill"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            False),
+        "inference_decode_fused_paged_tp2": (
+            lambda: _inference_tp2("decode_fused"),
+            "apex_tpu/inference/engine.py", (0,), True, False, False,
+            True),
+        "inference_verify_paged_tp2": (
+            lambda: _inference_tp2("verify"),
             "apex_tpu/inference/engine.py", (0,), True, False, False,
             False),
     }

@@ -43,11 +43,13 @@ same executable-shape discipline with nothing to split.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from apex_tpu import observability as obs
 from apex_tpu.inference import kv_cache, models
@@ -56,13 +58,29 @@ from apex_tpu.inference.speculative import default_spec_k
 from apex_tpu.ops.paged_attention import (decode_fusion as
                                           resolve_fusion_mode,
                                           resolve_decode_fusion)
+from apex_tpu.transformer.parallel_state import serving_mesh
 
 __all__ = ["InferenceEngine", "make_prefill_fn", "make_decode_fn",
-           "make_verify_fn", "prefill_bucket"]
+           "make_verify_fn", "prefill_bucket", "serve_tp"]
+
+
+def serve_tp() -> int:
+    """Effective serving tensor-parallel width from ``APEX_TPU_SERVE_TP``
+    (registered in ``analysis/env_registry.py``): unset/``0`` means
+    single-chip; an explicit ``InferenceEngine(tp=)`` always wins."""
+    raw = os.environ.get("APEX_TPU_SERVE_TP", "0").strip() or "0"
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"APEX_TPU_SERVE_TP must be an integer, got {raw!r}")
+    if v < 0:
+        raise ValueError(f"APEX_TPU_SERVE_TP must be >= 0, got {v}")
+    return v or 1
 
 
 def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
-                    paged: bool = False):
+                    paged: bool = False, tp: int = 1):
     """Pure prefill step.  Dense: ``(cache, params, tokens [s], slot,
     length, key, step) -> (cache, next_token, last_logits)``; paged
     takes extra ``row`` (the slot's ``[max_pages_per_slot]`` page-table
@@ -86,7 +104,8 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
             # length threads into the forward so the lm head projects
             # ONLY the last real position, not every bucket-padded row
             logits, ks, vs = models.prefill_forward(kind, cfg, params,
-                                                    tokens[None], length)
+                                                    tokens[None], length,
+                                                    tp=tp)
         with obs.named_scope("apex_prefill_cache_insert"):
             cache = kv_cache.insert(cache, slot, ks, vs, length)
         with obs.named_scope("apex_prefill_sample"):
@@ -100,7 +119,7 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
         with obs.named_scope("apex_prefill_forward"):
             logits, ks, vs = models.prefill_forward(
                 kind, cfg, params, tokens[None], length, cache=cache,
-                row=row, prefill_from=prefill_from)
+                row=row, prefill_from=prefill_from, tp=tp)
         with obs.named_scope("apex_prefill_cache_insert"):
             cache = kv_cache.insert_tokens(cache, slot, ks, vs, length,
                                            row, prefill_from)
@@ -114,7 +133,7 @@ def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
 
 
 def make_decode_fn(kind: str, cfg, sampling: SamplingConfig,
-                   fused: bool = False):
+                   fused: bool = False, tp: int = 1):
     """Pure decode step: ``(cache, params, tokens [slots], active
     [slots], key, step) -> (cache, next_tokens, logits, truncated)``.
     Every slot computes (static shape); only active slots advance their
@@ -135,7 +154,8 @@ def make_decode_fn(kind: str, cfg, sampling: SamplingConfig,
         with obs.named_scope("apex_decode_forward"):
             logits, cache = models.decode_forward(kind, cfg, tree,
                                                   cache, tokens,
-                                                  fused=fused_layers)
+                                                  fused=fused_layers,
+                                                  tp=tp)
         with obs.named_scope("apex_decode_sample"):
             logits = logits.astype(jnp.float32)
             toks = sample_token(logits, jax.random.fold_in(key, step),
@@ -147,7 +167,8 @@ def make_decode_fn(kind: str, cfg, sampling: SamplingConfig,
     return decode_fn
 
 
-def make_verify_fn(kind: str, cfg, sampling: SamplingConfig, k: int):
+def make_verify_fn(kind: str, cfg, sampling: SamplingConfig, k: int,
+                   tp: int = 1):
     """Pure speculative-verify step (ISSUE 15): ``(cache, params, slab
     [slots, k+1], active [slots], key, step) -> (cache, tokens
     [slots, k+1], n_emit [slots], truncated)``.
@@ -184,7 +205,7 @@ def make_verify_fn(kind: str, cfg, sampling: SamplingConfig, k: int):
     def verify_fn(cache, params, slab, active, key, step):
         with obs.named_scope("apex_verify_forward"):
             logits, cache = models.verify_forward(kind, cfg, params,
-                                                  cache, slab)
+                                                  cache, slab, tp=tp)
         with obs.named_scope("apex_verify_accept"):
             toks = greedy(logits.astype(jnp.float32))    # [slots, k+1]
             match = (toks[:, :-1] == slab[:, 1:]).astype(jnp.int32)
@@ -212,14 +233,26 @@ def prefill_bucket(n: int, max_seq: int, min_bucket: int = 64) -> int:
 
 
 class InferenceEngine:
-    """Single-chip serving engine over a standalone GPT/LLaMA/BERT.
+    """Serving engine over a standalone GPT/LLaMA/BERT — single-chip by
+    default, tensor-parallel over a ``tp``-wide mesh on request.
 
     Static shape contract: ``slots`` concurrent sequences, each with a
     ``max_seq``-deep cache line, decode always batched over every slot.
     The host-side request plumbing lives in
     :class:`apex_tpu.inference.scheduler.SlotScheduler`; this class owns
     the device programs and the cache geometry.
-    """
+
+    Tensor-parallel serving (ISSUE 17): ``tp=N`` (or
+    ``APEX_TPU_SERVE_TP``) shards the param mirrors column/row-wise and
+    the paged kv pool over kv heads across a private one-axis mesh
+    (:func:`~apex_tpu.transformer.parallel_state.serving_mesh`) — a
+    model whose dense mirrors + pool exceed one chip's HBM serves from
+    ``tp`` chips at ~1/tp the per-chip footprint and compute.  Each
+    step stays ONE donated executable (now a mesh program); the page
+    table, allocator, prefix cache, and COW barrier are replicated and
+    byte-identical to single-chip, so the scheduler never changes.
+    Requires the paged cache and a generative model; per-slot outputs
+    are replica-uniform and match the single-chip engine."""
 
     def __init__(self, kind: str, cfg, params, *, slots: int = 4,
                  max_seq: Optional[int] = None, dtype=None,
@@ -230,7 +263,8 @@ class InferenceEngine:
                  num_pages: Optional[int] = None,
                  paged_attn_max_pages: Optional[int] = None,
                  decode_fusion=None, fusion_min_pages=None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 tp: Optional[int] = None):
         if kind not in ("gpt", "llama", "bert"):
             raise ValueError(f"unknown model kind {kind!r}")
         if kind != "bert":
@@ -275,6 +309,21 @@ class InferenceEngine:
             self.page_size = self.num_pages = None
             self.max_pages_per_slot = None
             self.paged_attn_max_pages = None
+        # tensor-parallel serving width (ISSUE 17): explicit kwarg wins,
+        # else APEX_TPU_SERVE_TP, else single chip
+        self.tp = int(tp) if tp is not None else serve_tp()
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.tp > 1:
+            if kind == "bert":
+                raise ValueError(
+                    "tensor-parallel serving is a generative-path "
+                    "feature; BERT is the encode-only path")
+            if not self.paged:
+                raise ValueError(
+                    "tensor-parallel serving shards the PAGED kv pool "
+                    "over kv heads — pass page_size=/num_pages= (the "
+                    "dense slot cache does not shard)")
         if dtype is not None:
             from apex_tpu.optimizers.functional import _cast_floating
             params = _cast_floating(params, dtype)
@@ -303,6 +352,20 @@ class InferenceEngine:
             self._encode = jax.jit(self._make_bert_encode())
         else:
             self.dims = models.model_dims(kind, cfg)
+            # tensor-parallel serving (ISSUE 17): validate the geometry
+            # up front (tp | heads; tp | kvh or kvh | tp), build the
+            # private one-axis serving mesh, and expand GQA/MQA kv
+            # heads below tp in the SERVED mirrors so the plain column
+            # shard hands every rank the kv head its query group reads
+            self.tp_dims = models.tp_dims(kind, cfg, self.tp)
+            self._param_specs = self._fused_specs = None
+            self._cache_specs = None
+            if self.tp > 1:
+                self.mesh = serving_mesh(self.tp)
+                self.params = models.expand_kv_for_tp(
+                    kind, cfg, self.params, self.tp)
+            else:
+                self.mesh = None
             # fused-block decode (ISSUE 15): resolved STATICALLY here —
             # the knob selects which of two lowerings the ONE decode
             # executable compiles, never a per-step branch.  The fused
@@ -316,26 +379,48 @@ class InferenceEngine:
             self._fused_layers = (
                 models.fused_layer_params(kind, cfg, self.params)
                 if self.decode_fused else None)
-            self._prefill = jax.jit(
-                make_prefill_fn(kind, cfg, sampling, paged=self.paged),
-                donate_argnums=(0,))
-            self._decode = jax.jit(
+            if self.tp > 1:
+                self._place_tp_mirrors()
+            P, cs, ps = PartitionSpec, self._cache_specs, self._param_specs
+            # the _raw fns are the exact (shard_map-wrapped at tp > 1)
+            # step bodies the jits below compile — the SPMD audits
+            # trace THESE, so the audited program is the served one
+            self._prefill_raw = self._tp_wrap(
+                make_prefill_fn(kind, cfg, sampling, paged=self.paged,
+                                tp=self.tp),
+                in_specs=(cs, ps) + (P(),) * (7 if self.paged else 5),
+                out_specs=(cs, P(), P()))
+            self._prefill = jax.jit(self._prefill_raw,
+                                    donate_argnums=(0,))
+            dps = ((ps, self._fused_specs) if self.decode_fused else ps)
+            self._decode_raw = self._tp_wrap(
                 make_decode_fn(kind, cfg, sampling,
-                               fused=self.decode_fused),
-                donate_argnums=(0,))
+                               fused=self.decode_fused, tp=self.tp),
+                in_specs=(cs, dps, P(), P(), P(), P()),
+                out_specs=(cs, P(), P(), P()))
+            self._decode = jax.jit(self._decode_raw, donate_argnums=(0,))
             # speculative decoding (ISSUE 15): ONE verify executable
             # per (k, engine) — the slab width is static
             self.spec_k = int(spec_k if spec_k is not None
                               else default_spec_k())
-            self._verify = (jax.jit(
-                make_verify_fn(kind, cfg, sampling, self.spec_k),
-                donate_argnums=(0,)) if self.spec_k else None)
+            if self.spec_k:
+                self._verify_raw = self._tp_wrap(
+                    make_verify_fn(kind, cfg, sampling, self.spec_k,
+                                   tp=self.tp),
+                    in_specs=(cs, ps, P(), P(), P(), P()),
+                    out_specs=(cs, P(), P(), P()))
+                self._verify = jax.jit(self._verify_raw,
+                                       donate_argnums=(0,))
+            else:
+                self._verify_raw = self._verify = None
             if self.paged:
                 # the COW write barrier (ISSUE 12): one donated page
                 # copy, compiled once, dispatched only when a slot must
                 # privatize a page it still shares
-                self._cow = jax.jit(kv_cache.cow_page,
-                                    donate_argnums=(0,))
+                self._cow_raw = self._tp_wrap(
+                    kv_cache.cow_page, in_specs=(cs, P(), P()),
+                    out_specs=cs)
+                self._cow = jax.jit(self._cow_raw, donate_argnums=(0,))
 
     def _refresh_dispatch_counters(self) -> None:
         reg = obs.global_registry()
@@ -352,6 +437,47 @@ class InferenceEngine:
             self._verify_dispatches = reg.declared(
                 "infer_verify_dispatch_total")
 
+    # -- tensor-parallel serving (ISSUE 17) ----------------------------------
+    def _tp_wrap(self, fn, *, in_specs, out_specs):
+        """Per-rank step body -> mesh program: ``shard_map`` over the
+        serving mesh's tensor axis.  tp=1 returns ``fn`` untouched, so
+        the single-chip lowering stays bitwise the pre-TP engine.  The
+        unjitted wrap is what the ``_*_raw`` attributes hold — the SPMD
+        audits trace those, auditing the exact program served."""
+        if self.tp == 1:
+            return fn
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    def _place_tp_mirrors(self) -> None:
+        """Column/row-partition the served mirrors onto the mesh: spec
+        trees from :func:`models.param_partition_specs` /
+        :func:`models.fused_partition_specs`, every leaf ``device_put``
+        with its ``NamedSharding`` at construction so dispatch never
+        reshards (the jitted steps see already-placed operands)."""
+        mesh = self.mesh
+
+        def put(tree, specs):
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                tree, specs)
+
+        self._param_specs = models.param_partition_specs(
+            self.kind, self.cfg, self.params, self.tp)
+        self.params = put(self.params, self._param_specs)
+        if self._fused_layers is not None:
+            self._fused_specs = models.fused_partition_specs(
+                self._fused_layers, self.tp)
+            self._fused_layers = put(self._fused_layers,
+                                     self._fused_specs)
+        # page table / lengths / capacity replicated, k/v pool sharded
+        # over the kv-head dim — the host-side allocator, prefix cache,
+        # COW, and eviction logic never see the shard boundary
+        self._cache_specs = kv_cache.paged_cache_partition_specs(
+            attn_max_pages=self.paged_attn_max_pages)
+        self._key = jax.device_put(
+            self._key, NamedSharding(mesh, PartitionSpec()))
+
     # -- cache ---------------------------------------------------------------
     def init_cache(self):
         if self.kind == "bert":
@@ -359,12 +485,23 @@ class InferenceEngine:
                              "cache); use encode()")
         d = self.dims
         if self.paged:
-            return kv_cache.init_paged_cache(
-                self.num_pages, d["layers"], d["kv_heads"],
+            # under tp the GLOBAL pool carries kv_heads_pool heads
+            # (kvh * rep — GQA/MQA replicate below tp); the k/v leaves
+            # then shard over the kv-head dim, handing each rank
+            # kv_heads_pool / tp heads of every page
+            cache = kv_cache.init_paged_cache(
+                self.num_pages, d["layers"],
+                self.tp_dims["kv_heads_pool"],
                 self.page_size, d["head_dim"], slots=self.slots,
                 max_pages_per_slot=self.max_pages_per_slot,
                 dtype=self.cache_dtype,
                 attn_max_pages=self.paged_attn_max_pages)
+            if self.tp > 1:
+                cache = jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(
+                        x, NamedSharding(self.mesh, s)),
+                    cache, self._cache_specs)
+            return cache
         return kv_cache.init_cache(
             self.slots, d["layers"], d["kv_heads"], self.max_seq,
             d["head_dim"], dtype=self.cache_dtype)
@@ -382,10 +519,15 @@ class InferenceEngine:
 
     def cache_hbm_bytes(self) -> int:
         """Bytes the KV cache pins in HBM: pool pages (paged, incl. the
-        trash page) or slots x max_seq (dense)."""
+        trash page) or slots x max_seq (dense).  Under tensor-parallel
+        serving this is PER-RANK bytes — the pool shards over kv heads,
+        so each chip pins ``kv_heads_pool / tp`` heads (= 1/tp of the
+        tp-divisible pool; an MQA pool replicated below tp pins its one
+        kv head per rank)."""
         d = self.dims
         itemsize = jnp.dtype(self.cache_dtype).itemsize
-        per_tok = 2 * d["layers"] * d["kv_heads"] * d["head_dim"] * itemsize
+        kvh = self.tp_dims["kv_heads_pool"] // self.tp   # per-rank heads
+        per_tok = 2 * d["layers"] * kvh * d["head_dim"] * itemsize
         if self.paged:
             return (self.num_pages + 1) * self.page_size * per_tok
         return self.slots * self.max_seq * per_tok

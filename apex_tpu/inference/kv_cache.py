@@ -62,9 +62,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
 __all__ = ["KVCache", "init_cache", "PagedKVCache", "init_paged_cache",
            "PageAllocator", "default_page_size", "insert_tokens",
-           "cow_page", "append_slab", "advance_by", "set_lengths"]
+           "cow_page", "append_slab", "advance_by", "set_lengths",
+           "paged_cache_partition_specs"]
 
 _PAGE_SIZE_ENV = "APEX_TPU_PAGE_SIZE"
 _DEFAULT_PAGE_SIZE = 64
@@ -347,6 +350,16 @@ class PagedKVCache:
     kernel/XLA crossover override for
     :func:`~apex_tpu.ops.paged_attention.paged_decode_attention`
     (None = the env/default dispatch).
+
+    Tensor-parallel serving (ISSUE 17) shards ONLY the ``k``/``v``
+    pool, over the kv-head dim (``kv_heads/tp`` heads per rank — see
+    :func:`paged_cache_partition_specs`); the page table, lengths and
+    capacity stay REPLICATED, so admission, prefix sharing, COW and
+    eviction run unchanged on the host-side allocator.  Inside the
+    engine's ``shard_map`` every mutator here sees the per-rank shard
+    as an ordinary pool — the shape checks validate against the
+    LOCAL ``kv_heads`` and all page/length arithmetic is rank-
+    invariant.
     """
     k: jax.Array           # [pages, layers, kv_heads, page_size, head_dim]
     v: jax.Array           # same shape/dtype as k
@@ -420,6 +433,23 @@ def init_paged_cache(pages: int, layers: int, kv_heads: int,
         lengths=jnp.zeros((slots,), jnp.int32),
         capacity=jnp.zeros((slots,), jnp.int32),
         attn_max_pages=attn_max_pages)
+
+
+def paged_cache_partition_specs(attn_max_pages: Optional[int] = None,
+                                axis: str = TENSOR_AXIS) -> PagedKVCache:
+    """The pool's ``PartitionSpec`` tree for tensor-parallel serving:
+    ``k``/``v`` ``[pages+1, layers, kv_heads/tp, page_size, head_dim]``
+    sharded over the kv-head dim, page table / lengths / capacity
+    replicated — each rank's pages are a contiguous slab (the ragged-
+    paged-attention layout argument), and page IDs mean the same thing
+    on every rank.  Doubles as the engine's ``shard_map`` in/out spec
+    for the cache operand and as the ``NamedSharding`` source for the
+    one-time ``device_put``; ``attn_max_pages`` must match the cache it
+    will describe (aux data participates in pytree equality)."""
+    from jax.sharding import PartitionSpec as P
+    kv = P(None, None, axis, None, None)
+    return PagedKVCache(k=kv, v=kv, page_table=P(), lengths=P(),
+                        capacity=P(), attn_max_pages=attn_max_pages)
 
 
 def page_row(page_ids: Sequence[int], max_pages_per_slot: int,

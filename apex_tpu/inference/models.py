@@ -16,6 +16,23 @@ Single-chip serving (tp = 1): the TP layers all collapse to plain
 matmuls at world size 1, which is what these forwards implement.
 Unsupported training-only configs (scan_layers, MoE FFN, sequence/
 context parallelism) fail loudly at engine construction.
+
+Multi-chip serving (ISSUE 17): every forward takes a static ``tp`` and,
+at ``tp > 1``, runs as the per-rank body of a ``shard_map`` over the
+``parallel_state`` tensor axis — the same column/row partitioning the
+training ``transformer/tensor_parallel`` layers implement.  qkv / gate /
+up projections are column-sharded over heads/ffn (no comm), out-proj and
+down-proj are row-sharded with ONE psum each at the row boundary
+(:func:`_row_linear` — the ``RowParallelLinear`` reduce, bias added
+once AFTER the reduction), and the embedding / LM head are
+vocab-sharded: the lookup is the ``VocabParallelEmbedding``
+mask-clip-take-zero-psum (the PR 9 vocab-parallel xent target-pick
+algebra), the head a local vocab-shard matmul whose tiled ``all_gather``
+reassembles the full logits rank-major — original vocab order — so
+sampling stays replica-uniform off one folded key.  GQA/MQA kv heads
+replicate below tp (:func:`expand_kv_for_tp`): each kv head's packed
+columns repeat ``tp/kvh`` times head-major, so the plain column shard
+hands every rank exactly the kv head its query group reads.
 """
 from __future__ import annotations
 
@@ -38,10 +55,13 @@ from apex_tpu.ops.paged_attention import (
 from apex_tpu.transformer.functional.fused_rope import (
     fused_apply_rotary_pos_emb_cached,
 )
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.testing.standalone_llama import _rope_cos_sin
 
-__all__ = ["model_dims", "check_supported", "prefill_forward",
-           "decode_forward", "verify_forward", "fused_layer_params"]
+__all__ = ["model_dims", "tp_dims", "check_supported", "prefill_forward",
+           "decode_forward", "verify_forward", "fused_layer_params",
+           "expand_kv_for_tp", "param_partition_specs",
+           "fused_partition_specs"]
 
 
 def model_dims(kind: str, cfg) -> dict:
@@ -52,6 +72,36 @@ def model_dims(kind: str, cfg) -> dict:
                 else cfg.num_attention_heads)
     return {"layers": cfg.num_layers, "heads": cfg.num_attention_heads,
             "kv_heads": kv_heads, "head_dim": head_dim}
+
+
+def tp_dims(kind: str, cfg, tp: int) -> dict:
+    """Per-rank geometry under tensor-parallel serving, validated.
+
+    ``heads_local`` / ``kv_heads_local`` are what each rank's forwards
+    compute with; ``kv_heads_pool`` is the GLOBAL kv-head count of the
+    sharded paged pool (``kvh * rep`` — GQA/MQA heads replicate below
+    tp, each kv head repeated ``rep = tp/kvh`` times head-major so the
+    plain shard over the pool's kv-head dim hands every rank the kv
+    head its query group reads)."""
+    d = model_dims(kind, cfg)
+    heads, kvh = d["heads"], d["kv_heads"]
+    if tp <= 1:
+        return dict(d, heads_local=heads, kv_heads_local=kvh,
+                    kv_heads_pool=kvh, rep=1)
+    if heads % tp:
+        raise ValueError(
+            f"tp={tp} does not divide num_attention_heads={heads}")
+    if kvh % tp == 0:
+        rep = 1
+    elif tp % kvh == 0:
+        rep = tp // kvh
+    else:
+        raise ValueError(
+            f"tp={tp} vs kv_heads={kvh}: need tp | kv_heads (shard) or "
+            f"kv_heads | tp (replicate below tp)")
+    return dict(d, heads_local=heads // tp,
+                kv_heads_local=max(kvh // tp, 1),
+                kv_heads_pool=kvh * rep, rep=rep)
 
 
 def check_supported(kind: str, cfg) -> None:
@@ -81,6 +131,49 @@ def _linear(p, x):
     if "bias" in p:
         y = y + p["bias"]
     return y
+
+
+def _row_linear(p, x, tp):
+    """RowParallelLinear forward: the local in-shard matmul, ONE psum
+    at the row boundary, bias added once AFTER the reduction (the
+    training layers' ``reduce_from_tensor_model_parallel_region``
+    discipline — a per-rank bias would add ``tp`` copies).  At tp=1
+    this is :func:`_linear` op for op."""
+    y = jnp.matmul(x, p["weight"].T)
+    if tp > 1:
+        y = jax.lax.psum(y, TENSOR_AXIS)
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _vocab_embed(emb_w, tokens, tp):
+    """Vocab-parallel embedding lookup (the ``VocabParallelEmbedding``
+    mask-clip-take-zero-psum, shared with the PR 9 vocab-parallel xent
+    target pick): each rank holds rows ``[rank*vp, (rank+1)*vp)`` of
+    the table, out-of-shard tokens gather row 0 and are zeroed, and the
+    psum reassembles the full embedding replica-uniform."""
+    if tp <= 1:
+        return jnp.take(emb_w, tokens, axis=0)
+    vp = emb_w.shape[0]
+    start = jax.lax.axis_index(TENSOR_AXIS) * vp
+    mask = (tokens < start) | (tokens >= start + vp)
+    local = jnp.clip(tokens - start, 0, vp - 1)
+    e = jnp.take(emb_w, local, axis=0)
+    e = jnp.where(mask[..., None], jnp.zeros((), e.dtype), e)
+    return jax.lax.psum(e, TENSOR_AXIS)
+
+
+def _gather_logits(local, tp):
+    """Reassemble vocab-sharded logits: a tiled ``all_gather`` over the
+    tensor axis concatenates the rank shards along the vocab dim in
+    rank-major order — which IS the original vocab order (shard ``r``
+    holds rows ``[r*vp, (r+1)*vp)``), so greedy/sampled tokens off the
+    gathered logits are replica-uniform with one folded key."""
+    if tp <= 1:
+        return local
+    return jax.lax.all_gather(local, TENSOR_AXIS,
+                              axis=local.ndim - 1, tiled=True)
 
 
 def _suffix_attend(cache, layer: int, row, q, k, v, start):
@@ -210,13 +303,16 @@ def fused_layer_params(kind: str, cfg, params):
             }
         else:
             att = lp["attention"]
-            kvw = jnp.transpose(att["kv_proj"]["weight"]).reshape(
-                hidden, kvh, 2, d)
+            kvw = jnp.transpose(att["kv_proj"]["weight"])
+            # kv-head count from the WEIGHT, not the config: a
+            # kv-expanded tree (expand_kv_for_tp) carries kvh*rep heads
+            kvh_w = kvw.shape[1] // (2 * d)
+            kvw = kvw.reshape(hidden, kvh_w, 2, d)
             blk = {
                 "ln1_w": lp["input_norm"]["weight"].reshape(1, hidden),
                 "wq": jnp.transpose(att["q_proj"]["weight"]),
-                "wk": kvw[:, :, 0, :].reshape(hidden, kvh * d),
-                "wv": kvw[:, :, 1, :].reshape(hidden, kvh * d),
+                "wk": kvw[:, :, 0, :].reshape(hidden, kvh_w * d),
+                "wv": kvw[:, :, 1, :].reshape(hidden, kvh_w * d),
                 "wo": jnp.transpose(att["o_proj"]["weight"]),
                 "ln2_w": lp["post_attention_norm"]["weight"].reshape(
                     1, hidden),
@@ -226,6 +322,141 @@ def fused_layer_params(kind: str, cfg, params):
             }
         out.append(blk)
     return out
+
+
+# --------------------------------------------------------------------------
+# tensor-parallel param mirrors (ISSUE 17)
+# --------------------------------------------------------------------------
+
+#: parent module names whose ``weight`` is column-partitioned ([out, in]
+#: layout, out dim sharded — heads/ffn/vocab-major, so whole heads land
+#: per rank) and whose ``bias`` shards with the out dim
+_COL_PARENTS = frozenset({
+    "query_key_value", "dense_h_to_4h",            # gpt
+    "q_proj", "kv_proj", "gate_proj", "up_proj",   # llama
+    "lm_head", "word_embeddings", "embed_tokens",  # vocab-sharded
+})
+
+#: parent module names whose ``weight`` is row-partitioned (in dim
+#: sharded); their bias stays replicated — added once post-psum
+_ROW_PARENTS = frozenset({
+    "dense", "dense_4h_to_h",                      # gpt
+    "o_proj", "down_proj",                         # llama
+})
+
+
+def expand_kv_for_tp(kind: str, cfg, params, tp: int):
+    """Replicate GQA/MQA kv heads below tp (``rep = tp/kvh > 1``): each
+    kv head's packed ``[2*head_dim]`` output columns of ``kv_proj``
+    repeat ``rep`` times head-major, so the plain column shard over the
+    expanded out dim hands every rank exactly the kv head its query
+    group reads — the training layers' "replicate below tp" for
+    serving mirrors.  Identity when ``rep == 1`` (tp=1, MHA, or
+    tp-divisible GQA)."""
+    td = tp_dims(kind, cfg, tp)
+    rep, kvh, d = td["rep"], td["kv_heads"], td["head_dim"]
+    if rep == 1:
+        return params
+    sub = _params_subtree(params)
+    fixed = dict(sub)
+    for name, lp in sub.items():
+        if not name.startswith("layer_"):
+            continue
+        kvp = dict(lp["attention"]["kv_proj"])
+        w = kvp["weight"]                          # [kvh*2d, hidden]
+        kvp["weight"] = jnp.repeat(
+            w.reshape(kvh, 2 * d, w.shape[1]), rep, axis=0
+        ).reshape(kvh * rep * 2 * d, w.shape[1])
+        if "bias" in kvp:
+            kvp["bias"] = jnp.repeat(
+                kvp["bias"].reshape(kvh, 2 * d), rep, axis=0).reshape(-1)
+        att = dict(lp["attention"])
+        att["kv_proj"] = kvp
+        fixed[name] = dict(lp)
+        fixed[name]["attention"] = att
+    if sub is not params:
+        return {**params, "params": fixed}
+    return fixed
+
+
+def param_partition_specs(kind: str, cfg, params, tp: int):
+    """``PartitionSpec`` tree for the (kv-expanded) param tree: qkv /
+    gate / up column-sharded over heads/ffn, out-proj / down
+    row-sharded, embed + LM head vocab-sharded, norms / position table
+    replicated.  Validates divisibility leaf by leaf so a bad geometry
+    names the offending module."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        if tp <= 1:
+            return P()
+        keys = [getattr(k, "key", getattr(k, "name", str(k)))
+                for k in path]
+        name = keys[-1] if keys else ""
+        parent = keys[-2] if len(keys) >= 2 else ""
+        if parent in _COL_PARENTS:
+            if leaf.shape[0] % tp:
+                raise ValueError(
+                    f"tp={tp} does not divide {parent}.{name} out dim "
+                    f"{leaf.shape[0]}")
+            return (P(TENSOR_AXIS, None) if name == "weight"
+                    else P(TENSOR_AXIS))
+        if parent in _ROW_PARENTS:
+            if name == "weight":
+                if leaf.shape[1] % tp:
+                    raise ValueError(
+                        f"tp={tp} does not divide {parent}.weight in "
+                        f"dim {leaf.shape[1]}")
+                return P(None, TENSOR_AXIS)
+            return P()                  # row bias: replicated, post-psum
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def fused_partition_specs(fused_layers, tp: int):
+    """``PartitionSpec`` list matching :func:`fused_layer_params`'s
+    ``[in, out]`` layout: q/k/v/gate/up planes column-sharded on the
+    out dim, out-proj/down row-sharded on the in dim, norms and the
+    post-psum biases (``bo``/``bd``) replicated."""
+    from jax.sharding import PartitionSpec as P
+    col = {"wq", "bq", "wk", "bk", "wv", "bv", "wg", "wu", "bu"}
+    row = {"wo", "wd"}
+
+    def one(blk):
+        out = {}
+        for k in blk:
+            if tp > 1 and k in col:
+                out[k] = P(None, TENSOR_AXIS)
+            elif tp > 1 and k in row:
+                out[k] = P(TENSOR_AXIS, None)
+            else:
+                out[k] = P()
+        return out
+    return [one(b) for b in fused_layers]
+
+
+def _fused_block_tail_tp(kind: str, blk, x, part, eps):
+    """Finish one fused block OUTSIDE the kernel under tp: psum the
+    rank-partial attention output at the row boundary (the out-proj
+    psum the ISSUE moves out of the kernel), add the out-proj bias
+    once, then norm2 + the column/row-parallel MLP with its own
+    row-boundary psum — the same two-psums-per-layer the unfused
+    sharded path pays."""
+    attn = jax.lax.psum(part, TENSOR_AXIS)
+    if kind == "gpt":
+        x2 = x + attn + blk["bo"]
+        h2 = layer_norm(x2, blk["ln2_w"].reshape(-1),
+                        blk["ln2_b"].reshape(-1))
+        u = jax.nn.gelu(jnp.matmul(h2, blk["wu"]) + blk["bu"])
+        y = jax.lax.psum(jnp.matmul(u, blk["wd"]), TENSOR_AXIS)
+        y = y + blk["bd"]
+    else:
+        x2 = x + attn
+        h2 = rms_norm(x2, blk["ln2_w"].reshape(-1), eps=eps)
+        u = jax.nn.silu(jnp.matmul(h2, blk["wg"])) * jnp.matmul(
+            h2, blk["wu"])
+        y = jax.lax.psum(jnp.matmul(u, blk["wd"]), TENSOR_AXIS)
+    return x2 + y
 
 
 # --------------------------------------------------------------------------
@@ -240,9 +471,10 @@ def _gpt_attn_proj(lp, h, heads, head_dim):
     return jnp.split(qkv, 3, axis=-1)
 
 
-def _gpt_mlp(lp, h):
-    return _linear(lp["mlp"]["dense_4h_to_h"],
-                   jax.nn.gelu(_linear(lp["mlp"]["dense_h_to_4h"], h)))
+def _gpt_mlp(lp, h, tp=1):
+    return _row_linear(lp["mlp"]["dense_4h_to_h"],
+                       jax.nn.gelu(_linear(lp["mlp"]["dense_h_to_4h"],
+                                           h)), tp)
 
 
 def _last_row(h, length):
@@ -256,15 +488,15 @@ def _last_row(h, length):
 
 
 def _gpt_prefill(cfg, params, tokens, length=None, cache=None, row=None,
-                 start=None):
+                 start=None, tp=1):
     p = _params_subtree(params)
     b, s = tokens.shape
     dims = model_dims("gpt", cfg)
-    heads, head_dim = dims["heads"], dims["head_dim"]
+    heads, head_dim = dims["heads"] // tp, dims["head_dim"]
     suffix = cache is not None          # static: suffix-prefill variant
 
     emb_w = p["embedding"]["word_embeddings"]["weight"]
-    h = jnp.take(emb_w, tokens, axis=0)                     # [b, s, h]
+    h = _vocab_embed(emb_w, tokens, tp)                     # [b, s, h]
     pos_tab = p["embedding"]["position_embeddings"]
     if suffix:
         # rows sit at absolute positions start + i (clamped: dead
@@ -293,10 +525,10 @@ def _gpt_prefill(cfg, params, tokens, length=None, cache=None, row=None,
         else:
             ctx = flash_attention(q, k, v, causal=True)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
-        x = x + _linear(lp["self_attention"]["dense"], ctx)
+        x = x + _row_linear(lp["self_attention"]["dense"], ctx, tp)
         h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
                         lp["post_attention_layernorm"]["bias"])
-        h = x + _gpt_mlp(lp, h2)
+        h = x + _gpt_mlp(lp, h2, tp)
 
     h = layer_norm(h, p["final_layernorm"]["weight"],
                    p["final_layernorm"]["bias"])
@@ -305,23 +537,35 @@ def _gpt_prefill(cfg, params, tokens, length=None, cache=None, row=None,
         logits = jnp.einsum("bh,vh->bv", _last_row(h, last), emb_w)
     else:
         logits = jnp.einsum("sbh,vh->sbv", h, emb_w)        # tied head
-    return logits, jnp.stack(ks), jnp.stack(vs)
+    return _gather_logits(logits, tp), jnp.stack(ks), jnp.stack(vs)
 
 
-def _gpt_decode(cfg, params, cache, tokens, fused=None):
+def _gpt_decode(cfg, params, cache, tokens, fused=None, tp=1):
     p = _params_subtree(params)
     dims = model_dims("gpt", cfg)
-    heads, head_dim = dims["heads"], dims["head_dim"]
+    heads, head_dim = dims["heads"] // tp, dims["head_dim"]
     positions = cache.lengths                               # [slots]
 
     emb_w = p["embedding"]["word_embeddings"]["weight"]
-    h = jnp.take(emb_w, tokens, axis=0)                     # [slots, h]
+    h = _vocab_embed(emb_w, tokens, tp)                     # [slots, h]
     h = h + jnp.take(p["embedding"]["position_embeddings"],
                      positions, axis=0)
 
     live = positions + 1                    # incl. the token written now
     for i in range(cfg.num_layers):
         if fused is not None:
+            if tp > 1:
+                # sharded fused block (ISSUE 17): the kernel runs on
+                # the 1/tp weight shard and emits the RANK-PARTIAL
+                # out-proj product (no residual, no bias) — the row
+                # psum + bias + norm2 + col/row MLP finish outside
+                part, k_tok, v_tok = fused_block_decode(
+                    h, fused[i], cache.k[:, i], cache.v[:, i],
+                    cache.page_table, positions, kind="gpt", eps=1e-5,
+                    fuse_mlp=False, partial_out=True)
+                cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
+                h = _fused_block_tail_tp("gpt", fused[i], h, part, 1e-5)
+                continue
             # ISSUE 15: the whole block in ONE kernel (norm1 -> qkv ->
             # paged attention incl. this token -> out proj -> norm2 ->
             # MLP); only the pool append leaves the per-op path
@@ -337,19 +581,19 @@ def _gpt_decode(cfg, params, cache, tokens, fused=None):
         q, k_tok, v_tok = _gpt_attn_proj(lp, h1, heads, head_dim)
         cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
         ctx = _cache_attend(cache, i, q, live)
-        x = x + _linear(lp["self_attention"]["dense"],
-                        ctx.reshape(ctx.shape[0], -1))
+        x = x + _row_linear(lp["self_attention"]["dense"],
+                            ctx.reshape(ctx.shape[0], -1), tp)
         h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
                         lp["post_attention_layernorm"]["bias"])
-        h = x + _gpt_mlp(lp, h2)
+        h = x + _gpt_mlp(lp, h2, tp)
 
     h = layer_norm(h, p["final_layernorm"]["weight"],
                    p["final_layernorm"]["bias"])
     logits = jnp.einsum("bh,vh->bv", h, emb_w)
-    return logits, cache
+    return _gather_logits(logits, tp), cache
 
 
-def _gpt_verify(cfg, params, cache, tokens):
+def _gpt_verify(cfg, params, cache, tokens, tp=1):
     """Speculative verify (ISSUE 15): score an ``S``-token drafted slab
     per slot in ONE batched step — logits at EVERY slab position, the
     slab's k/v appended at ``[lengths, lengths + S)``.  Lengths do not
@@ -358,14 +602,14 @@ def _gpt_verify(cfg, params, cache, tokens):
     rollback."""
     p = _params_subtree(params)
     dims = model_dims("gpt", cfg)
-    heads, head_dim = dims["heads"], dims["head_dim"]
+    heads, head_dim = dims["heads"] // tp, dims["head_dim"]
     slots, s = tokens.shape
     base = cache.lengths                                    # [slots]
     pos = base[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
 
     emb_w = p["embedding"]["word_embeddings"]["weight"]
     pos_tab = p["embedding"]["position_embeddings"]
-    h = jnp.take(emb_w, tokens, axis=0)                     # [b, S, hid]
+    h = _vocab_embed(emb_w, tokens, tp)                     # [b, S, hid]
     h = h + jnp.take(pos_tab,
                      jnp.minimum(pos, jnp.int32(pos_tab.shape[0] - 1)),
                      axis=0)
@@ -380,15 +624,15 @@ def _gpt_verify(cfg, params, cache, tokens):
         cache = kv_cache.append_slab(cache, i, k, v)
         ctx = _slab_attend(cache, i, q, base)               # [b,h,S,d]
         ctx = ctx.transpose(0, 2, 1, 3).reshape(slots, s, -1)
-        x = x + _linear(lp["self_attention"]["dense"], ctx)
+        x = x + _row_linear(lp["self_attention"]["dense"], ctx, tp)
         h2 = layer_norm(x, lp["post_attention_layernorm"]["weight"],
                         lp["post_attention_layernorm"]["bias"])
-        h = x + _gpt_mlp(lp, h2)
+        h = x + _gpt_mlp(lp, h2, tp)
 
     h = layer_norm(h, p["final_layernorm"]["weight"],
                    p["final_layernorm"]["bias"])
     logits = jnp.einsum("bsh,vh->bsv", h, emb_w)
-    return logits, cache
+    return _gather_logits(logits, tp), cache
 
 
 # --------------------------------------------------------------------------
@@ -411,22 +655,24 @@ def _llama_proj(lp, h, cfg, heads, kv_heads, head_dim):
     return q, k, v
 
 
-def _llama_mlp(lp, h):
+def _llama_mlp(lp, h, tp=1):
     gate = _linear(lp["mlp"]["gate_proj"], h)
     up = _linear(lp["mlp"]["up_proj"], h)
-    return _linear(lp["mlp"]["down_proj"], jax.nn.silu(gate) * up)
+    return _row_linear(lp["mlp"]["down_proj"],
+                       jax.nn.silu(gate) * up, tp)
 
 
 def _llama_prefill(cfg, params, tokens, length=None, cache=None,
-                   row=None, start=None):
+                   row=None, start=None, tp=1):
     p = _params_subtree(params)
     b, s = tokens.shape
-    dims = model_dims("llama", cfg)
-    heads, kv_heads = dims["heads"], dims["kv_heads"]
-    head_dim, group = dims["head_dim"], heads // kv_heads
+    dims = tp_dims("llama", cfg, tp)
+    heads, kv_heads = dims["heads_local"], dims["kv_heads_local"]
+    head_dim, group = dims["head_dim"], (dims["heads_local"]
+                                         // dims["kv_heads_local"])
     suffix = cache is not None          # static: suffix-prefill variant
 
-    h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
+    h = _vocab_embed(p["embed_tokens"]["weight"], tokens, tp)
     h = h.transpose(1, 0, 2)                                # [s, b, h]
     if suffix:
         # RoPE at the slab's absolute positions start + i (clamped for
@@ -465,10 +711,10 @@ def _llama_prefill(cfg, params, tokens, length=None, cache=None,
             q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
             ctx = flash_attention(q, k, v, causal=True)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
-        x = x + _linear(lp["attention"]["o_proj"], ctx)
+        x = x + _row_linear(lp["attention"]["o_proj"], ctx, tp)
         h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
                       eps=cfg.rms_eps)
-        h = x + _llama_mlp(lp, h1)
+        h = x + _llama_mlp(lp, h1, tp)
 
     h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
     if length is not None:
@@ -476,17 +722,17 @@ def _llama_prefill(cfg, params, tokens, length=None, cache=None,
         logits = _linear(p["lm_head"], _last_row(h, last))    # [b, v]
     else:
         logits = _linear(p["lm_head"], h)                     # [s, b, v]
-    return logits, jnp.stack(ks), jnp.stack(vs)
+    return _gather_logits(logits, tp), jnp.stack(ks), jnp.stack(vs)
 
 
-def _llama_decode(cfg, params, cache, tokens, fused=None):
+def _llama_decode(cfg, params, cache, tokens, fused=None, tp=1):
     p = _params_subtree(params)
-    dims = model_dims("llama", cfg)
-    heads, kv_heads = dims["heads"], dims["kv_heads"]
+    dims = tp_dims("llama", cfg, tp)
+    heads, kv_heads = dims["heads_local"], dims["kv_heads_local"]
     head_dim = dims["head_dim"]
     positions = cache.lengths
 
-    h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
+    h = _vocab_embed(p["embed_tokens"]["weight"], tokens, tp)
     cos_t, sin_t = _llama_rope_table(cfg, head_dim, cache.max_seq)
     cos2 = jnp.take(cos_t, positions, axis=0)               # [slots, d]
     sin2 = jnp.take(sin_t, positions, axis=0)
@@ -495,6 +741,16 @@ def _llama_decode(cfg, params, cache, tokens, fused=None):
     live = positions + 1
     for i in range(cfg.num_layers):
         if fused is not None:
+            if tp > 1:
+                part, k_tok, v_tok = fused_block_decode(
+                    h, fused[i], cache.k[:, i], cache.v[:, i],
+                    cache.page_table, positions, kind="llama",
+                    eps=cfg.rms_eps, cos=cos2, sin=sin2,
+                    fuse_mlp=False, partial_out=True)
+                cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
+                h = _fused_block_tail_tp("llama", fused[i], h, part,
+                                         cfg.rms_eps)
+                continue
             h, k_tok, v_tok = fused_block_decode(
                 h, fused[i], cache.k[:, i], cache.v[:, i],
                 cache.page_table, positions, kind="llama",
@@ -511,31 +767,31 @@ def _llama_decode(cfg, params, cache, tokens, fused=None):
         cache = kv_cache.append_layer(cache, i, k_tok, v_tok)
         # grouped-query scoring straight off the per-kv-head cache/pool
         ctx = _cache_attend(cache, i, q, live)
-        x = x + _linear(lp["attention"]["o_proj"],
-                        ctx.reshape(ctx.shape[0], -1))
+        x = x + _row_linear(lp["attention"]["o_proj"],
+                            ctx.reshape(ctx.shape[0], -1), tp)
         h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
                       eps=cfg.rms_eps)
-        h = x + _llama_mlp(lp, h1)
+        h = x + _llama_mlp(lp, h1, tp)
 
     h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
     logits = _linear(p["lm_head"], h)                       # [slots, v]
-    return logits, cache
+    return _gather_logits(logits, tp), cache
 
 
-def _llama_verify(cfg, params, cache, tokens):
+def _llama_verify(cfg, params, cache, tokens, tp=1):
     """LLaMA twin of :func:`_gpt_verify`: RoPE at each slab row's
     absolute position, GQA/MQA slab scoring straight off the
     per-kv-head cache/pool."""
     p = _params_subtree(params)
-    dims = model_dims("llama", cfg)
-    heads, kv_heads = dims["heads"], dims["kv_heads"]
+    dims = tp_dims("llama", cfg, tp)
+    heads, kv_heads = dims["heads_local"], dims["kv_heads_local"]
     head_dim = dims["head_dim"]
     slots, s = tokens.shape
     base = cache.lengths
     pos = base[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
     pos = jnp.minimum(pos, jnp.int32(cache.max_seq - 1))
 
-    h = jnp.take(p["embed_tokens"]["weight"], tokens, axis=0)
+    h = _vocab_embed(p["embed_tokens"]["weight"], tokens, tp)
     cos_t, sin_t = _llama_rope_table(cfg, head_dim, cache.max_seq)
     cos = jnp.take(cos_t, pos, axis=0)[:, :, None, :]     # [b, S, 1, d]
     sin = jnp.take(sin_t, pos, axis=0)[:, :, None, :]
@@ -551,14 +807,14 @@ def _llama_verify(cfg, params, cache, tokens):
         cache = kv_cache.append_slab(cache, i, k, v)
         ctx = _slab_attend(cache, i, q, base)               # [b,h,S,d]
         ctx = ctx.transpose(0, 2, 1, 3).reshape(slots, s, -1)
-        x = x + _linear(lp["attention"]["o_proj"], ctx)
+        x = x + _row_linear(lp["attention"]["o_proj"], ctx, tp)
         h1 = rms_norm(x, lp["post_attention_norm"]["weight"],
                       eps=cfg.rms_eps)
-        h = x + _llama_mlp(lp, h1)
+        h = x + _llama_mlp(lp, h1, tp)
 
     h = rms_norm(h, p["final_norm"]["weight"], eps=cfg.rms_eps)
     logits = _linear(p["lm_head"], h)                     # [b, S, v]
-    return logits, cache
+    return _gather_logits(logits, tp), cache
 
 
 # --------------------------------------------------------------------------
@@ -566,7 +822,7 @@ def _llama_verify(cfg, params, cache, tokens):
 # --------------------------------------------------------------------------
 
 def prefill_forward(kind: str, cfg, params, tokens, length=None, *,
-                    cache=None, row=None, prefill_from=None):
+                    cache=None, row=None, prefill_from=None, tp=1):
     """Full-prompt forward: ``tokens [1, s]`` -> ``(logits, k_stack,
     v_stack)`` with k/v ``[layers, kv_heads, s, head_dim]`` ready for
     :func:`kv_cache.insert`.
@@ -592,15 +848,16 @@ def prefill_forward(kind: str, cfg, params, tokens, length=None, *,
             f"prefill takes one prompt [1, s], got {tuple(tokens.shape)}")
     fn = _gpt_prefill if kind == "gpt" else _llama_prefill
     if cache is None:
-        return fn(cfg, params, tokens, length)
+        return fn(cfg, params, tokens, length, tp=tp)
     if row is None or prefill_from is None or length is None:
         raise ValueError(
             "suffix prefill needs cache, row, prefill_from AND length")
     return fn(cfg, params, tokens, length, cache=cache, row=row,
-              start=prefill_from)
+              start=prefill_from, tp=tp)
 
 
-def decode_forward(kind: str, cfg, params, cache, tokens, fused=None):
+def decode_forward(kind: str, cfg, params, cache, tokens, fused=None,
+                   tp=1):
     """One-token step for every slot: ``tokens [slots]`` ->
     ``(logits [slots, v], cache)`` with the new k/v appended at each
     slot's position.  Lengths do not advance here (the engine advances
@@ -615,10 +872,10 @@ def decode_forward(kind: str, cfg, params, cache, tokens, fused=None):
     numerics (the in-kernel residual chain stays fp32 where the
     unfused path rounds to bf16 at each sublayer)."""
     fn = _gpt_decode if kind == "gpt" else _llama_decode
-    return fn(cfg, params, cache, tokens, fused=fused)
+    return fn(cfg, params, cache, tokens, fused=fused, tp=tp)
 
 
-def verify_forward(kind: str, cfg, params, cache, tokens):
+def verify_forward(kind: str, cfg, params, cache, tokens, tp=1):
     """Speculative-verify step (ISSUE 15): ``tokens [slots, S]`` (the
     last confirmed token followed by ``S - 1`` drafts, per slot) ->
     ``(logits [slots, S, v], cache)`` with the slab's k/v appended at
@@ -630,4 +887,4 @@ def verify_forward(kind: str, cfg, params, cache, tokens):
         raise ValueError(
             f"verify takes a [slots, S] slab, got {tuple(tokens.shape)}")
     fn = _gpt_verify if kind == "gpt" else _llama_verify
-    return fn(cfg, params, cache, tokens)
+    return fn(cfg, params, cache, tokens, tp=tp)

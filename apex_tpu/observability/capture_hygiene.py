@@ -73,12 +73,22 @@ def hbm_capacity_bound(obj: dict) -> int:
     """Physical ceiling for a ``compiled_peak_hbm_bytes`` field: the
     capture's own chip's HBM when the ``chip`` stamp matches the spec
     table, else the LARGEST capacity in the table (the permissive bound
-    — an unknown chip must not scrub a valid value)."""
+    — an unknown chip must not scrub a valid value).
+
+    A tensor-parallel serving capture (``infer_serve_tp`` > 1, ISSUE
+    17) spans that many chips: its compiled peak may legitimately sum
+    over the mesh, so the bound is PER-CHIP HBM x the capture's own tp
+    stamp — a single-chip ceiling would scrub a valid multi-chip
+    value, and an unsharded capture (tp absent or 1) keeps the strict
+    one-chip bound."""
     from apex_tpu.chip_specs import CHIP_SPECS, match_spec
     spec = match_spec(str(obj.get("chip", "")))
-    if spec is not None:
-        return spec.hbm_bytes
-    return max(s.hbm_bytes for s in CHIP_SPECS.values())
+    per_chip = (spec.hbm_bytes if spec is not None
+                else max(s.hbm_bytes for s in CHIP_SPECS.values()))
+    tp = obj.get("infer_serve_tp", 1)
+    if isinstance(tp, bool) or not isinstance(tp, int) or tp < 1:
+        tp = 1
+    return per_chip * tp
 
 
 def vmem_capacity_bound(obj: dict) -> int:

@@ -443,7 +443,7 @@ def resolve_decode_fusion(mode=None, *, paged: bool,
 
 
 def _fused_block_kernel(kind, scale, kvh, group, ps, mpps, hidden, d,
-                        eps, fuse_mlp, *refs):
+                        eps, fuse_mlp, partial_out, *refs):
     gpt = kind == "gpt"
     h = kvh * group
     f32 = jnp.float32
@@ -463,7 +463,7 @@ def _fused_block_kernel(kind, scale, kvh, group, ps, mpps, hidden, d,
     bv = next(it) if gpt else None
     k_ref, v_ref = next(it), next(it)
     wo = next(it)
-    bo = next(it) if gpt else None
+    bo = next(it) if gpt and not partial_out else None
     ln2_w = ln2_b = wg = wu = bu = wd = bd = None
     if fuse_mlp:
         ln2_w = next(it)
@@ -573,6 +573,13 @@ def _fused_block_kernel(kind, scale, kvh, group, ps, mpps, hidden, d,
         acc = acc_scr[...] * alpha + p_new * vb
         ctx = acc / l            # the current token is always live: l > 0
         attn = matmul(ctx.reshape(1, h * d), wo, bo)
+        if partial_out:
+            # tensor-parallel shard (ISSUE 17): emit the RANK-PARTIAL
+            # out-proj row product — no residual, no bias.  The caller
+            # psums at the row boundary, adds ``bo`` once, and runs
+            # norm2 + the col/row MLP outside the kernel.
+            o_ref[...] = attn.astype(o_ref.dtype)
+            return
         x2 = x_ref[...].astype(f32) + attn               # [1, hidden]
         if fuse_mlp:
             h2 = norm(x2, ln2_w, ln2_b)
@@ -591,7 +598,8 @@ def _fused_block_kernel(kind, scale, kvh, group, ps, mpps, hidden, d,
 def fused_block_decode(x, blk, k_pages, v_pages, page_table, lengths, *,
                        kind: str, eps: float, cos=None, sin=None,
                        sm_scale: Optional[float] = None,
-                       fuse_mlp: bool = True):
+                       fuse_mlp: bool = True,
+                       partial_out: bool = False):
     """One fused transformer-block decode step against the paged pool.
 
     * ``x``: ``[slots, hidden]`` — the block's input activations (the
@@ -617,9 +625,22 @@ def fused_block_decode(x, blk, k_pages, v_pages, page_table, lengths, *,
     XLA fallback is the original unfused per-op path, selected by
     ``APEX_TPU_DECODE_FUSION`` / the ``auto`` crossover
     (:func:`resolve_decode_fusion`).
+
+    ``partial_out`` (ISSUE 17, tensor-parallel serving): ``blk`` is a
+    rank's 1/tp shard (heads/kvh column-split, ``wo`` row-split exactly
+    as ``pallas_audit --mesh`` prices it) and ``y`` is the RANK-PARTIAL
+    out-proj product — no residual, no out-proj bias.  The out-proj
+    psum moves OUTSIDE the kernel: the caller reduces over the tensor
+    axis, adds ``bo`` once, and finishes norm2 + the MLP with its own
+    row-boundary psum.  Requires ``fuse_mlp=False`` (the MLP cannot
+    fuse across the row reduction).
     """
     if kind not in ("gpt", "llama"):
         raise ValueError(f"unknown block kind {kind!r}")
+    if partial_out and fuse_mlp:
+        raise ValueError(
+            "partial_out emits the pre-psum attention shard; the MLP "
+            "runs after the row-boundary reduction (fuse_mlp=False)")
     gpt = kind == "gpt"
     slots, hidden = x.shape
     if k_pages.shape != v_pages.shape or k_pages.ndim != 4:
@@ -674,7 +695,7 @@ def fused_block_decode(x, blk, k_pages, v_pages, page_table, lengths, *,
         add_w("ln1_w", "ln1_b", "wq", "bq", "wk", "bk", "wv", "bv")
     operands.extend([k_pages, v_pages])
     in_specs.extend([pl.BlockSpec((1, kvh, ps, d), page_index)] * 2)
-    add_w(*(("wo", "bo") if gpt else ("wo",)))
+    add_w(*(("wo", "bo") if gpt and not partial_out else ("wo",)))
     if fuse_mlp:
         if gpt:
             add_w("ln2_w", "ln2_b", "wu", "bu", "wd", "bd")
@@ -701,7 +722,8 @@ def fused_block_decode(x, blk, k_pages, v_pages, page_table, lengths, *,
         ],
     )
     kernel = functools.partial(_fused_block_kernel, kind, scale, kvh,
-                               group, ps, mpps, hidden, d, eps, fuse_mlp)
+                               group, ps, mpps, hidden, d, eps, fuse_mlp,
+                               partial_out)
     y, kt, vt = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,

@@ -45,6 +45,7 @@ __all__ = [
     "destroy_model_parallel",
     "model_parallel_is_initialized",
     "get_mesh",
+    "serving_mesh",
     "get_tensor_model_parallel_group",
     "get_pipeline_model_parallel_group",
     "get_data_parallel_group",
@@ -140,6 +141,25 @@ def initialize_model_parallel(
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = (
             virtual_pipeline_model_parallel_size_)
     return _MESH
+
+
+def serving_mesh(tp: int, devices: Optional[Sequence] = None) -> Mesh:
+    """One-axis ``(tensor,)`` mesh for tensor-parallel SERVING (ISSUE
+    17): the inference engine owns its mesh privately instead of going
+    through the global 5-axis training topology, so an engine can come
+    up (and tests can spin several at different tp) without touching —
+    or requiring — ``initialize_model_parallel`` state."""
+    if tp < 1:
+        raise ValueError(f"serving tp must be >= 1, got {tp}")
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < tp:
+        raise RuntimeError(
+            f"serving tp={tp} needs {tp} devices, have {len(devices)} "
+            "(on CPU, force host devices with "
+            "--xla_force_host_platform_device_count)")
+    grid = np.asarray(devices[:tp], dtype=object)
+    return Mesh(grid, (TENSOR_AXIS,))
 
 
 def model_parallel_is_initialized() -> bool:

@@ -884,6 +884,14 @@ def _microbench_infer(rtt: float, on_tpu: bool):
     prefill_len = max_seq // 2          # leaves decode headroom
     paged = bool(_ov("paged", 0))
     page_size = _ov("page_size", default_page_size()) if paged else None
+    # tensor-parallel serving (ISSUE 17): override > APEX_TPU_SERVE_TP
+    # > 1; the EFFECTIVE value is stamped so captures self-describe
+    # (same contract as page_size)
+    from apex_tpu.inference.engine import serve_tp
+    tp = int(_ov("tp", 0)) or serve_tp()
+    if tp > 1 and not paged:
+        raise ValueError("--override tp=N shards the PAGED kv pool "
+                         "over kv heads — add --override paged=1")
 
     parallel_state.destroy_model_parallel()
     parallel_state.initialize_model_parallel(1)
@@ -991,6 +999,7 @@ def _microbench_infer(rtt: float, on_tpu: bool):
            "infer_hbm_bytes_per_concurrent_request":
                round(cache_bytes / max(concurrent, 1)),
            "infer_paged": int(paged),
+           "infer_serve_tp": tp,
            # crossover knob stamp (same contract as attn_xla_max_seq)
            "infer_decode_xla_max_seq": decode_xla_max_seq()}
     if paged:
@@ -1165,11 +1174,13 @@ def _microbench_infer(rtt: float, on_tpu: bool):
         # wins/losses can be read against the predicted residency
         # (capture_hygiene bounds it to (0, chip VMEM capacity])
         from apex_tpu.analysis.pallas_audit import fused_block_envelope
+        # tp > 1 prices the 1/tp weight shard the sharded engine's
+        # fused kernel actually holds resident (ISSUE 17)
         out["fused_vmem_model_bytes"] = fused_block_envelope(
             cfg.hidden_size,
             head_dim=cfg.hidden_size // cfg.num_attention_heads,
             page_size=page_size, max_pages=pages_per_req,
-            slots=slots)["vmem_bytes"]
+            slots=slots, tp=tp)["vmem_bytes"]
         fused_layers = _inf_models.fused_layer_params("gpt", cfg,
                                                       engine.params)
         fused_decode_fn = make_decode_fn("gpt", cfg, sampling,
@@ -1274,6 +1285,59 @@ def _microbench_infer(rtt: float, on_tpu: bool):
         out["infer_spec_floor_tokens_per_s"] = round(ng["floor"], 1)
         out["infer_spec_oracle_acceptance_rate"] = oc["accept"]
         out["infer_spec_oracle_tokens_per_s"] = round(oc["eff"], 1)
+
+    # tensor-parallel serving leg (ISSUE 17, paged only): the SAME warm
+    # decode loop through the engine's tp-sharded shard_map executable
+    # (param mirrors column/row-partitioned, paged pool sharded over kv
+    # heads, psums only at the row boundaries) next to the single-chip
+    # decode above; the comm_model step-time estimate rides the capture
+    # so the measured step reads against modeled compute/comm scaling
+    # (the CPU dryrun's wall time is meaningless for the win — the
+    # model stamp IS the dryrun's answer, the on-chip queue measures).
+    if tp > 1:
+        if len(jax.devices()) < tp:
+            out["infer_tp_skipped"] = (
+                f"tp={tp} needs {tp} devices, have {len(jax.devices())}"
+                " (the CPU dryrun forces host devices via XLA_FLAGS)")
+            return out
+        eng_tp = InferenceEngine("gpt", cfg, params, slots=slots,
+                                 max_seq=max_seq, page_size=page_size,
+                                 num_pages=engine.num_pages, spec_k=0,
+                                 tp=tp)
+        alloc_t = eng_tp.new_allocator()
+        cache_t = eng_tp.init_cache()
+        for slot in range(slots):
+            cache_t, _, _ = eng_tp.prefill(
+                cache_t, np.asarray(prompt), slot,
+                pages=alloc_t.acquire(pages_per_req))
+        dparams_t = ((eng_tp.params, eng_tp._fused_layers)
+                     if eng_tp.decode_fused else eng_tp.params)
+
+        def tp_decode_step(state, batch):
+            cache_, toks, step = state
+            active, key_ = batch
+            cache_, toks, _, _ = eng_tp._decode_raw(
+                cache_, dparams_t, toks, active, key_, step)
+            return (cache_, toks, step + 1)
+
+        t_tdec = _bench_loop(
+            tp_decode_step,
+            (cache_t, jnp.zeros((slots,), jnp.int32), jnp.int32(0)),
+            (jnp.ones((slots,), bool), key), decode_iters, rtt)
+        out["infer_decode_token_us_tp"] = round(t_tdec.best * 1e6, 1)
+        out["infer_decode_token_us_tp_median"] = round(
+            t_tdec.median * 1e6, 1)
+        out["infer_decode_tp_tokens_per_s"] = round(
+            slots / t_tdec.best, 1)
+        # per-RANK pool bytes: under sharding the HBM that serving
+        # capacity prices against is per chip (cache_hbm_bytes/tp)
+        out["infer_hbm_cache_bytes_tp"] = eng_tp.cache_hbm_bytes()
+        _stamp_step_time_model(
+            out,
+            lambda: jax.make_jaxpr(eng_tp._decode_raw)(
+                cache_t, dparams_t, jnp.zeros((slots,), jnp.int32),
+                jnp.ones((slots,), bool), key, jnp.int32(0)),
+            dict(eng_tp.mesh.shape))
     return out
 
 
@@ -1899,11 +1963,16 @@ if __name__ == "__main__":
         mode = sys.argv[sys.argv.index("--inner") + 1]
         leg = (sys.argv[sys.argv.index("--leg") + 1]
                if "--leg" in sys.argv else "main")
-        if leg == "tp" and mode == "cpu" and \
+        _env_tp = os.environ.get("APEX_TPU_SERVE_TP", "0") or "0"
+        _needs_mesh = leg == "tp" or (
+            leg == "infer" and
+            (int(_OVERRIDES.get("tp", 0) or 0) > 1 or
+             (_env_tp.isdigit() and int(_env_tp) > 1)))
+        if _needs_mesh and mode == "cpu" and \
                 "--xla_force_host_platform_device_count" not in \
                 os.environ.get("XLA_FLAGS", ""):
-            # the TP leg needs a 2-device mesh; on the CPU dryrun force
-            # host devices BEFORE the backend initializes
+            # the TP legs need a multi-device mesh; on the CPU dryrun
+            # force host devices BEFORE the backend initializes
             os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                        + " --xla_force_host_platform_"
                                          "device_count=8").strip()

@@ -324,7 +324,9 @@ def test_registered_overlap_executables_audit_clean():
                                               run_spmd_audit)
 
     flagged = {s.name for s in exec_specs() if s.check_overlap}
-    assert flagged == {"train_step_zero", "tp_column_row"}
+    # PR 17 adds the tp-sharded fused decode step to the overlap set
+    assert flagged == {"train_step_zero", "tp_column_row",
+                       "inference_decode_fused_paged_tp2"}
     findings, report = run_spmd_audit(execs=sorted(flagged))
     assert findings == [], [(f.rule, f.message) for f in findings]
     committed = json.loads(

@@ -18,7 +18,8 @@ REPO = repo_root()
 # a Pallas rewrite lands in the ledger through them)
 REQUIRED_OPS = {
     "layer_norm", "rms_norm", "flash_attention", "decode_attention",
-    "paged_decode_attention", "fused_block_decode", "fused_update",
+    "paged_decode_attention", "fused_block_decode",
+    "fused_block_decode_tp2", "fused_update",
     "xentropy", "fused_lm_xent",
 }
 

@@ -28,10 +28,11 @@ from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
 
 # 18 at ISSUE 12; ISSUE 15 consciously added the fused-block decode
-# twin and the speculative verify step (the only legitimate way this
+# twin and the speculative verify step; ISSUE 17 the three tp=2
+# tensor-parallel serving executables (the only legitimate way this
 # number moves: a new REGISTERED executable, never a serving-path
 # side effect)
-BUDGETED_EXECUTABLES = 20
+BUDGETED_EXECUTABLES = 23
 
 
 def _engine():
@@ -105,7 +106,9 @@ def test_budget_ledger_untouched_by_prefix_sharing():
     assert inference_entries == {
         "inference_prefill", "inference_decode",
         "inference_prefill_paged", "inference_decode_paged",
-        "inference_decode_fused_paged", "inference_verify_paged"}
+        "inference_decode_fused_paged", "inference_verify_paged",
+        "inference_prefill_paged_tp2", "inference_decode_fused_paged_tp2",
+        "inference_verify_paged_tp2"}
     # the serving-side program set is closed: the COW copy rides the
     # jaxpr audit (precision/transfer) without a budget entry, and no
     # "prefix" executable exists anywhere in the registry

@@ -21,6 +21,10 @@ REQUIRED_EXECS = {
     "ulysses_attention_cp", "moe_dispatch", "inference_prefill",
     "inference_decode", "lm_xent_fused", "lm_xent_unfused",
     "tp_fused_lm_xent", "train_step_zero_numerics",
+    # ISSUE 17: tensor-parallel serving executables (the engine's own
+    # tp=2 shard_map programs)
+    "inference_prefill_paged_tp2", "inference_decode_fused_paged_tp2",
+    "inference_verify_paged_tp2",
 }
 
 

@@ -96,6 +96,16 @@ SLOW = {
     # 6-12 s; the fast lane keeps the GQA step-locked fused sentinel,
     # the GPT fused-logits sentinel, both paged spec-parity sentinels
     # and the replay-drafter acceptance-criterion pin
+    # tensor-parallel serving (ISSUE 17): the full parity matrix and
+    # the scheduler-churn invariance run 5-10 s each (two engines per
+    # variant, every tp mesh compiles its own shard_map executables);
+    # the fast lane keeps the tp=2 GPT parity + per-rank-HBM sentinel
+    # (test_gpt_tp2_parity_and_per_rank_hbm_fast) plus the contract and
+    # env-knob coverage
+    "tests/L0/run_inference/test_tp_serving.py::test_gpt_tp_matrix",
+    "tests/L0/run_inference/test_tp_serving.py::test_llama_kv_replication_tp_matrix",
+    "tests/L0/run_inference/test_tp_serving.py::test_spec_verify_tp2_parity",
+    "tests/L0/run_inference/test_tp_serving.py::test_allocator_prefix_churn_invariant_and_zero_compiles_under_tp",
     "tests/L0/run_inference/test_fused_block.py::test_fused_gpt_matches_unfused_greedy",
     "tests/L0/run_inference/test_fused_block.py::test_fused_llama_tracks_unfused_step_locked[mha]",
     "tests/L0/run_inference/test_speculative.py::test_engine_drafter_self_draft_full_acceptance",
