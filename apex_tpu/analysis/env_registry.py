@@ -277,6 +277,32 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "stamped into infer bench captures as infer_serve_tp",
         read_by="apex_tpu/inference/engine.py"),
     EnvKnob(
+        name="APEX_TPU_HOST_KV_TIER_BYTES",
+        default="0",
+        effect="host-DRAM KV page tier byte budget for paged serving "
+               "(ISSUE 18): > 0 arms a second cache tier under the "
+               "prefix cache — LRU eviction copies full prefix pages "
+               "to host RAM (the HBM page frees immediately) instead "
+               "of discarding them, and a later hit uploads them back "
+               "in fixed-width batches overlapped with chunked prefill "
+               "of the uncached tail; 0 (default) keeps discard-on-"
+               "evict.  Requires the paged cache.  Per-engine "
+               "override: InferenceEngine(host_tier_bytes=); stamped "
+               "into paged infer bench captures as "
+               "infer_host_tier_bytes",
+        read_by="apex_tpu/inference/engine.py"),
+    EnvKnob(
+        name="APEX_TPU_SWAP_BATCH_PAGES",
+        default="8",
+        effect="pages per swap copy batch for the host KV tier: both "
+               "swap directions run ONE fixed-width executable each "
+               "(shorter batches pad with the trash page / an OOB "
+               "drop sentinel), so swap traffic can never recompile; "
+               "per-engine override: InferenceEngine("
+               "swap_batch_pages=); stamped into paged infer bench "
+               "captures as infer_swap_batch_pages",
+        read_by="apex_tpu/inference/kv_cache.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

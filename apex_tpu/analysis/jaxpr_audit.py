@@ -301,6 +301,26 @@ def _builders():
         return (kvc.cow_page, (cache, s((), jnp.int32),
                                s((), jnp.int32)))
 
+    def inference_swap_out_paged():
+        # the ISSUE 18 host-tier offload gather: one fixed-width batch
+        # of page slabs read out of the pool (D2H happens at the
+        # dispatch boundary via device_get — the program itself must
+        # stay free of host callbacks/transfers, which is exactly what
+        # this audit pins)
+        from apex_tpu.inference import kv_cache as kvc
+        _, _, _, cache, _ = _paged_engine_audit_pieces()
+        return (kvc.extract_pages, (cache, s((8,), jnp.int32)))
+
+    def inference_swap_in_paged():
+        # the ISSUE 18 swap-back scatter: one fixed-width batch of host
+        # slabs written into the (donated) pool at their new page ids;
+        # padding lanes carry an out-of-bounds id and drop
+        from apex_tpu.inference import kv_cache as kvc
+        _, _, _, cache, _ = _paged_engine_audit_pieces()
+        slab = s((8, 2, 4, 16, 16), bf16)
+        return (kvc.restore_pages, (cache, s((8,), jnp.int32),
+                                    slab, slab))
+
     return {
         # budgets are the measured entry upcasts (γ/β applied in fp32 by
         # design — see the kernel docstrings); any increase fails
@@ -382,6 +402,17 @@ def _builders():
                                "apex_tpu/inference/kv_cache.py",
                                ("bfloat16", "bfloat16", "int32",
                                 "int32", "int32"), 0),
+        # ISSUE 18: the two host-tier copy programs — pure gathers/
+        # scatters over the pool (no collectives, no host callbacks,
+        # no entry upcasts); the swap-in returns the whole cache (cow's
+        # output pins), the swap-out returns the two page slabs
+        "inference_swap_out_paged": (inference_swap_out_paged,
+                                     "apex_tpu/inference/kv_cache.py",
+                                     ("bfloat16", "bfloat16"), 0),
+        "inference_swap_in_paged": (inference_swap_in_paged,
+                                    "apex_tpu/inference/kv_cache.py",
+                                    ("bfloat16", "bfloat16", "int32",
+                                     "int32", "int32"), 0),
     }
 
 

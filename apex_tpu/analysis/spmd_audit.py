@@ -588,6 +588,21 @@ def _builders():
             lambda: _inference("inference_verify_paged"),
             "apex_tpu/inference/engine.py", (0,), True, False, False,
             False),
+        # ISSUE 18: the host-tier copy programs.  The swap-out gather
+        # deliberately does NOT donate (and must not be flagged for
+        # it): the pool stays live — the evicted pages' contents are
+        # read out while other pages keep serving.  The swap-in
+        # scatter donates the pool like every mutating serving
+        # program; its slab operands are small fixed-width staging
+        # buffers, not aliasable state.
+        "inference_swap_out_paged": (
+            lambda: _inference("inference_swap_out_paged"),
+            "apex_tpu/inference/kv_cache.py", (), False, False, False,
+            False),
+        "inference_swap_in_paged": (
+            lambda: _inference("inference_swap_in_paged"),
+            "apex_tpu/inference/kv_cache.py", (0,), True, False, False,
+            False),
         # ISSUE 17: the tensor-parallel serving executables — the
         # engine's own shard_map mesh programs at tp=2, donated pool
         # and all; APX217 overlap verified on the sharded fused decode

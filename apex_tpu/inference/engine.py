@@ -61,7 +61,10 @@ from apex_tpu.ops.paged_attention import (decode_fusion as
 from apex_tpu.transformer.parallel_state import serving_mesh
 
 __all__ = ["InferenceEngine", "make_prefill_fn", "make_decode_fn",
-           "make_verify_fn", "prefill_bucket", "serve_tp"]
+           "make_verify_fn", "prefill_bucket", "serve_tp",
+           "host_kv_tier_bytes"]
+
+_HOST_TIER_ENV = "APEX_TPU_HOST_KV_TIER_BYTES"
 
 
 def serve_tp() -> int:
@@ -77,6 +80,23 @@ def serve_tp() -> int:
     if v < 0:
         raise ValueError(f"APEX_TPU_SERVE_TP must be >= 0, got {v}")
     return v or 1
+
+
+def host_kv_tier_bytes() -> int:
+    """Host-DRAM KV page tier byte budget from
+    ``APEX_TPU_HOST_KV_TIER_BYTES`` (registered in
+    ``analysis/env_registry.py``): unset/``0`` disables the tier (LRU
+    eviction discards, the pre-ISSUE-18 behavior); an explicit
+    ``InferenceEngine(host_tier_bytes=)`` always wins."""
+    raw = os.environ.get(_HOST_TIER_ENV, "0").strip() or "0"
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{_HOST_TIER_ENV} must be an integer, got {raw!r}")
+    if v < 0:
+        raise ValueError(f"{_HOST_TIER_ENV} must be >= 0, got {v}")
+    return v
 
 
 def make_prefill_fn(kind: str, cfg, sampling: SamplingConfig,
@@ -264,7 +284,9 @@ class InferenceEngine:
                  paged_attn_max_pages: Optional[int] = None,
                  decode_fusion=None, fusion_min_pages=None,
                  spec_k: Optional[int] = None,
-                 tp: Optional[int] = None):
+                 tp: Optional[int] = None,
+                 host_tier_bytes: Optional[int] = None,
+                 swap_batch_pages: Optional[int] = None):
         if kind not in ("gpt", "llama", "bert"):
             raise ValueError(f"unknown model kind {kind!r}")
         if kind != "bert":
@@ -305,10 +327,33 @@ class InferenceEngine:
                 raise ValueError(
                     f"num_pages must be >= 1, got {self.num_pages}")
             self.paged_attn_max_pages = paged_attn_max_pages
+            # host-DRAM page tier (ISSUE 18): explicit kwargs win, else
+            # the registered env knobs; 0 bytes = tier off (eviction
+            # discards, the pre-tier behavior)
+            self.host_tier_bytes = int(
+                host_tier_bytes if host_tier_bytes is not None
+                else host_kv_tier_bytes())
+            if self.host_tier_bytes < 0:
+                raise ValueError(
+                    f"host_tier_bytes must be >= 0, got "
+                    f"{self.host_tier_bytes}")
+            self.swap_batch_pages = int(
+                swap_batch_pages if swap_batch_pages is not None
+                else kv_cache.default_swap_batch_pages())
+            if self.swap_batch_pages < 1:
+                raise ValueError(
+                    f"swap_batch_pages must be >= 1, got "
+                    f"{self.swap_batch_pages}")
         else:
+            if host_tier_bytes:
+                raise ValueError(
+                    "host_tier_bytes is the paged-mode host page tier; "
+                    "this engine runs the dense slot cache")
             self.page_size = self.num_pages = None
             self.max_pages_per_slot = None
             self.paged_attn_max_pages = None
+            self.host_tier_bytes = 0
+            self.swap_batch_pages = None
         # tensor-parallel serving width (ISSUE 17): explicit kwarg wins,
         # else APEX_TPU_SERVE_TP, else single chip
         self.tp = int(tp) if tp is not None else serve_tp()
@@ -421,6 +466,24 @@ class InferenceEngine:
                     kv_cache.cow_page, in_specs=(cs, P(), P()),
                     out_specs=cs)
                 self._cow = jax.jit(self._cow_raw, donate_argnums=(0,))
+                # the host-tier swap copy programs (ISSUE 18): one
+                # gather out, one scatter in, each compiled ONCE at the
+                # static swap batch width (page-ID vectors pad to it).
+                # The slab spec mirrors the k/v pool spec — under tp
+                # each rank moves its own kv-head shard; device_get of
+                # the sharded slab assembles the global page host-side.
+                sb = cs.k if self.tp > 1 else None
+                self._swap_out_raw = self._tp_wrap(
+                    kv_cache.extract_pages, in_specs=(cs, P()),
+                    out_specs=(sb, sb))
+                # NOT donated: extract is a pure read — the pool stays
+                # live (eviction is host-side bookkeeping)
+                self._swap_out = jax.jit(self._swap_out_raw)
+                self._swap_in_raw = self._tp_wrap(
+                    kv_cache.restore_pages,
+                    in_specs=(cs, P(), sb, sb), out_specs=cs)
+                self._swap_in = jax.jit(self._swap_in_raw,
+                                        donate_argnums=(0,))
 
     def _refresh_dispatch_counters(self) -> None:
         reg = obs.global_registry()
@@ -436,6 +499,10 @@ class InferenceEngine:
                 "infer_decode_fused_dispatch_total")
             self._verify_dispatches = reg.declared(
                 "infer_verify_dispatch_total")
+            self._swap_out_dispatches = reg.declared(
+                "infer_swap_out_dispatch_total")
+            self._swap_in_dispatches = reg.declared(
+                "infer_swap_in_dispatch_total")
 
     # -- tensor-parallel serving (ISSUE 17) ----------------------------------
     def _tp_wrap(self, fn, *, in_specs, out_specs):
@@ -629,6 +696,96 @@ class InferenceEngine:
         with obs.trace_annotation("apex_tpu.inference.cow_page",
                                   src=int(src), dst=int(dst)):
             return self._cow(cache, np.int32(src), np.int32(dst))
+
+    def page_host_bytes(self) -> int:
+        """Host-DRAM bytes ONE page's k+v slabs occupy in the host
+        tier.  GLOBAL geometry even under tensor parallelism: swap-out
+        ``device_get``\\ s the sharded slab into the full kv-head dim,
+        so the host books (like the page table) are rank-invariant."""
+        if not self.paged:
+            raise ValueError("page_host_bytes is the paged-mode host "
+                             "tier ledger; this engine runs the dense "
+                             "slot cache")
+        d = self.dims
+        itemsize = jnp.dtype(self.cache_dtype).itemsize
+        return (2 * d["layers"] * self.tp_dims["kv_heads_pool"]
+                * self.page_size * d["head_dim"] * itemsize)
+
+    def swap_out_pages(self, cache, page_ids):
+        """Copy physical pages ``page_ids`` device→host (ISSUE 18
+        eviction offload): returns ``(k, v)`` numpy slabs
+        ``[n, layers, kv_heads, page_size, head_dim]``.  Pure read —
+        the cache operand stays valid (the HBM pages return to the
+        free list host-side).  Batches of ``swap_batch_pages`` are
+        dispatched back-to-back (short batches pad with the trash
+        page) and fetched only after the LAST dispatch, so the
+        device-side gathers pipeline ahead of the host copies; every
+        batch rides the ONE compiled extract program."""
+        if not self.paged:
+            raise ValueError("swap_out_pages is the paged-mode host "
+                             "tier; this engine runs the dense slot "
+                             "cache")
+        ids = np.asarray(page_ids, np.int32).reshape(-1)
+        n, B = ids.shape[0], self.swap_batch_pages
+        if n == 0:
+            raise ValueError("swap_out_pages needs at least one page")
+        self._refresh_dispatch_counters()
+        pending = []
+        with obs.trace_annotation("apex_tpu.inference.swap_out",
+                                  pages=int(n)):
+            for i in range(0, n, B):
+                chunk = ids[i:i + B]
+                padded = np.full((B,), self.num_pages, np.int32)
+                padded[:chunk.shape[0]] = chunk
+                self._swap_out_dispatches.inc()
+                k_s, v_s = self._swap_out(cache, padded)
+                pending.append((k_s, v_s, chunk.shape[0]))
+            ks = [np.asarray(jax.device_get(k_s))[:m]
+                  for k_s, _, m in pending]
+            vs = [np.asarray(jax.device_get(v_s))[:m]
+                  for _, v_s, m in pending]
+        return np.concatenate(ks, axis=0), np.concatenate(vs, axis=0)
+
+    def swap_in_pages(self, cache, page_ids, k_slabs, v_slabs):
+        """Upload host-tier page slabs back into freshly acquired
+        physical pages ``page_ids`` (ISSUE 18 hit-after-eviction):
+        returns the cache.  The inverse of :meth:`swap_out_pages` —
+        batches pad short with an OUT-OF-BOUNDS page index (dropped by
+        the scatter) and zero slabs, so every batch rides the ONE
+        compiled restore program; the cache is donated through each
+        dispatch like every other mutation.  The scheduler calls this
+        BEFORE the uncached tail's first prefill chunk, so uploads
+        overlap the tail's compute in the dispatch queue."""
+        if not self.paged:
+            raise ValueError("swap_in_pages is the paged-mode host "
+                             "tier; this engine runs the dense slot "
+                             "cache")
+        ids = np.asarray(page_ids, np.int32).reshape(-1)
+        n, B = ids.shape[0], self.swap_batch_pages
+        k_slabs = np.asarray(k_slabs)
+        v_slabs = np.asarray(v_slabs)
+        if n == 0:
+            raise ValueError("swap_in_pages needs at least one page")
+        if k_slabs.shape[0] != n or v_slabs.shape[0] != n:
+            raise ValueError(
+                f"swap-in slabs must carry one entry per page id "
+                f"({n}), got k {k_slabs.shape[0]} v {v_slabs.shape[0]}")
+        self._refresh_dispatch_counters()
+        oob = np.int32(self.num_pages + 1)   # >= cache.pages -> dropped
+        with obs.trace_annotation("apex_tpu.inference.swap_in",
+                                  pages=int(n)):
+            for i in range(0, n, B):
+                chunk = ids[i:i + B]
+                m = chunk.shape[0]
+                padded = np.full((B,), oob, np.int32)
+                padded[:m] = chunk
+                pk = np.zeros((B,) + k_slabs.shape[1:], k_slabs.dtype)
+                pv = np.zeros((B,) + v_slabs.shape[1:], v_slabs.dtype)
+                pk[:m] = k_slabs[i:i + B]
+                pv[:m] = v_slabs[i:i + B]
+                self._swap_in_dispatches.inc()
+                cache = self._swap_in(cache, padded, pk, pv)
+        return cache
 
     def decode(self, cache, last_tokens, active=None):
         """One token for every slot: returns ``(cache, next_tokens,

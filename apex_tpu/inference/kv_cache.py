@@ -65,12 +65,15 @@ import numpy as np
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 
 __all__ = ["KVCache", "init_cache", "PagedKVCache", "init_paged_cache",
-           "PageAllocator", "default_page_size", "insert_tokens",
-           "cow_page", "append_slab", "advance_by", "set_lengths",
-           "paged_cache_partition_specs"]
+           "PageAllocator", "HostPageStore", "default_page_size",
+           "default_swap_batch_pages", "insert_tokens", "cow_page",
+           "extract_pages", "restore_pages", "append_slab",
+           "advance_by", "set_lengths", "paged_cache_partition_specs"]
 
 _PAGE_SIZE_ENV = "APEX_TPU_PAGE_SIZE"
 _DEFAULT_PAGE_SIZE = 64
+_SWAP_BATCH_ENV = "APEX_TPU_SWAP_BATCH_PAGES"
+_DEFAULT_SWAP_BATCH = 8
 
 
 def default_page_size() -> int:
@@ -90,6 +93,28 @@ def default_page_size() -> int:
                 f"got {val}")
         return val
     return _DEFAULT_PAGE_SIZE
+
+
+def default_swap_batch_pages() -> int:
+    """Pages moved per host-tier swap dispatch (ISSUE 18):
+    ``APEX_TPU_SWAP_BATCH_PAGES`` env var > the built-in 8.  The batch
+    width is a STATIC operand dimension of the two swap copy programs
+    (:func:`extract_pages` / :func:`restore_pages`): page-ID vectors
+    are padded host-side to this width, so one compiled program per
+    direction serves every page count — the zero-recompile guarantee
+    every other serving-path program already gives."""
+    env = os.environ.get(_SWAP_BATCH_ENV)
+    if env:
+        try:
+            val = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_SWAP_BATCH_ENV} must be an int, got {env!r}") from e
+        if val < 1:
+            raise ValueError(
+                f"{_SWAP_BATCH_ENV} must be >= 1, got {val}")
+        return val
+    return _DEFAULT_SWAP_BATCH
 
 
 @flax.struct.dataclass
@@ -630,6 +655,66 @@ def cow_page(cache: PagedKVCache, src, dst) -> PagedKVCache:
     return cache.replace(k=new_k, v=new_v)
 
 
+def extract_pages(cache: PagedKVCache, page_ids):
+    """Swap-out gather (ISSUE 18 host page tier): read physical pages
+    ``page_ids``' k/v rows into contiguous slabs —
+    ``[n, layers, kv_heads, page_size, head_dim]`` per buffer, the
+    :func:`insert_pages` slab layout — that the engine then
+    ``device_get``\\ s into the host store.
+
+    ``page_ids`` is a ``[n]`` int32 vector with STATIC ``n`` (the swap
+    batch width): the engine pads short batches with the trash page —
+    an in-bounds gather whose garbage rows the host slices off — so one
+    compiled extract serves every page set.  Pure read: the cache
+    operand is NOT donated (the pool stays live; eviction returns the
+    page IDs to the free list host-side, no device-side erase needed).
+    Under tensor parallelism each rank gathers its own ``kv_heads/tp``
+    shard of the requested pages; the host-side ``device_get``
+    assembles the global slab."""
+    page_ids = jnp.asarray(page_ids, jnp.int32)
+    if page_ids.ndim != 1:
+        raise ValueError(
+            f"page_ids must be a rank-1 int32 vector, got shape "
+            f"{tuple(page_ids.shape)}")
+    k_slab = jnp.take(cache.k, page_ids, axis=0, mode="clip")
+    v_slab = jnp.take(cache.v, page_ids, axis=0, mode="clip")
+    return k_slab, v_slab
+
+
+def restore_pages(cache: PagedKVCache, page_ids, k_slab,
+                  v_slab) -> PagedKVCache:
+    """Swap-in scatter (ISSUE 18 host page tier): write host-tier page
+    slabs back into freshly acquired physical pages ``page_ids`` — the
+    :func:`insert_pages` slab scatter aimed by an explicit page-ID
+    vector instead of a table row.
+
+    ``page_ids`` is ``[n]`` int32 with STATIC ``n`` (the swap batch
+    width); ``k_slab``/``v_slab`` are ``[n, layers, kv_heads,
+    page_size, head_dim]``.  The engine pads short batches with an
+    OUT-OF-BOUNDS page index (``cache.pages``) and zero slabs, so
+    ``mode="drop"`` discards the padding rows — one compiled restore
+    serves every page set.  Pure donated update like every other cache
+    mutation.  Under tensor parallelism each rank scatters its own
+    ``kv_heads/tp`` shard of the (globally sharded) slab operand."""
+    page_ids = jnp.asarray(page_ids, jnp.int32)
+    if page_ids.ndim != 1:
+        raise ValueError(
+            f"page_ids must be a rank-1 int32 vector, got shape "
+            f"{tuple(page_ids.shape)}")
+    n = page_ids.shape[0]
+    want = (n, cache.layers, cache.kv_heads, cache.page_size,
+            cache.head_dim)
+    if tuple(k_slab.shape) != want or tuple(v_slab.shape) != want:
+        raise ValueError(
+            f"swap-in slabs must be {want}, got k "
+            f"{tuple(k_slab.shape)} v {tuple(v_slab.shape)}")
+    new_k = cache.k.at[page_ids].set(k_slab.astype(cache.k.dtype),
+                                     mode="drop")
+    new_v = cache.v.at[page_ids].set(v_slab.astype(cache.v.dtype),
+                                     mode="drop")
+    return cache.replace(k=new_k, v=new_v)
+
+
 def _append_layer_paged(cache: PagedKVCache, layer: int, k_tok,
                         v_tok) -> PagedKVCache:
     """Paged decode write for ONE layer: slot ``i``'s token row lands in
@@ -763,3 +848,70 @@ class PageAllocator:
             if self._refs[pid] == 0:
                 del self._refs[pid]
                 self._free.append(pid)
+
+
+class HostPageStore:
+    """Host-DRAM page tier under the HBM pool (ISSUE 18): a
+    byte-budgeted dict of per-page k/v slabs, keyed by opaque integer
+    handles the prefix cache's ``host``-state edges carry.
+
+    The store is deliberately dumb: which entries exist and WHEN they
+    are dropped is the prefix cache's per-tier LRU policy — this class
+    only owns the byte ledger.  Entries are the GLOBAL page geometry
+    (``[layers, kv_heads, page_size, head_dim]`` per buffer) even under
+    tensor parallelism: the engine's swap-out assembles the full
+    kv-head dim via ``device_get`` and the swap-in re-shards, so the
+    host books stay replicated exactly like the page table.
+
+    Conservation mirror (the churn sweep walks it every step):
+    ``pages == `` the prefix cache's count of host-state edges, and
+    ``bytes_used == pages * page_bytes <= capacity_bytes``.
+    """
+
+    def __init__(self, capacity_bytes: int, page_bytes: int):
+        capacity_bytes = int(capacity_bytes)
+        page_bytes = int(page_bytes)
+        if capacity_bytes < 0 or page_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes ({capacity_bytes}) must be >= 0 and "
+                f"page_bytes ({page_bytes}) >= 1")
+        self.capacity_bytes = capacity_bytes
+        self.page_bytes = page_bytes
+        self._slabs: dict = {}      # handle -> (k_np, v_np)
+        self._next_handle = 0
+
+    @property
+    def pages(self) -> int:
+        return len(self._slabs)
+
+    @property
+    def bytes_used(self) -> int:
+        return len(self._slabs) * self.page_bytes
+
+    def fits(self, n: int = 1) -> bool:
+        """Would ``n`` more pages stay inside the byte budget?"""
+        return self.bytes_used + int(n) * self.page_bytes \
+            <= self.capacity_bytes
+
+    def put(self, k_np, v_np) -> int:
+        """Park one page's k/v slabs; returns the handle.  Strict on
+        the budget: the caller (the prefix cache's offload path) makes
+        room FIRST — an over-budget put is a bookkeeping bug."""
+        if not self.fits(1):
+            raise ValueError(
+                f"host tier over budget: {self.bytes_used} + "
+                f"{self.page_bytes} > {self.capacity_bytes}")
+        handle = self._next_handle
+        self._next_handle += 1
+        self._slabs[handle] = (k_np, v_np)
+        return handle
+
+    def get(self, handle: int):
+        """The ``(k, v)`` slabs behind ``handle`` (KeyError if the
+        host-tier LRU already dropped it)."""
+        return self._slabs[int(handle)]
+
+    def pop(self, handle: int):
+        """Drop an entry, returning its slabs (None if already gone —
+        a swapped-in entry may race a host-tier eviction)."""
+        return self._slabs.pop(int(handle), None)

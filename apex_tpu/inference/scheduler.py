@@ -223,8 +223,24 @@ class SlotScheduler:
                           else ServeTelemetry())
         use_prefix = (prefix_cache if prefix_cache is not None
                       else prefix_cache_enabled())
-        self.prefix = (PrefixCache(self.alloc)
-                       if engine.paged and use_prefix else None)
+        # host-DRAM page tier (ISSUE 18): armed when the engine carries
+        # a byte budget AND prefix caching is on — the tier is the
+        # prefix cache's second level, nothing else swaps.  The store
+        # and the offload closure (a batched engine extract over the
+        # scheduler's live cache) are both owned here; the prefix cache
+        # only does bookkeeping.
+        self.host_store = None
+        if engine.paged and use_prefix \
+                and getattr(engine, "host_tier_bytes", 0):
+            self.host_store = kv_cache.HostPageStore(
+                engine.host_tier_bytes, engine.page_host_bytes())
+            self.prefix = PrefixCache(self.alloc,
+                                      host_store=self.host_store,
+                                      offload=self._offload_pages)
+        elif engine.paged and use_prefix:
+            self.prefix = PrefixCache(self.alloc)
+        else:
+            self.prefix = None
         self.prefill_chunk = (default_prefill_chunk()
                               if prefill_chunk is None
                               else int(prefill_chunk))
@@ -311,6 +327,21 @@ class SlotScheduler:
                               queue_depth=len(self.queue))
         return uid
 
+    def _offload_pages(self, page_ids):
+        """Eviction-side device→host copy for the prefix cache's host
+        tier (ISSUE 18): one batched extract over the scheduler's live
+        cache, one store entry per page, handles back to the cache so
+        its edges can transition to their ``host`` state.  Returns
+        None before the first wave materializes a cache (nothing to
+        copy — the eviction then discards, as without the tier)."""
+        if self.cache is None or self.host_store is None:
+            return None
+        k, v = self.engine.swap_out_pages(self.cache, page_ids)
+        handles = [self.host_store.put(k[i].copy(), v[i].copy())
+                   for i in range(len(page_ids))]
+        self.telemetry.page_swapped("out", len(page_ids))
+        return handles
+
     # -- admission ----------------------------------------------------------
     def _pick_index(self, worst: bool = False) -> int:
         """Queue index of the next request to admit: highest effective
@@ -346,45 +377,63 @@ class SlotScheduler:
     def _reservation(self, req: Request):
         """Page plan for one request, or None (backpressure).
 
-        Paged: match the prompt against the prefix cache, take one
-        shared reference per covered page, and ACQUIRE only the
-        private pages (uncached suffix + decode headroom).  Coverage
-        is clamped to ``len(prompt) - 1`` — the last prompt token is
-        always prefilled so its logits seed the first sampled token —
-        which is exactly what makes a fully-cached prompt's boundary
-        page a COW candidate.  Short of private pages the prefix
-        cache evicts LRU entries first; only then does the request
-        wait.  Returns ``(row_ids, capacity, covered, cow_src)``:
-        ``row_ids`` the slot's full ordered page list, ``covered`` the
-        shared token coverage, ``cow_src`` the shared page to
-        privatize before the suffix prefill writes mid-page (or
-        None).  Dense: ``(None, max_seq, 0, None)``."""
+        Paged: match the prompt against BOTH tiers of the prefix
+        cache, take one shared reference per HBM-covered page, and
+        ACQUIRE the private pages (uncached suffix + decode headroom
+        + one fresh page per HOST-covered ordinal — swapped-out
+        content needs an HBM page to land in).  Coverage is clamped
+        to ``len(prompt) - 1`` — the last prompt token is always
+        prefilled so its logits seed the first sampled token — which
+        is exactly what makes a fully-cached prompt's boundary page a
+        COW candidate.  A HOST-resident boundary page needs no COW:
+        its swapped-in copy is already private to the request.  Short
+        of private pages the prefix cache evicts LRU entries first
+        (offloading them to the host tier when armed); only then does
+        the request wait.  Returns ``(row_ids, capacity, covered,
+        cow_src, swap_plan)``: ``row_ids`` the slot's full ordered
+        page list, ``covered`` the shared token coverage, ``cow_src``
+        the shared page to privatize before the suffix prefill writes
+        mid-page (or None), ``swap_plan`` the
+        ``(page_ids, k_slabs, v_slabs)`` upload the admission must
+        dispatch before the tail's first prefill chunk (or None).
+        Dense: ``(None, max_seq, 0, None, None)``."""
         eng = self.engine
         if not eng.paged:
-            return None, eng.max_seq, 0, None
+            return None, eng.max_seq, 0, None, None
         ps = eng.page_size
         need_total = self.alloc.pages_needed(
             len(req.prompt) + req.max_new_tokens)
-        covered, mpages = 0, []
+        covered, mpages, host = 0, [], []
         if self.prefix is not None:
-            covered, mpages = self.prefix.match(req.prompt)
+            covered, mpages, host = self.prefix.match_tiered(req.prompt)
             covered = min(covered, len(req.prompt) - 1)
             if covered < self.prefix.min_hit_tokens:
-                covered, mpages = 0, []
+                covered, mpages, host = 0, [], []
             else:
-                mpages = mpages[:-(-covered // ps)]
+                n_cov = -(-covered // ps)
+                mpages = mpages[:n_cov]
+                host = [(j, h) for j, h in host if j < n_cov]
         full = covered // ps
         partial = covered % ps
-        shared = mpages[:full]
-        cow_src = mpages[full] if partial else None
-        # pin the matched pages BEFORE eviction/acquire: evict_lru may
-        # release the cache's (sole) reference on exactly these pages,
-        # and the LIFO acquire would then re-issue one of them as a
-        # private suffix page — the same physical page mapped twice
-        # into one row.  The request's own references block that.
+        host_map = dict(host)
+        shared = [mpages[j] for j in range(full) if j not in host_map]
+        boundary_host = bool(partial) and (full in host_map)
+        cow_src = (mpages[full] if partial and full not in host_map
+                   else None)
+        # grab the host slabs NOW (numpy refs stay valid even if the
+        # host-tier LRU drops these entries while evict_lru below
+        # makes room for NEW offloads)
+        swap_ordinals = sorted(host_map)
+        swap_slabs = [self.host_store.get(host_map[j])
+                      for j in swap_ordinals]
+        # pin the matched HBM pages BEFORE eviction/acquire: evict_lru
+        # may release the cache's (sole) reference on exactly these
+        # pages, and the LIFO acquire would then re-issue one of them
+        # as a private suffix page — the same physical page mapped
+        # twice into one row.  The request's own references block that.
         pinned = shared + ([cow_src] if cow_src is not None else [])
         self.alloc.share(pinned)
-        need_priv = need_total - full
+        need_priv = need_total - len(shared)
         if need_priv > self.alloc.free_pages and self.prefix is not None:
             freed = self.prefix.evict_lru(
                 need_priv - self.alloc.free_pages)
@@ -392,11 +441,33 @@ class SlotScheduler:
                 self.telemetry.prefix_evicted(self.prefix.evictions)
         priv = self.alloc.acquire(need_priv)
         if priv is None:
-            self.alloc.release(pinned)
-            return None, 0, covered, None
-        row_ids = shared + priv
+            if pinned:
+                self.alloc.release(pinned)
+            return None, 0, covered, None, None
+        # assemble the row POSITIONALLY: ordinal j's page backs tokens
+        # [j*ps, (j+1)*ps) — HBM ordinals reuse the shared page, host
+        # ordinals take a fresh private page the swap-in fills
+        priv_q = list(priv)
+        row_ids, swap_ids = [], []
+        for j in range(full):
+            if j in host_map:
+                pid = priv_q.pop(0)
+                row_ids.append(pid)
+                swap_ids.append(pid)
+            else:
+                row_ids.append(mpages[j])
+        if boundary_host:
+            pid = priv_q.pop(0)
+            row_ids.append(pid)
+            swap_ids.append(pid)
+        row_ids += priv_q
+        swap_plan = None
+        if swap_ids:
+            swap_plan = (swap_ids,
+                         np.stack([s[0] for s in swap_slabs]),
+                         np.stack([s[1] for s in swap_slabs]))
         return row_ids, min(len(row_ids) * ps, eng.max_seq), covered, \
-            cow_src
+            cow_src, swap_plan
 
     def run(self, cache=None) -> dict:
         """Drain the queue; returns ``{uid: generated token list}``.
@@ -443,6 +514,10 @@ class SlotScheduler:
                 self.alloc.shared_pages(),
                 self.prefix.pinned_pages if self.prefix is not None
                 else 0)
+            if self.host_store is not None:
+                tel.host_tier(self.host_store.pages,
+                              self.host_store.bytes_used)
+                tel.host_tier_evicted(self.prefix.host_evictions)
 
         def retire(slot, reason):
             nonlocal cache
@@ -512,8 +587,11 @@ class SlotScheduler:
 
         def admit_one() -> bool:
             nonlocal cache
+            # the host-tier offload closure reads the scheduler's live
+            # cache: sync it before _reservation can trigger eviction
+            self.cache = cache
             i = self._pick_index()
-            row_ids, capacity, covered, cow_src = \
+            row_ids, capacity, covered, cow_src, swap_plan = \
                 self._reservation(self.queue[i])
             if eng.paged and row_ids is None:
                 tel.backpressured()
@@ -541,6 +619,21 @@ class SlotScheduler:
                 cache = eng.cow_page(cache, cow_src, dst)
                 self.alloc.release([cow_src])
                 tel.cow_copied(req.uid, slot, cow_src, dst)
+            if swap_plan is not None:
+                # host-tier hit (ISSUE 18): upload the swapped-out
+                # prefix pages into their freshly acquired rows BEFORE
+                # the tail's first prefill chunk — the batched uploads
+                # queue ahead of the tail's compute and the prefill
+                # attends across the partially-materialized prefix via
+                # prefill_from.  The prefix edges resurrect to HBM at
+                # this request's insert() (the swap-in commit and the
+                # cold-dedup path are the same move).
+                ids, kss, vss = swap_plan
+                cache = eng.swap_in_pages(cache, ids, kss, vss)
+                self.cache = cache
+                tel.page_swapped("in", len(ids), uid=req.uid)
+                tel.prefix_host_hit()
+                pool_gauges()
             n_chunks = (1 if not self.prefill_chunk else
                         -(-(len(req.prompt) - covered)
                           // self.prefill_chunk))

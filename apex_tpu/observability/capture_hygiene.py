@@ -17,6 +17,7 @@ __all__ = ["MAX_PLAUSIBLE_SPEEDUP", "MAX_PLAUSIBLE_TOKENS_PER_S",
            "is_us_key", "is_tokens_per_s_key", "is_mfu_key",
            "is_acceptance_rate_key", "hbm_capacity_bound",
            "vmem_capacity_bound", "is_vmem_model_key",
+           "MAX_PLAUSIBLE_HOST_TIER_BYTES", "is_host_tier_bytes_key",
            "scrub_capture_values"]
 
 #: capture-hygiene bounds: a measured duration of exactly 0.0 µs means
@@ -51,6 +52,15 @@ MAX_PLAUSIBLE_LATENCY_US = 3.6e9
 #: wearing its throughput face (flops / ~0 s); 0 and negatives are the
 #: same artifact's other side.
 MAX_PLAUSIBLE_MFU = 1.0
+
+#: host-DRAM KV-tier budget ceiling (ISSUE 18: paged infer captures
+#: stamp the effective ``APEX_TPU_HOST_KV_TIER_BYTES``).  The tier
+#: lives in HOST RAM, not HBM, so the chip-selected HBM bound does not
+#: apply — but a budget beyond ~2 TiB exceeds any TPU host's DRAM (a
+#: v5e host tops out at 512 GiB) and reads as a units bug (pages or
+#: GiB stamped into a bytes field).  0 is VALID here: it means the
+#: tier is off, and captures must record that honestly.
+MAX_PLAUSIBLE_HOST_TIER_BYTES = 1 << 41
 
 
 def is_us_key(key: str) -> bool:
@@ -108,6 +118,11 @@ def is_vmem_model_key(key: str) -> bool:
             or key.endswith("_vmem_model_bytes"))
 
 
+def is_host_tier_bytes_key(key: str) -> bool:
+    return (key == "host_tier_bytes"
+            or key.endswith("_host_tier_bytes"))
+
+
 def scrub_capture_values(obj):
     """Drop physically impossible values from a capture payload
     (recursively): NaN/Inf in ANY numeric field (NaN passes every
@@ -135,7 +150,13 @@ def scrub_capture_values(obj):
     bonus token, so effective >= floor by construction.  ISSUE 16
     VMEM-model stamps: a ``*vmem_model_bytes`` field must be positive
     and fit the chip's VMEM capacity (same chip-selected bound policy
-    as the HBM rule).
+    as the HBM rule).  ISSUE 18 host-tier stamps: a
+    ``*host_tier_bytes`` field is a HOST-RAM budget — 0 (tier off) is
+    valid, but negatives and values beyond
+    :data:`MAX_PLAUSIBLE_HOST_TIER_BYTES` (~2 TiB, above any TPU
+    host's DRAM) are units bugs; the HBM rule deliberately does not
+    see these keys (exact-key match), so a legitimate multi-hundred-GiB
+    host budget never trips the chip's HBM ceiling.
 
     Returns a scrubbed copy; containers are preserved, only the
     corrupt scalar fields vanish."""
@@ -179,6 +200,12 @@ def scrub_capture_values(obj):
                         not 0 < v <= vmem_capacity_bound(obj):
                     # a modeled VMEM envelope <= 0 or beyond the chip's
                     # VMEM is a wrong geometry / wrong chip stamp
+                    continue
+                if is_host_tier_bytes_key(k) and \
+                        not 0 <= v <= MAX_PLAUSIBLE_HOST_TIER_BYTES:
+                    # host-RAM budget, NOT an HBM quantity: 0 = tier
+                    # off (valid); negative or beyond any TPU host's
+                    # DRAM is a units bug
                     continue
             out[k] = v
         return out

@@ -153,6 +153,27 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("serve_tenant_rejected_total", "counter",
                "submissions rejected at validation, keyed by tenant",
                labels=("tenant",)),
+    # -- tiered KV memory (ISSUE 18): host-DRAM prefix-page offload.
+    #    Swap-outs ride LRU eviction (page contents copied to host
+    #    before the HBM page returns to the free list); swap-ins ride
+    #    admissions whose matched prefix is host-resident.
+    MetricSpec("serve_swap_out_pages_total", "counter",
+               "KV pages offloaded HBM -> host-DRAM tier at prefix "
+               "eviction (contents survive; the HBM page is freed)"),
+    MetricSpec("serve_swap_in_pages_total", "counter",
+               "KV pages uploaded host -> HBM on a hit against a "
+               "swapped-out prefix (recompute avoided)"),
+    MetricSpec("serve_host_tier_pages", "gauge",
+               "KV pages currently resident in the host-DRAM tier"),
+    MetricSpec("serve_host_tier_bytes", "gauge",
+               "bytes held by the host-DRAM page tier (against "
+               "APEX_TPU_HOST_KV_TIER_BYTES)"),
+    MetricSpec("serve_host_tier_evictions_total", "counter",
+               "pages dropped from the HOST tier entirely (host-LRU "
+               "under byte-budget pressure) — a re-request recomputes"),
+    MetricSpec("serve_prefix_host_hits_total", "counter",
+               "admissions whose matched prefix was (partly) host-"
+               "resident and was served by swap-in uploads"),
     # -- speculative decoding (ISSUE 15): the verify step's accept/
     #    reject accounting.  Drafted counts what the verify executable
     #    SCORED (k per active slot per round, padding drafts
@@ -216,6 +237,12 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
     MetricSpec("infer_verify_dispatch_total", "counter",
                "InferenceEngine.verify dispatches (speculative "
                "verify steps)"),
+    MetricSpec("infer_swap_out_dispatch_total", "counter",
+               "InferenceEngine.swap_out_pages batch dispatches "
+               "(fixed-width page-gather executions, D2H)"),
+    MetricSpec("infer_swap_in_dispatch_total", "counter",
+               "InferenceEngine.swap_in_pages batch dispatches "
+               "(fixed-width page-scatter executions, H2D)"),
     # -- training (TrainTelemetry) ----------------------------------------
     MetricSpec("train_steps_total", "counter",
                "instrumented train steps dispatched"),
@@ -341,6 +368,11 @@ EVENT_FIELDS: Dict[str, Dict[str, str]] = {
     "prefill_chunk": {"uid": "int", "start": "int", "tokens": "int"},
     "cow_copy": {"uid": "int", "slot": "int", "src": "int",
                  "dst": "int"},
+    # tiered KV memory (ISSUE 18): one event per batched page copy
+    # across the HBM<->host boundary.  uid tags swap-ins with the
+    # admitting request; swap-outs (eviction-driven) carry null.
+    "page_swap": {"uid": "int|null", "direction": "str",
+                  "pages": "int"},
     "request_first_token": {"uid": "int", "ttft_s": "float"},
     "request_finish": {"uid": "int", "reason": "str", "tokens": "int",
                        "e2e_s": "float"},

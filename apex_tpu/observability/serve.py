@@ -34,7 +34,8 @@ from apex_tpu.observability.registry import MetricsRegistry
 from apex_tpu.observability.spans import RequestTracer
 from apex_tpu.observability.timers import StepTimer
 
-__all__ = ["ServeTelemetry", "SPEC_METRIC_FAMILIES"]
+__all__ = ["ServeTelemetry", "SPEC_METRIC_FAMILIES",
+           "TIER_METRIC_FAMILIES"]
 
 #: the ISSUE 15 speculation families (schema-guard tested: every name
 #: here must be pinned in ``.telemetry_schema.json`` — the
@@ -47,6 +48,19 @@ SPEC_METRIC_FAMILIES = (
     "serve_spec_acceptance_rate",
     "infer_decode_fused_dispatch_total",
     "infer_verify_dispatch_total",
+)
+
+#: the ISSUE 18 host-page-tier families (same schema-guard contract as
+#: SPEC_METRIC_FAMILIES: every name pinned in ``.telemetry_schema.json``)
+TIER_METRIC_FAMILIES = (
+    "serve_swap_out_pages_total",
+    "serve_swap_in_pages_total",
+    "serve_host_tier_pages",
+    "serve_host_tier_bytes",
+    "serve_host_tier_evictions_total",
+    "serve_prefix_host_hits_total",
+    "infer_swap_out_dispatch_total",
+    "infer_swap_in_dispatch_total",
 )
 
 
@@ -110,6 +124,15 @@ class ServeTelemetry:
         self.spec_emitted = d("serve_spec_emitted_tokens_total")
         self.spec_acceptance = d("serve_spec_acceptance_rate")
         self.spec_step_seconds = 0.0
+        # tiered KV memory (ISSUE 18): host-DRAM page-tier accounting —
+        # pages crossing the HBM<->host boundary, tier residency gauges,
+        # host-LRU drops, and hits served by uploads instead of compute
+        self.swap_out_pages = d("serve_swap_out_pages_total")
+        self.swap_in_pages = d("serve_swap_in_pages_total")
+        self.host_tier_pages = d("serve_host_tier_pages")
+        self.host_tier_bytes = d("serve_host_tier_bytes")
+        self.host_tier_evictions = d("serve_host_tier_evictions_total")
+        self.prefix_host_hits = d("serve_prefix_host_hits_total")
         # request tracing (ISSUE 13): spans ride the SAME host
         # boundaries the methods below already occupy — arming the
         # tracer (trace= or APEX_TPU_TRACE) adds zero device work
@@ -208,6 +231,39 @@ class ServeTelemetry:
         done = self.prefix_evictions.total()
         if total_evictions > done:
             self.prefix_evictions.inc(total_evictions - done)
+
+    def page_swapped(self, direction: str, pages: int,
+                     uid: Optional[int] = None) -> None:
+        """``pages`` KV pages crossed the HBM<->host boundary in one
+        batched copy: ``direction`` is ``"out"`` when LRU eviction
+        offloaded prefix pages to the host tier, ``"in"`` when a hit on
+        a swapped-out prefix uploaded them back.  ``uid`` tags swap-ins
+        with the admitting request; swap-outs have no single owner."""
+        (self.swap_out_pages if direction == "out"
+         else self.swap_in_pages).inc(pages)
+        self.registry.emit_event(
+            "page_swap", uid=int(uid) if uid is not None else None,
+            direction=str(direction), pages=int(pages))
+
+    def host_tier(self, pages: int, bytes_used: int) -> None:
+        """Gauge refresh: pages resident in the host-DRAM tier and the
+        bytes they hold against the configured budget."""
+        self.host_tier_pages.set(pages)
+        self.host_tier_bytes.set(bytes_used)
+
+    def host_tier_evicted(self, total_evictions: int) -> None:
+        """Sync the host-tier eviction counter to the prefix cache's
+        lifetime tally (the :meth:`prefix_evicted` delta pattern) —
+        counts pages dropped from the HOST tier entirely, i.e. prefixes
+        that will cost recompute if requested again."""
+        done = self.host_tier_evictions.total()
+        if total_evictions > done:
+            self.host_tier_evictions.inc(total_evictions - done)
+
+    def prefix_host_hit(self) -> None:
+        """One admission whose matched prefix was (partly) host-resident
+        — served by swap-in uploads instead of prefill recompute."""
+        self.prefix_host_hits.inc()
 
     def cow_copied(self, uid: int, slot: int, src: int, dst: int) -> None:
         """One copy-on-write page duplication (a slot privatized a
@@ -415,6 +471,12 @@ class ServeTelemetry:
             out["cow_copies"] = int(self.cow_copies.total())
         if self.prefill_chunks.total():
             out["prefill_chunks"] = int(self.prefill_chunks.total())
+        if self.swap_out_pages.total() or self.swap_in_pages.total():
+            out["swap_out_pages"] = int(self.swap_out_pages.total())
+            out["swap_in_pages"] = int(self.swap_in_pages.total())
+            out["prefix_host_hits"] = int(self.prefix_host_hits.total())
+            out["host_tier_evictions"] = int(
+                self.host_tier_evictions.total())
         if self.spec_verify_steps.total():
             out["verify_steps"] = int(self.spec_verify_steps.total())
             out["spec_drafted"] = int(self.spec_drafted.total())

@@ -82,7 +82,7 @@ def metric_direction(key: str) -> Optional[str]:
     context (shapes, knob stamps, counts)."""
     base = key[:-len("_median")] if key.endswith("_median") else key
     if is_us_key(base) or base.endswith("sec_per_step") \
-            or base.endswith("_drift_ratio"):
+            or base.endswith("_drift_ratio") or base.endswith("_skew"):
         return "lower"
     if (is_tokens_per_s_key(base) or "tokens_per_s" in base
             or base.endswith("_gbps") or base == "mfu"

@@ -520,6 +520,37 @@ def _stamp_measured_attribution(extras: dict, capture_dir: str,
             "unavailable:ingest-failed"
 
 
+def _stamp_tp_skew(extras: dict, capture_dir: str, steps: int) -> None:
+    """Stamp the MEASURED cross-rank straggler skew (ISSUE 18, ROADMAP
+    item 1 leftover) into the tp infer capture when a profiler trace
+    was armed: ingest the trace the tp decode loop just dropped,
+    attribute it per rank, and stamp ``measured_tp_rank_step_skew``
+    (slowest rank window ÷ median — the straggler sets the global
+    step) plus ``measured_tp_step_us`` next to the comm_model's
+    HLO-analysis estimate, so the r17 on-chip queue run yields measured
+    overlap/skew rather than model-only numbers.  The provenance
+    marker always lands; single-rank traces stamp no skew (there is
+    nothing to straggle against) instead of a fabricated 1.0.  Named
+    ``measured_*`` like the ISSUE 14 family on purpose: the provenance
+    string is comparability context ONLY for the trace-derived metrics
+    (token-wise match), never a fork of the leg's other series."""
+    try:
+        from apex_tpu.observability import attribution, trace_ingest
+        rec = attribution.attribute(
+            trace_ingest.load_profile_dirs([capture_dir]), steps=steps)
+        attribution.publish(rec, profile_dir=capture_dir)
+        extras["measured_tp_provenance"] = rec["provenance"]
+        v = (rec.get("skew") or {}).get("slowest_over_median")
+        if v:
+            extras["measured_tp_rank_step_skew"] = v
+        step_us = rec.get("step_us")
+        if step_us:
+            extras["measured_tp_step_us"] = step_us
+    except Exception:  # noqa: BLE001 — the stamp is auxiliary
+        traceback.print_exc()
+        extras["measured_tp_provenance"] = "unavailable:ingest-failed"
+
+
 def _zero_train_setup(loss_fn, tx, params, batch_specs, batch):
     """Shared ``--override zero=1`` machinery for the main/bert/llama
     legs: a ZeRO dp-sharded train step over a ``data`` mesh of the
@@ -1118,6 +1149,44 @@ def _microbench_infer(rtt: float, on_tpu: bool):
         # the sharing geometry: one physical copy of the prefix's pages
         out["infer_prefix_shared_pages"] = -(-prefill_len // page_size)
 
+        # hot-but-evicted burst (ISSUE 18): the SAME shared burst after
+        # the prefix was evicted to the HOST tier — the hit costs
+        # batched page uploads (counted below), not recompute.  A
+        # tier-armed engine twin serves this leg so the tierless stamps
+        # above stay untouched; the effective budget/batch knobs ride
+        # the capture (same contract as page_size).
+        from apex_tpu.inference.engine import host_kv_tier_bytes
+        from apex_tpu.inference.kv_cache import default_swap_batch_pages
+
+        tier_bytes = int(_ov("host_tier_bytes",
+                             host_kv_tier_bytes() or (64 << 20)))
+        out["infer_host_tier_bytes"] = tier_bytes
+        out["infer_swap_batch_pages"] = default_swap_batch_pages()
+        eng_tier = InferenceEngine("gpt", cfg, params, slots=slots,
+                                   max_seq=max_seq, page_size=page_size,
+                                   num_pages=engine.num_pages, spec_k=0,
+                                   host_tier_bytes=tier_bytes)
+        tel_ev = ServeTelemetry(MetricsRegistry())
+        sched_ev = SlotScheduler(eng_tier, telemetry=tel_ev)
+        # warm every executable the measured wave uses: seed the cache,
+        # evict it to host (compiles the swap-out gather), replay the
+        # full burst as a swapped-out hit (compiles the swap-in scatter
+        # + the suffix bucket + the COW copy), then evict again so the
+        # measured wave starts from the same swapped-out state
+        _serve_wave(sched_ev, [burst[0]])
+        sched_ev.prefix.evict_lru(eng_tier.num_pages)
+        _serve_wave(sched_ev, burst)
+        sched_ev.prefix.evict_lru(eng_tier.num_pages)
+        n1, s1 = tel_ev.ttft.count(), tel_ev.ttft.sum()
+        _serve_wave(sched_ev, burst)          # the hot-but-evicted hit
+        out["infer_prefix_hot_evicted_ttft_us"] = round(
+            (tel_ev.ttft.sum() - s1)
+            / max(tel_ev.ttft.count() - n1, 1) * 1e6, 1)
+        out["infer_swap_in_pages"] = int(tel_ev.swap_in_pages.total())
+        out["infer_swap_out_pages"] = int(tel_ev.swap_out_pages.total())
+        out["infer_prefix_host_hits"] = int(
+            tel_ev.prefix_host_hits.total())
+
         # chunked-prefill burst: victim decodes, a filler retires, the
         # long prompt's prefill lands mid-stream — worst victim
         # inter-token gap, monolithic vs chunked
@@ -1324,6 +1393,39 @@ def _microbench_infer(rtt: float, on_tpu: bool):
             tp_decode_step,
             (cache_t, jnp.zeros((slots,), jnp.int32), jnp.int32(0)),
             (jnp.ones((slots,), bool), key), decode_iters, rtt)
+
+        def _tp_skew_post(extras, base_dir):
+            # deferred by _bench_micro_leg until the LEG-WIDE profiler
+            # capture has closed (one trace session at a time):
+            # re-dispatch the warm tp decode loop under a dedicated
+            # capture in a subdir — only the tp executable runs inside
+            # that window, so the per-rank rollups measure THIS loop's
+            # straggler skew, not the whole leg's single-rank phases
+            if base_dir is None:
+                extras["measured_tp_provenance"] = \
+                    "unavailable:capture-skipped"
+                return
+            from apex_tpu.observability.tracing import (start_profile,
+                                                        stop_profile)
+            sub = os.path.join(base_dir, "tp_skew")
+            if not start_profile(sub):
+                extras["measured_tp_provenance"] = \
+                    "unavailable:capture-skipped"
+                return
+            try:
+                _bench_loop(
+                    tp_decode_step,
+                    (cache_t, jnp.zeros((slots,), jnp.int32),
+                     jnp.int32(0)),
+                    (jnp.ones((slots,), bool), key), decode_iters, rtt)
+            finally:
+                stop_profile()
+            # the captured window saw the warm dispatch plus _REPS
+            # timed dispatches of the iters-long scan
+            _stamp_tp_skew(extras, sub,
+                           steps=(1 + _REPS) * decode_iters)
+
+        out["_post_capture"] = _tp_skew_post
         out["infer_decode_token_us_tp"] = round(t_tdec.best * 1e6, 1)
         out["infer_decode_token_us_tp_median"] = round(
             t_tdec.median * 1e6, 1)
@@ -1692,10 +1794,17 @@ def _bench_micro_leg(name: str, force_cpu: bool = False) -> None:
     whole leg there (transparent no-op otherwise) — grabbing a device
     trace of any leg is one environment variable, zero code edits."""
     from apex_tpu.observability import profile_capture
+    from apex_tpu.observability.tracing import profile_dir as _prof_dir
 
     on_tpu, rtt = _bench_setup(force_cpu)
-    with profile_capture(tag=f"bench_{name}"):
+    with profile_capture(tag=f"bench_{name}") as profiled:
         res = MICRO_LEGS[name](rtt, on_tpu)
+    # a leg may defer trace-dependent stamping until its leg-wide
+    # capture has closed (one profiler session at a time); the hook
+    # receives the armed dir only when the capture actually ran
+    post = res.pop("_post_capture", None)
+    if post is not None and _prof_dir() is not None:
+        post(res, _prof_dir() if profiled else None)
     res["_leg"] = name
     print(json.dumps(res))
 

@@ -72,6 +72,19 @@ def test_speculation_families_are_pinned():
         assert fam in schema.METRIC_SPECS, fam
 
 
+def test_tier_families_are_pinned():
+    """ISSUE 18 satellite: the committed schema re-pin covers every
+    host-page-tier family the serve telemetry and engine emit, plus
+    the page_swap event — a new tier family cannot ship unpinned."""
+    from apex_tpu.observability import serve
+    committed = json.loads((REPO / schema.SCHEMA_NAME).read_text())
+    for fam in serve.TIER_METRIC_FAMILIES:
+        assert fam in committed["prometheus"], fam
+        assert fam in schema.METRIC_SPECS, fam
+    assert "page_swap" in committed["jsonl"]["events"]
+    assert "page_swap" in schema.EVENT_FIELDS
+
+
 def test_measured_attribution_families_are_pinned():
     """ISSUE 14 satellite: the committed schema re-pin covers every
     family and event the trace-ingestion/attribution layer emits — a

@@ -98,12 +98,24 @@ def test_metric_direction_classifier():
     assert watch.metric_direction("measured_step_us") == "lower"
     assert watch.metric_direction("measured_exposed_comm_us") == "lower"
     assert watch.metric_direction("measured_mfu") == "higher"
+    # ISSUE 18: the hot-but-evicted TTFT stamp (swap-in uploads
+    # instead of recompute) trends lower-is-better like every latency,
+    # and the swap page tallies are workload counts, not measurements
+    assert watch.metric_direction("infer_prefix_hot_evicted_ttft_us") \
+        == "lower"
+    # the measured cross-rank straggler ratio (slowest/median window)
+    # is lower-is-better — a widening skew is a regression
+    assert watch.metric_direction("measured_tp_rank_step_skew") \
+        == "lower"
     # context, not measurements: shapes, knob stamps, SLO targets
     assert watch.metric_direction("infer_shape") is None
     assert watch.metric_direction("xent_chunk") is None
     assert watch.metric_direction("infer_slo_ttft") is None
     assert watch.metric_direction("infer_trace") is None
     assert watch.metric_direction("adam_nelem") is None
+    assert watch.metric_direction("infer_swap_batch_pages") is None
+    assert watch.metric_direction("infer_host_tier_bytes") is None
+    assert watch.metric_direction("infer_swap_in_pages") is None
     assert watch.metric_direction("measured_attribution_provenance") \
         is None
 

@@ -141,6 +141,38 @@ def test_scrub_rejects_nonphysical_vmem_model_fields():
         bench._scrub_capture_values(over)
 
 
+def test_scrub_rejects_nonphysical_host_tier_bytes_fields():
+    """ISSUE 18 satellite: a ``*host_tier_bytes`` stamp is a HOST-RAM
+    budget, not an HBM quantity — 0 (tier off) is valid and must
+    survive, negatives and beyond-any-host values vanish, and a
+    legitimate budget far above the chip's HBM must NOT trip the
+    chip-selected HBM bound (that rule is exact-key)."""
+    import bench
+    from apex_tpu.observability.capture_hygiene import (
+        MAX_PLAUSIBLE_HOST_TIER_BYTES)
+
+    v5e = chip_specs.CHIP_SPECS["v5e"]
+    # a 256 GiB host budget dwarfs v5e HBM and is still physical
+    good = {"chip": "TPU v5e",
+            "infer_host_tier_bytes": 256 * 1024 ** 3,
+            "infer_swap_batch_pages": 8}
+    assert good["infer_host_tier_bytes"] > v5e.hbm_bytes
+    assert bench._scrub_capture_values(good) == good
+
+    off = {"chip": "TPU v5e", "infer_host_tier_bytes": 0}
+    assert bench._scrub_capture_values(off) == off
+
+    at_bound = {"infer_host_tier_bytes":
+                MAX_PLAUSIBLE_HOST_TIER_BYTES}
+    assert bench._scrub_capture_values(at_bound) == at_bound
+
+    poisoned = {"chip": "TPU v5e",
+                "infer_host_tier_bytes":
+                MAX_PLAUSIBLE_HOST_TIER_BYTES + 1,
+                "other_host_tier_bytes": -1}
+    assert bench._scrub_capture_values(poisoned) == {"chip": "TPU v5e"}
+
+
 def test_scrub_existing_rules_still_hold():
     import bench
     payload = {"flash_attn_us": 0.0, "adam_speedup": 1e9,
