@@ -303,6 +303,25 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "captures as infer_swap_batch_pages",
         read_by="apex_tpu/inference/kv_cache.py"),
     EnvKnob(
+        name="APEX_TPU_FLEET_REPLICAS",
+        default="0",
+        effect="replica count for the fleet front door (ISSUE 19): "
+               "> 0 makes bench's fleet leg / examples build this "
+               "many engine+scheduler replicas behind one FleetRouter "
+               "(process-local, equal aggregate HBM); 0 (default) "
+               "serves behind one standalone scheduler.  Stamped into "
+               "fleet bench captures as fleet_replicas",
+        read_by="apex_tpu/fleet/router.py"),
+    EnvKnob(
+        name="APEX_TPU_FLEET_POLICY",
+        default="prefix_affinity",
+        effect="routing policy when FleetRouter(policy=None): "
+               "round_robin, least_loaded, or prefix_affinity "
+               "(read-only radix peek + swap-aware admission cost, "
+               "with a load-aware spill threshold); stamped into "
+               "fleet bench captures as fleet_policy",
+        read_by="apex_tpu/fleet/router.py"),
+    EnvKnob(
         name="APEX_TPU_PAGED_XLA_MAX_PAGES",
         default="64",
         effect="paged_decode_attention gathers slot windows through "

@@ -1,0 +1,30 @@
+"""Fleet front door (ISSUE 19): the layer ABOVE one engine+scheduler.
+
+``router``
+    :class:`FleetRouter` — one ``submit()`` over N scheduler replicas
+    with pluggable routing (``round_robin`` / ``least_loaded`` /
+    ``prefix_affinity``), PR 13's overload/burn-rate trackers as the
+    routing + cross-replica shedding signal, and a fleet-level
+    conservation law.
+``capacity``
+    A deterministic discrete-event simulator pricing replica counts
+    against traffic mixes from MEASURED per-token latencies
+    (``unavailable:`` provenance when none exist — never fabricated).
+"""
+from apex_tpu.fleet.capacity import (CAPACITY_DRIFT_TOLERANCE,
+                                     ServiceProfile, drift_ratio,
+                                     profile_from_captures,
+                                     required_replicas, simulate)
+from apex_tpu.fleet.router import (FLEET_POLICY_ENV,
+                                   FLEET_REPLICAS_ENV, POLICIES,
+                                   FleetRouter, build_fleet,
+                                   default_fleet_policy,
+                                   fleet_replicas_from_env)
+
+__all__ = [
+    "FleetRouter", "build_fleet", "POLICIES",
+    "fleet_replicas_from_env", "default_fleet_policy",
+    "FLEET_REPLICAS_ENV", "FLEET_POLICY_ENV",
+    "ServiceProfile", "profile_from_captures", "simulate",
+    "required_replicas", "drift_ratio", "CAPACITY_DRIFT_TOLERANCE",
+]

@@ -252,6 +252,31 @@ def prefill_bucket(n: int, max_seq: int, min_bucket: int = 64) -> int:
     return min(b, max_seq)
 
 
+class PendingSwapOut:
+    """In-flight device→host page drain (ISSUE 19): the batched
+    gather dispatches have been issued but the blocking
+    ``device_get``\\ s have not run yet.  ``resolve()`` fetches (once;
+    idempotent) and returns the concatenated ``(k, v)`` numpy slabs.
+    Safe to defer across later cache mutations: each batch's output is
+    a fresh device buffer, not a view of the (donated) cache."""
+    __slots__ = ("_batches", "_resolved")
+
+    def __init__(self, batches):
+        self._batches = batches        # [(k_dev, v_dev, valid_rows)]
+        self._resolved = None
+
+    def resolve(self):
+        if self._resolved is None:
+            ks = [np.asarray(jax.device_get(k_s))[:m]
+                  for k_s, _, m in self._batches]
+            vs = [np.asarray(jax.device_get(v_s))[:m]
+                  for _, v_s, m in self._batches]
+            self._resolved = (np.concatenate(ks, axis=0),
+                              np.concatenate(vs, axis=0))
+            self._batches = None       # free the device buffers
+        return self._resolved
+
+
 class InferenceEngine:
     """Serving engine over a standalone GPT/LLaMA/BERT — single-chip by
     default, tensor-parallel over a ``tp``-wide mesh on request.
@@ -711,7 +736,7 @@ class InferenceEngine:
         return (2 * d["layers"] * self.tp_dims["kv_heads_pool"]
                 * self.page_size * d["head_dim"] * itemsize)
 
-    def swap_out_pages(self, cache, page_ids):
+    def swap_out_pages(self, cache, page_ids, defer: bool = False):
         """Copy physical pages ``page_ids`` device→host (ISSUE 18
         eviction offload): returns ``(k, v)`` numpy slabs
         ``[n, layers, kv_heads, page_size, head_dim]``.  Pure read —
@@ -720,7 +745,14 @@ class InferenceEngine:
         dispatched back-to-back (short batches pad with the trash
         page) and fetched only after the LAST dispatch, so the
         device-side gathers pipeline ahead of the host copies; every
-        batch rides the ONE compiled extract program."""
+        batch rides the ONE compiled extract program.
+
+        ``defer=True`` (ISSUE 19) skips the fetch entirely and returns
+        a :class:`PendingSwapOut` instead: the gathers are dispatched
+        NOW (into fresh output buffers, so later cache donations
+        cannot disturb them) but the blocking ``device_get``\\ s run
+        only at ``resolve()`` — the scheduler drains them at the next
+        wave boundary instead of stalling the eviction path."""
         if not self.paged:
             raise ValueError("swap_out_pages is the paged-mode host "
                              "tier; this engine runs the dense slot "
@@ -740,11 +772,9 @@ class InferenceEngine:
                 self._swap_out_dispatches.inc()
                 k_s, v_s = self._swap_out(cache, padded)
                 pending.append((k_s, v_s, chunk.shape[0]))
-            ks = [np.asarray(jax.device_get(k_s))[:m]
-                  for k_s, _, m in pending]
-            vs = [np.asarray(jax.device_get(v_s))[:m]
-                  for _, v_s, m in pending]
-        return np.concatenate(ks, axis=0), np.concatenate(vs, axis=0)
+            if defer:
+                return PendingSwapOut(pending)
+        return PendingSwapOut(pending).resolve()
 
     def swap_in_pages(self, cache, page_ids, k_slabs, v_slabs):
         """Upload host-tier page slabs back into freshly acquired

@@ -850,6 +850,24 @@ class PageAllocator:
                 self._free.append(pid)
 
 
+class _DeferredSlab:
+    """Placeholder for one page whose device→host drain has been
+    DISPATCHED but not yet fetched (ISSUE 19): ``pending.resolve()``
+    returns the batch's stacked ``(k, v)`` slabs and ``index`` selects
+    this page's row.  Bytes are booked the moment the placeholder is
+    parked — the drain WILL land — so the budget stays as strict as an
+    eager put."""
+    __slots__ = ("pending", "index")
+
+    def __init__(self, pending, index: int):
+        self.pending = pending
+        self.index = index
+
+    def materialize(self):
+        k, v = self.pending.resolve()
+        return k[self.index].copy(), v[self.index].copy()
+
+
 class HostPageStore:
     """Host-DRAM page tier under the HBM pool (ISSUE 18): a
     byte-budgeted dict of per-page k/v slabs, keyed by opaque integer
@@ -906,12 +924,41 @@ class HostPageStore:
         self._slabs[handle] = (k_np, v_np)
         return handle
 
+    def put_deferred(self, n: int, pending) -> list:
+        """Park ``n`` pages whose device→host drain is in flight
+        (ISSUE 19): ``pending.resolve()`` must return the batch's
+        stacked ``(k, v)`` slabs ``[n, ...]``.  Same strict budget as
+        :meth:`put` — bytes are booked eagerly for all ``n`` pages.
+        Returns one handle per page.  A :meth:`get`/:meth:`pop` before
+        the owner drains ``pending`` forces resolution (a prefix hit
+        racing its own eviction is correct, just no longer deferred)."""
+        n = int(n)
+        if not self.fits(n):
+            raise ValueError(
+                f"host tier over budget: {self.bytes_used} + "
+                f"{n * self.page_bytes} > {self.capacity_bytes}")
+        handles = []
+        for i in range(n):
+            handle = self._next_handle
+            self._next_handle += 1
+            self._slabs[handle] = _DeferredSlab(pending, i)
+            handles.append(handle)
+        return handles
+
     def get(self, handle: int):
         """The ``(k, v)`` slabs behind ``handle`` (KeyError if the
         host-tier LRU already dropped it)."""
-        return self._slabs[int(handle)]
+        handle = int(handle)
+        entry = self._slabs[handle]
+        if isinstance(entry, _DeferredSlab):
+            entry = entry.materialize()
+            self._slabs[handle] = entry
+        return entry
 
     def pop(self, handle: int):
         """Drop an entry, returning its slabs (None if already gone —
         a swapped-in entry may race a host-tier eviction)."""
-        return self._slabs.pop(int(handle), None)
+        entry = self._slabs.pop(int(handle), None)
+        if isinstance(entry, _DeferredSlab):
+            entry = entry.materialize()
+        return entry

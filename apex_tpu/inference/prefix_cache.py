@@ -197,6 +197,50 @@ class PrefixCache:
             edge.stamp = stamp
         return c, pages, host
 
+    def peek_match(self, tokens: Sequence[int]) \
+            -> Tuple[int, int, int]:
+        """READ-ONLY coverage probe for routers (ISSUE 19):
+        ``(covered_tokens, hbm_pages, host_pages)`` for the longest
+        cached prefix of ``tokens`` across both tiers — the same walk
+        as :meth:`match_tiered` but with ZERO side effects: no LRU
+        touch, no clock tick, no counter.  A fleet front door peeks
+        every replica's cache to find where a shared prefix's pages
+        live; only the replica that actually ADMITS the request may
+        disturb recency (a peek that stamped edges would let routing
+        probes pin victims against eviction on replicas that never
+        serve them)."""
+        toks = [int(t) for t in tokens]
+        ps = self.page_size
+        node, c = self._root, 0
+        hbm = host = 0
+        while len(toks) - c >= ps:
+            edge = node.children.get(tuple(toks[c:c + ps]))
+            if edge is None:
+                break
+            if edge.page is None:
+                host += 1
+            else:
+                hbm += 1
+            c += ps
+            node = edge.child
+        rest = toks[c:]
+        best, best_edge = 0, None
+        if rest:
+            for et, edge in list(node.children.items()) \
+                    + list(node.partials.items()):
+                n = _lcp(et, rest)
+                if n > best:
+                    best, best_edge = n, edge
+        if best_edge is not None:
+            if best_edge.page is None:
+                host += 1
+            else:
+                hbm += 1
+            c += best
+        if c < self.min_hit_tokens:
+            return 0, 0, 0
+        return c, hbm, host
+
     def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
         """Single-tier view of :meth:`match_tiered` for callers that
         cannot swap in: coverage truncates at the first host-resident

@@ -93,6 +93,13 @@ REASON_TRUNCATED = "truncated"        # slot capacity (max_seq or page
 REASON_SHED = "shed"                  # rejected while queued by the
 #                                       overload shedding advisory
 
+#: Admission-cost weight of one HOST-tier-covered token (ISSUE 19):
+#: a swap-in upload per page instead of a full prefill recompute —
+#: much cheaper than cold (1.0) but never free like an HBM hit (0.0).
+#: The exact value only needs to preserve that ordering; 0.25 tracks
+#: the dryrun's upload-vs-prefill ratio at the flagship page size.
+HOST_HIT_TOKEN_COST = 0.25
+
 _PREFILL_CHUNK_ENV = "APEX_TPU_PREFILL_CHUNK"
 _TENANT_PRIORITY_ENV = "APEX_TPU_TENANT_PRIORITY"
 
@@ -210,8 +217,14 @@ class SlotScheduler:
                  max_chunks_per_pass: int = 1,
                  slo: Optional[SLOTracker] = None,
                  shed_on_overload: bool = False,
-                 drafter: Optional[Drafter] = None):
+                 drafter: Optional[Drafter] = None,
+                 replica_id: Optional[int] = None):
         self.engine = engine
+        # fleet plumb-through (ISSUE 19): the router stamps each
+        # replica's ordinal here so per-replica metric labels and
+        # route_decision events can name the scheduler they hit;
+        # standalone schedulers stay unlabeled (None).
+        self.replica_id = replica_id
         self.queue: collections.deque = collections.deque()
         self._next_uid = 0
         self.alloc = engine.new_allocator() if engine.paged else None
@@ -230,6 +243,7 @@ class SlotScheduler:
         # scheduler's live cache) are both owned here; the prefix cache
         # only does bookkeeping.
         self.host_store = None
+        self._pending_swaps: list = []   # deferred D2H drains (ISSUE 19)
         if engine.paged and use_prefix \
                 and getattr(engine, "host_tier_bytes", 0):
             self.host_store = kv_cache.HostPageStore(
@@ -333,14 +347,62 @@ class SlotScheduler:
         cache, one store entry per page, handles back to the cache so
         its edges can transition to their ``host`` state.  Returns
         None before the first wave materializes a cache (nothing to
-        copy — the eviction then discards, as without the tier)."""
+        copy — the eviction then discards, as without the tier).
+
+        The drain is DEFERRED (ISSUE 19): the gather dispatches queue
+        now, but the blocking ``device_get``\\ s run at the next wave
+        boundary (or on the first hit against one of these handles,
+        whichever comes first) — eviction inside the admission path no
+        longer stalls on PCIe."""
         if self.cache is None or self.host_store is None:
             return None
-        k, v = self.engine.swap_out_pages(self.cache, page_ids)
-        handles = [self.host_store.put(k[i].copy(), v[i].copy())
-                   for i in range(len(page_ids))]
+        pending = self.engine.swap_out_pages(self.cache, page_ids,
+                                             defer=True)
+        handles = self.host_store.put_deferred(len(page_ids), pending)
+        self._pending_swaps.append(pending)
         self.telemetry.page_swapped("out", len(page_ids))
         return handles
+
+    def drain_pending_swaps(self) -> int:
+        """Resolve every deferred device→host page drain (ISSUE 19):
+        returns how many batches were forced.  Called at the wave
+        boundary; hits against still-pending handles resolve lazily
+        through the host store, so this only catches stragglers."""
+        n = len(self._pending_swaps)
+        for p in self._pending_swaps:
+            p.resolve()
+        self._pending_swaps.clear()
+        return n
+
+    def admission_cost(self, prompt) -> float:
+        """Estimated admission cost in PREFILL-TOKEN EQUIVALENTS for a
+        prompt, resolved against the prefix cache WITHOUT disturbing
+        its LRU (a pure :meth:`PrefixCache.peek_match` probe).
+
+        Cold tokens cost 1.0 each.  HBM-covered tokens cost 0 — the
+        pages are already resident.  HOST-tier-covered tokens cost
+        ``HOST_HIT_TOKEN_COST`` each (ISSUE 19 satellite): the swap-in
+        upload is far cheaper than recomputing the prefix but it is
+        NOT a free HBM hit — each such page still buys a fresh HBM
+        page and a PCIe upload before the tail can prefill.  Pinned by
+        a unit test: full-HBM hit < host hit < cold, always."""
+        toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if self.prefix is None:
+            return float(len(toks))
+        covered, _hbm, host = self.prefix.peek_match(toks)
+        host_tokens = min(host * self.engine.page_size, covered)
+        return (float(len(toks) - covered)
+                + HOST_HIT_TOKEN_COST * host_tokens)
+
+    def shed_worst(self) -> Optional[int]:
+        """Public shed hook for the fleet router (ISSUE 19): reject
+        the worst-ranked QUEUED request (lowest effective priority,
+        most recently admitted tenant, newest) and return its uid, or
+        None when nothing is queued.  Same conservation-preserving
+        path as the in-loop overload shed."""
+        if not self.queue:
+            return None
+        return self._shed_one()
 
     # -- admission ----------------------------------------------------------
     def _pick_index(self, worst: bool = False) -> int:
@@ -805,6 +867,10 @@ class SlotScheduler:
         # the (donation-threaded) cache carries into the next wave —
         # cached prefix pages stay valid across run() calls
         self.cache = cache
+        # wave boundary: force any deferred eviction drains to land
+        # (ISSUE 19) — the dispatches have been pipelining behind the
+        # wave's real work; the gets happen here, out of line
+        self.drain_pending_swaps()
         # wave boundary: close one SLO accounting window (burn rate /
         # budget gauges + slo_violation events off the histogram deltas
         # this wave contributed), then flush snapshot sinks (the

@@ -93,7 +93,7 @@ from apex_tpu.observability.numerics import (NumericsAccountant,
                                              NumericsProbes,
                                              compute_probes,
                                              flat_leaf_names)
-from apex_tpu.observability.serve import ServeTelemetry
+from apex_tpu.observability.serve import FleetTelemetry, ServeTelemetry
 from apex_tpu.observability.sinks import (JsonlSink, PrometheusSink,
                                           render_prometheus)
 from apex_tpu.observability.slo import (OverloadDetector, SLOSpec,
@@ -128,7 +128,7 @@ __all__ = [
     "profile_dir_unusable", "start_profile", "stop_profile",
     "TraceEvent", "RankTrace", "parse_trace_file", "load_profile_dirs",
     "attribute", "publish",
-    "ServeTelemetry", "TrainTelemetry",
+    "ServeTelemetry", "FleetTelemetry", "TrainTelemetry",
     "RequestTracer", "default_trace_sample",
     "SLOSpec", "SLOTracker", "OverloadDetector", "slo_specs_from_env",
     "NumericsProbes", "NumericsAccountant", "compute_probes",

@@ -7,6 +7,13 @@ Extracted from ``bench.py`` (ISSUE 13) so package code can scrub
 without importing the repo-root bench script: one copy of the rules,
 two consumers — the no-second-copy discipline the chip-spec table
 already follows.
+
+ISSUE 19: the fleet bench leg's per-replica and policy-comparison
+fields (``fleet_affinity_ttft_us`` / ``fleet_round_robin_ttft_us``,
+``fleet_capacity_pred_ttft_us``) need NO new rules here — they ride
+the existing ``*_us`` latency suffix scrub, and the capacity sim's
+``fleet_capacity_drift_ratio`` is a unitless >= 1 agreement ratio the
+watch already trends by its ``_drift_ratio`` suffix.
 """
 from __future__ import annotations
 

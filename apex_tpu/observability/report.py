@@ -427,6 +427,50 @@ def _slo_section(events: list, families: dict) -> Optional[dict]:
     return out
 
 
+def _fleet_section(events: list, families: dict) -> Optional[dict]:
+    """The ISSUE 19 fleet front door: router-side routed/shed tallies,
+    per-replica routing + load off the ``fleet_*`` families, and the
+    policy mix off the ``route_decision`` events.  Returns None when
+    the run carried no fleet signal at all — every pre-PR-19 run dir
+    renders byte-identically (the back-compat goldens pin it)."""
+    routes = [e for e in events if e.get("kind") == "route_decision"]
+    has_fams = any(f.startswith("fleet_") for f in families)
+    if not (routes or has_fams):
+        return None
+    out: dict = {}
+    for key, fam in (("submitted", "fleet_requests_submitted_total"),
+                     ("routed", "fleet_requests_routed_total"),
+                     ("shed", "fleet_requests_shed_total"),
+                     ("affinity_hits",
+                      "fleet_prefix_affinity_hits_total"),
+                     ("affinity_spills",
+                      "fleet_affinity_spills_total")):
+        v = _family_total(families, fam)
+        if v is not None:
+            out[key] = v
+    replicas: Dict[str, dict] = {}
+    for key, fam in (("routed", "fleet_requests_routed_total"),
+                     ("shed", "fleet_requests_shed_total"),
+                     ("prefix_tokens",
+                      "fleet_routed_prefix_tokens_total"),
+                     ("queue_depth", "fleet_replica_queue_depth"),
+                     ("free_pages", "fleet_replica_free_pages"),
+                     ("overloaded", "fleet_replica_overloaded")):
+        for rep, v in _family_by_label(families, fam,
+                                       "replica").items():
+            replicas.setdefault(rep, {})[key] = v
+    if replicas:
+        out["replicas"] = {k: replicas[k] for k in sorted(replicas)}
+    if routes:
+        out["route_decisions"] = len(routes)
+        policies = sorted({str(e.get("policy", "?")) for e in routes})
+        out["policies"] = policies
+        spills = sum(1 for e in routes if e.get("spilled"))
+        if spills:
+            out["spilled_decisions"] = spills
+    return out
+
+
 #: attribution-event scalar keys copied verbatim into the measured
 #: section / detail view (render order).
 _MEASURED_KEYS = ("provenance", "ranks", "steps", "window_us",
@@ -566,6 +610,7 @@ def build_report(events: list, prom_text: str,
         "numerics": _numerics_section(events, families),
         "serve": _serve_section(events, families),
         "slo": _slo_section(events, families),
+        "fleet": _fleet_section(events, families),
         "measured": _measured_section(events, families),
         "compiled_attribution": _attribution_section(stats, budget),
     }
@@ -881,6 +926,33 @@ def render_markdown(report: dict) -> str:
         if sb:
             lines.append("- **shed_by_tenant**: " + ", ".join(
                 f"{k}={_f(v)}" for k, v in sorted(sb.items())))
+        lines.append("")
+
+    fleet = report.get("fleet")
+    if fleet:
+        lines += ["## Fleet", ""]
+        lines += _kv_lines(fleet, (
+            "submitted", "routed", "shed", "affinity_hits",
+            "affinity_spills", "route_decisions",
+            "spilled_decisions"))
+        pol = fleet.get("policies")
+        if pol:
+            lines.append(f"- **policies**: {', '.join(pol)}")
+        reps = fleet.get("replicas")
+        if reps:
+            lines += ["",
+                      "| replica | routed | shed | prefix tokens "
+                      "| queue | free pages | overloaded |",
+                      "|---|---|---|---|---|---|---|"]
+            for name in sorted(reps):
+                r = reps[name]
+                lines.append(
+                    f"| {name} | {_f(r.get('routed'))} "
+                    f"| {_f(r.get('shed'))} "
+                    f"| {_f(r.get('prefix_tokens'))} "
+                    f"| {_f(r.get('queue_depth'))} "
+                    f"| {_f(r.get('free_pages'))} "
+                    f"| {_f(r.get('overloaded'))} |")
         lines.append("")
 
     measured = report.get("measured")

@@ -222,6 +222,43 @@ METRIC_SPECS: Dict[str, MetricSpec] = {s.name: s for s in [
                "per-tenant admission goodput: admitted / (admitted + "
                "validation rejects + sheds), 0..1",
                labels=("tenant",)),
+    # -- fleet front door (ISSUE 19): the multi-replica router.  The
+    #    replica label is the replica ordinal as a string; "router" on
+    #    the shed family marks front-door rejects that never reached
+    #    any replica's queue.
+    MetricSpec("fleet_requests_submitted_total", "counter",
+               "requests entering the fleet front door (before any "
+               "routing decision)"),
+    MetricSpec("fleet_requests_routed_total", "counter",
+               "requests routed to a replica, keyed by replica ordinal",
+               labels=("replica",)),
+    MetricSpec("fleet_requests_shed_total", "counter",
+               "requests shed by cross-replica overload routing, keyed "
+               "by the replica whose queue lost them (\"router\" = "
+               "rejected at the front door before reaching any queue)",
+               labels=("replica",)),
+    MetricSpec("fleet_prefix_affinity_hits_total", "counter",
+               "routing decisions that landed on a replica holding a "
+               "non-zero radix peek match (the prefix's pages — HBM or "
+               "host tier — already live there)"),
+    MetricSpec("fleet_affinity_spills_total", "counter",
+               "affinity routings diverted to the least-loaded replica "
+               "because the preferred replica sat over the load spill "
+               "threshold (affinity must not starve a replica)"),
+    MetricSpec("fleet_routed_prefix_tokens_total", "counter",
+               "prompt tokens already cached on the chosen replica at "
+               "routing time (read-only peek coverage), keyed by "
+               "replica", labels=("replica",)),
+    MetricSpec("fleet_replica_queue_depth", "gauge",
+               "queued requests per replica as seen at the last "
+               "routing decision", labels=("replica",)),
+    MetricSpec("fleet_replica_free_pages", "gauge",
+               "free KV pages per replica as seen at the last routing "
+               "decision", labels=("replica",)),
+    MetricSpec("fleet_replica_overloaded", "gauge",
+               "per-replica overload advisory (0/1) as seen by the "
+               "router (PR 13's detector, consumed as a routing "
+               "signal)", labels=("replica",)),
     # -- engine dispatch (host wrappers around the donated executables) ---
     MetricSpec("infer_prefill_dispatch_total", "counter",
                "InferenceEngine.prefill dispatches"),
@@ -397,6 +434,14 @@ EVENT_FIELDS: Dict[str, Dict[str, str]] = {
     "overload": {"overloaded": "bool", "queue_depth": "int",
                  "backpressure_waits": "float",
                  "free_pages": "int|null"},
+    # fleet routing (ISSUE 19): one event per front-door decision.
+    # uid is the FLEET uid; prefix_tokens is the read-only peek
+    # coverage on the chosen replica; spilled marks an affinity pick
+    # diverted by the load spill threshold.
+    "route_decision": {"uid": "int", "replica": "int", "policy": "str",
+                       "prefix_tokens": "int", "queue_depth": "int",
+                       "free_pages": "int|null", "overloaded": "bool",
+                       "spilled": "bool"},
     "train_step": {"step": "int", "seconds": "float|null",
                    "recompiled": "bool"},
     "train_numerics": {"step": "int", "grad_norm": "float|null",

@@ -34,8 +34,8 @@ from apex_tpu.observability.registry import MetricsRegistry
 from apex_tpu.observability.spans import RequestTracer
 from apex_tpu.observability.timers import StepTimer
 
-__all__ = ["ServeTelemetry", "SPEC_METRIC_FAMILIES",
-           "TIER_METRIC_FAMILIES"]
+__all__ = ["ServeTelemetry", "FleetTelemetry", "SPEC_METRIC_FAMILIES",
+           "TIER_METRIC_FAMILIES", "FLEET_METRIC_FAMILIES"]
 
 #: the ISSUE 15 speculation families (schema-guard tested: every name
 #: here must be pinned in ``.telemetry_schema.json`` — the
@@ -62,6 +62,104 @@ TIER_METRIC_FAMILIES = (
     "infer_swap_out_dispatch_total",
     "infer_swap_in_dispatch_total",
 )
+
+#: the ISSUE 19 fleet-front-door families (same schema-guard contract
+#: as SPEC/TIER_METRIC_FAMILIES: every name pinned in
+#: ``.telemetry_schema.json``)
+FLEET_METRIC_FAMILIES = (
+    "fleet_requests_submitted_total",
+    "fleet_requests_routed_total",
+    "fleet_requests_shed_total",
+    "fleet_prefix_affinity_hits_total",
+    "fleet_affinity_spills_total",
+    "fleet_routed_prefix_tokens_total",
+    "fleet_replica_queue_depth",
+    "fleet_replica_free_pages",
+    "fleet_replica_overloaded",
+)
+
+
+class FleetTelemetry:
+    """Front-door routing accounting for the ISSUE 19 fleet router:
+    per-replica-labeled routing/shed counters, the replica load gauges
+    the router samples while deciding, and one ``route_decision``
+    JSONL event per submit.
+
+    The router-side half of the fleet conservation law (the other half
+    is each replica's own :meth:`ServeTelemetry.conservation`):
+    every front-door submit is either ROUTED to exactly one replica or
+    SHED at the router (``replica="router"``), so
+    ``submitted == Σ routed + shed{router}``."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        if registry is None:
+            from apex_tpu.observability import configure_from_env
+            registry = configure_from_env()
+        self.registry = registry
+        d = registry.declared
+        self.submitted = d("fleet_requests_submitted_total")
+        self.routed = d("fleet_requests_routed_total")
+        self.shed = d("fleet_requests_shed_total")
+        self.affinity_hits = d("fleet_prefix_affinity_hits_total")
+        self.affinity_spills = d("fleet_affinity_spills_total")
+        self.routed_prefix_tokens = d("fleet_routed_prefix_tokens_total")
+        self.replica_queue_depth = d("fleet_replica_queue_depth")
+        self.replica_free_pages = d("fleet_replica_free_pages")
+        self.replica_overloaded = d("fleet_replica_overloaded")
+
+    def request_submitted(self) -> None:
+        """One request reached the front door (pre-routing)."""
+        self.submitted.inc()
+
+    def replica_load(self, replica: int, queue_depth: int,
+                     free_pages: Optional[int],
+                     overloaded: bool) -> None:
+        """Gauge refresh for one replica's load as the router saw it
+        while deciding (queue depth, free pages, overload advisory)."""
+        r = str(int(replica))
+        self.replica_queue_depth.set(int(queue_depth), replica=r)
+        if free_pages is not None:
+            self.replica_free_pages.set(int(free_pages), replica=r)
+        self.replica_overloaded.set(1 if overloaded else 0, replica=r)
+
+    def route(self, uid: int, replica: int, policy: str,
+              prefix_tokens: int = 0, queue_depth: int = 0,
+              free_pages: Optional[int] = None,
+              overloaded: bool = False, spilled: bool = False) -> None:
+        """One routing decision: the request went to ``replica``.
+        ``prefix_tokens`` is the read-only peek coverage found there;
+        ``spilled`` marks an affinity pick diverted by the load spill
+        threshold."""
+        r = str(int(replica))
+        self.routed.inc(replica=r)
+        if prefix_tokens:
+            self.affinity_hits.inc()
+            self.routed_prefix_tokens.inc(int(prefix_tokens), replica=r)
+        if spilled:
+            self.affinity_spills.inc()
+        self.registry.emit_event(
+            "route_decision", uid=int(uid), replica=int(replica),
+            policy=str(policy), prefix_tokens=int(prefix_tokens),
+            queue_depth=int(queue_depth),
+            free_pages=int(free_pages) if free_pages is not None
+            else None, overloaded=bool(overloaded),
+            spilled=bool(spilled))
+
+    def request_shed(self, replica: Optional[int] = None) -> None:
+        """One request shed by cross-replica overload routing: from
+        ``replica``'s queue, or at the front door before reaching any
+        queue (``replica=None`` → the ``"router"`` label)."""
+        self.shed.inc(replica="router" if replica is None
+                      else str(int(replica)))
+
+    def conservation(self) -> dict:
+        """Router-side half of the fleet conservation law:
+        ``submitted == routed + shed{router}``."""
+        return {
+            "submitted": int(self.submitted.total()),
+            "routed": int(self.routed.total()),
+            "router_shed": int(self.shed.value(replica="router")),
+        }
 
 
 class ServeTelemetry:

@@ -20,7 +20,10 @@ Mechanics:
   ``us_*`` latencies, ``*sec_per_step``, and ``*_drift_ratio`` —
   the ISSUE 14 measured-vs-model exposed-comm drift, where a
   widening gap means the overlap model is losing touch with the
-  hardware and must fail the watch like any latency regression.
+  hardware and must fail the watch like any latency regression; the
+  ISSUE 19 ``fleet_capacity_drift_ratio`` — the capacity simulator's
+  predicted-vs-measured TTFT agreement — rides the same suffix, so a
+  simulator losing calibration fails the watch too.
   Lower-is-better is sound for this measured/model ratio because
   the model term is a pure function of the series' shape/knob
   context — constant WITHIN a comparability group — so the ratio

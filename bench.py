@@ -1536,6 +1536,200 @@ def _microbench_tp(rtt: float, on_tpu: bool):
     return out
 
 
+def _microbench_fleet(rtt: float, on_tpu: bool):
+    """Fleet front-door leg (ISSUE 19): prefix_affinity vs round_robin
+    over the SAME engine replicas (equal aggregate HBM by
+    construction — both arms route the identical skewed-prefix
+    workload across the identical page pools), plus the capacity
+    simulator's drift anchor.
+
+    Workload: ``replicas + 1`` distinct page-aligned prefixes (coprime
+    with the replica count, so round_robin cannot accidentally stripe
+    each prefix onto one replica) replayed over interleaved
+    submit/run waves — caches warm between waves, which is exactly
+    when affinity starts chasing cached pages and round_robin starts
+    duplicating them.  Each replica's pool holds TWO prefixes, never
+    all of them: the control arm thrashes, the affinity arm pins.
+
+    Stamps: ``fleet_affinity_hit_rate`` / ``fleet_round_robin_hit_rate``
+    and ``fleet_affinity_ttft_us`` / ``fleet_round_robin_ttft_us`` (the
+    A/B the acceptance gate reads), per-replica request/TTFT/routed
+    fields, the effective ``fleet_replicas``/``fleet_policy`` knobs,
+    and the capacity-sim block: ``fleet_capacity_pred_ttft_us`` vs
+    ``fleet_capacity_measured_ttft_us`` for a queued single-replica
+    calibration wave (profile self-measured from THIS leg's own serve
+    path, so the drift isolates the QUEUEING model, not dispatch
+    overhead), their ``fleet_capacity_drift_ratio`` (trended
+    lower-is-better by the watch), and the captures-priced sizing
+    answer ``fleet_capacity_replicas_needed`` with its provenance."""
+    import numpy as np
+
+    from apex_tpu.fleet import (CAPACITY_DRIFT_TOLERANCE, ServiceProfile,
+                                build_fleet, default_fleet_policy,
+                                drift_ratio, fleet_replicas_from_env,
+                                profile_from_captures, required_replicas,
+                                simulate)
+    from apex_tpu.inference import InferenceEngine, SlotScheduler
+    from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=8,
+                        num_attention_heads=16,
+                        max_seq_length=_ov("seq", 1024),
+                        hidden_dropout=0.0, attention_dropout=0.0,
+                        params_dtype=jnp.bfloat16)
+        slots, page_size = _ov("slots", 8), _ov("page_size", 64)
+        prefix_len, waves = _ov("prefix_len", 512), _ov("waves", 6)
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_attention_heads=4, max_seq_length=128,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        slots, page_size, prefix_len, waves = 2, 8, 64, 6
+    replicas = int(_ov("replicas", 0)) or fleet_replicas_from_env() or 2
+    n_prefix = replicas + 1
+    prompt_len = prefix_len + 2
+    pages_per_prefix = prefix_len // page_size
+    pages_per_req = -(-(prompt_len + 2) // page_size)
+    # two prefixes + a wave of tails per replica — NOT all n_prefix
+    # (the thrash-vs-pin contrast is the experiment)
+    num_pages = 2 * pages_per_prefix + slots + 4
+
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    model = gpt_model_provider(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jax.random.randint(jax.random.PRNGKey(0), (1, 8),
+                                           0, cfg.vocab_size))
+    engines = [InferenceEngine("gpt", cfg, params, slots=slots,
+                               max_seq=cfg.max_seq_length,
+                               page_size=page_size, num_pages=num_pages,
+                               spec_k=0)
+               for _ in range(replicas)]
+
+    vocab = cfg.vocab_size
+    prefixes = [list((np.arange(prefix_len, dtype=np.int64) * (t + 3)
+                      + t) % vocab) for t in range(n_prefix)]
+
+    def wave_prompts(w):
+        # rotate submission order each wave so round_robin's uid
+        # striping cannot phase-lock onto the prefix cycle
+        order = [(w + j) % n_prefix for j in range(n_prefix)]
+        return [prefixes[t] + [int((w * 7 + t) % vocab),
+                               int((w * 11 + t + 1) % vocab)]
+                for t in order]
+
+    # warm every executable both arms touch on EVERY replica engine, so
+    # neither measured arm pays a compile: a cold full-prompt bucket,
+    # then the SAME prefix with a fresh tail — the hit path's 2-token
+    # suffix prefill, exactly what the measured waves replay (tail
+    # tokens from the top of the vocab so no wave prompt collides)
+    for eng in engines:
+        wsched = SlotScheduler(eng,
+                               telemetry=ServeTelemetry(MetricsRegistry()))
+        for tail in ((vocab - 1, vocab - 2), (vocab - 3, vocab - 4)):
+            wsched.submit(prefixes[0] + list(tail), max_new_tokens=2)
+            wsched.run()
+
+    def run_arm(policy):
+        fleet = build_fleet(engines, policy=policy)
+        for w in range(waves):
+            for p in wave_prompts(w):
+                fleet.submit(p, max_new_tokens=2)
+            fleet.run()
+        assert fleet.conservation()["holds"]
+        return fleet
+
+    def arm_stats(fleet):
+        n_req = waves * n_prefix
+        hits = sum(int(r.telemetry.prefix_hits.total())
+                   for r in fleet.replicas)
+        cnt = sum(r.telemetry.ttft.count() for r in fleet.replicas)
+        tot = sum(r.telemetry.ttft.sum() for r in fleet.replicas)
+        return hits / max(n_req, 1), tot / max(cnt, 1) * 1e6
+
+    rr = run_arm("round_robin")
+    aff = run_arm("prefix_affinity")
+    rr_rate, rr_ttft = arm_stats(rr)
+    aff_rate, aff_ttft = arm_stats(aff)
+
+    out = {"fleet_replicas": replicas,
+           "fleet_policy": default_fleet_policy(),
+           "fleet_slots": slots, "fleet_page_size": page_size,
+           "fleet_pages_per_replica": num_pages,
+           "fleet_aggregate_pages": replicas * num_pages,
+           "fleet_waves": waves, "fleet_prefixes": n_prefix,
+           "fleet_round_robin_hit_rate": round(rr_rate, 4),
+           "fleet_affinity_hit_rate": round(aff_rate, 4),
+           "fleet_round_robin_ttft_us": round(rr_ttft, 1),
+           "fleet_affinity_ttft_us": round(aff_ttft, 1),
+           "fleet_affinity_hits": int(aff.telemetry.affinity_hits.total()),
+           "fleet_affinity_spills": int(
+               aff.telemetry.affinity_spills.total()),
+           "fleet_conservation_ok": int(rr.conservation()["holds"]
+                                        and aff.conservation()["holds"])}
+    for i, r in enumerate(aff.replicas):
+        c = r.telemetry.ttft.count()
+        out[f"fleet_replica{i}_requests"] = int(c)
+        out[f"fleet_replica{i}_ttft_us"] = round(
+            r.telemetry.ttft.sum() / max(c, 1) * 1e6, 1)
+        out[f"fleet_replica{i}_routed"] = int(
+            aff.telemetry.routed.value(replica=str(i)))
+
+    # capacity-sim drift anchor: a queued calibration wave through ONE
+    # replica with the prefix cache OFF (distinct prompts, pure
+    # admission queueing), predicted by a profile SELF-measured from a
+    # solo request on the same serve path — the residual drift is the
+    # discrete-event queueing model's own error, the thing
+    # CAPACITY_DRIFT_TOLERANCE bounds and the watch ratchets
+    sim_slots = max(1, min(slots, num_pages // pages_per_req))
+    n_cal = 2 * sim_slots
+
+    def cal_prompt(i):
+        return list((np.arange(prompt_len, dtype=np.int64) * (2 * i + 3)
+                     + 7 * i + 1) % vocab)
+
+    tel_one = ServeTelemetry(MetricsRegistry())
+    solo = SlotScheduler(engines[0], telemetry=tel_one,
+                         prefix_cache=False)
+    solo.submit(cal_prompt(0), max_new_tokens=2)
+    solo.run()
+    solo_ttft_us = tel_one.ttft.sum() / max(tel_one.ttft.count(), 1) * 1e6
+    dec_us = max(tel_one.summary()["decode_token_mean_s"] * 1e6, 1e-3)
+    prof_self = ServiceProfile(solo_ttft_us / prompt_len, dec_us,
+                               "measured:fleet_leg:self")
+    tel_cal = ServeTelemetry(MetricsRegistry())
+    cal = SlotScheduler(engines[0], telemetry=tel_cal,
+                        prefix_cache=False)
+    for i in range(n_cal):
+        cal.submit(cal_prompt(i + 1), max_new_tokens=2)
+    cal.run()
+    meas_us = tel_cal.ttft.sum() / max(tel_cal.ttft.count(), 1) * 1e6
+    pred = simulate(prof_self, replicas=1, slots=sim_slots,
+                    n_requests=n_cal, interarrival_us=0.0,
+                    prompt_tokens=prompt_len, decode_tokens=2)
+    out["fleet_capacity_pred_ttft_us"] = round(pred["ttft_p50_us"], 1)
+    out["fleet_capacity_measured_ttft_us"] = round(meas_us, 1)
+    ratio = drift_ratio(pred["ttft_p50_us"], meas_us)
+    if ratio is not None:
+        out["fleet_capacity_drift_ratio"] = round(ratio, 3)
+    out["fleet_capacity_drift_tolerance"] = CAPACITY_DRIFT_TOLERANCE
+
+    # the sizing answer, priced from COMMITTED measured captures (the
+    # provenance says which — or that none qualified; never fabricated)
+    prof_cap = profile_from_captures()
+    req = required_replicas(
+        prof_cap, slots=sim_slots,
+        slo_ttft_us=float(_ov("capacity_slo_us", 20000.0)),
+        n_requests=128, interarrival_us=1000.0,
+        prompt_tokens=prompt_len, decode_tokens=2, seed=19)
+    out["fleet_capacity_provenance"] = req["provenance"]
+    out["fleet_capacity_replicas_needed"] = (
+        req["replicas"] if req["replicas"] is not None else -1)
+    return out
+
+
 MICRO_LEGS = {
     "adam": _microbench_adam,
     "ln": _microbench_layernorm,
@@ -1547,6 +1741,7 @@ MICRO_LEGS = {
     "llama": _microbench_llama,
     "infer": _microbench_infer,
     "tp": _microbench_tp,
+    "fleet": _microbench_fleet,
 }
 
 

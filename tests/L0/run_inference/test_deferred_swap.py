@@ -1,0 +1,218 @@
+"""Deferred host-tier eviction drains (ISSUE 19 satellite).
+
+Eviction-side device->host page copies no longer stall the admission
+path: ``swap_out_pages(defer=True)`` dispatches the batched gathers
+into fresh output buffers and hands back a :class:`PendingSwapOut`;
+the blocking ``device_get``\\ s run at the next wave boundary (the
+scheduler's ``drain_pending_swaps``) or lazily on the first host-tier
+hit against one of the parked handles — whichever comes first.
+
+Pinned here:
+
+1. ``HostPageStore.put_deferred`` books bytes EAGERLY and stays as
+   strict as an eager ``put`` (over-budget raises, nothing parked).
+2. ``get``/``pop`` on a deferred handle force resolution exactly once
+   (the placeholder is replaced by the materialized slabs).
+3. ``PendingSwapOut.resolve`` is idempotent: one fetch, the device
+   batches are freed, every later call returns the cached slabs.
+4. ``swap_out_pages(defer=True)`` returns byte-identical slabs to the
+   eager path — deferral changes WHEN the copy lands, never WHAT.
+5. The scheduler drains every pending batch at the wave boundary and
+   the tier books (allocator conservation, host mirror) balance
+   through an evict -> hit round trip that rides the deferred path.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from apex_tpu.inference import InferenceEngine, SlotScheduler
+from apex_tpu.inference.engine import PendingSwapOut
+from apex_tpu.inference.kv_cache import HostPageStore, _DeferredSlab
+from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+PREFIX = [int(t) for t in (np.arange(16) * 5 + 2) % 64]
+
+
+class _FakePending:
+    """Stands in for PendingSwapOut in the pure-store tests: resolves
+    to deterministic per-row slabs and counts forced resolutions."""
+
+    def __init__(self, n, row_shape=(1, 2, 8, 4)):
+        self.calls = 0
+        self._n, self._row = n, row_shape
+        self._cached = None
+
+    def resolve(self):
+        self.calls += 1
+        if self._cached is None:
+            size = int(np.prod(self._row))
+            k = np.arange(self._n * size, dtype=np.float32).reshape(
+                (self._n,) + self._row)
+            self._cached = (k, k + 1000.0)
+        return self._cached
+
+
+def _store(pages=4, page_bytes=256):
+    return HostPageStore(capacity_bytes=pages * page_bytes,
+                         page_bytes=page_bytes)
+
+
+def test_put_deferred_books_bytes_eagerly_and_strictly():
+    store = _store(pages=4)
+    pending = _FakePending(3)
+    handles = store.put_deferred(3, pending)
+    assert len(handles) == 3
+    # bytes booked the moment the placeholders park — the drain WILL
+    # land, so the budget must not discover it late
+    assert store.pages == 3
+    assert store.bytes_used == 3 * store.page_bytes
+    assert pending.calls == 0
+    # strict like put(): one more page fits, two do not — and the
+    # over-budget attempt parks NOTHING (no partial booking)
+    with pytest.raises(ValueError):
+        store.put_deferred(2, _FakePending(2))
+    assert store.pages == 3
+    store.put_deferred(1, _FakePending(1))
+    assert not store.fits(1)
+
+
+def test_get_materializes_lazily_exactly_once():
+    store = _store()
+    pending = _FakePending(2)
+    h0, h1 = store.put_deferred(2, pending)
+    assert pending.calls == 0
+    k, v = store.get(h1)
+    assert pending.calls == 1
+    # index selects this page's row out of the stacked batch
+    want_k, want_v = pending.resolve()
+    np.testing.assert_array_equal(k, want_k[1])
+    np.testing.assert_array_equal(v, want_v[1])
+    # the placeholder was REPLACED by the materialized slabs: a second
+    # get serves the copy without touching the pending drain
+    assert not isinstance(store._slabs[h1], _DeferredSlab)
+    calls_before = pending.calls
+    k2, _ = store.get(h1)
+    assert pending.calls == calls_before
+    np.testing.assert_array_equal(k2, k)
+
+
+def test_pop_materializes_and_releases_bytes():
+    store = _store()
+    pending = _FakePending(1)
+    (h,) = store.put_deferred(1, pending)
+    k, v = store.pop(h)
+    assert pending.calls >= 1
+    assert k.shape[0] == 1 or k.ndim >= 1
+    assert store.pages == 0
+    assert store.bytes_used == 0
+    assert store.pop(h) is None
+
+
+def test_pending_swap_out_resolve_is_idempotent_and_frees_batches():
+    k1 = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+    v1 = k1 + 100.0
+    k2 = k1 + 200.0
+    p = PendingSwapOut([(k1, v1, 2), (k2, v1, 3)])
+    k, v = p.resolve()
+    # valid-row trim then concat: 2 + 3 rows
+    assert k.shape == (5, 4) and v.shape == (5, 4)
+    np.testing.assert_array_equal(k[:2], np.asarray(k1)[:2])
+    np.testing.assert_array_equal(k[2:], np.asarray(k2)[:3])
+    # idempotent: the device batches are freed, the fetched slabs are
+    # cached — every later resolve returns the SAME objects
+    assert p._batches is None
+    assert p.resolve() is p.resolve()
+    assert p.resolve()[0] is k
+
+
+def _sched():
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_attention_heads=2, max_seq_length=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = gpt_model_provider(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    eng = InferenceEngine("gpt", cfg, params, slots=2, max_seq=64,
+                          page_size=8, num_pages=16,
+                          host_tier_bytes=1 << 20)
+    return SlotScheduler(eng,
+                         telemetry=ServeTelemetry(MetricsRegistry()))
+
+
+def test_deferred_swap_out_matches_eager_bit_for_bit():
+    sched = _sched()
+    sched.submit(PREFIX + [1, 2], max_new_tokens=3)
+    sched.run()
+    ids = [0, 1, 2]
+    k_e, v_e = sched.engine.swap_out_pages(sched.cache, ids)
+    pending = sched.engine.swap_out_pages(sched.cache, ids, defer=True)
+    assert isinstance(pending, PendingSwapOut)
+    k_d, v_d = pending.resolve()
+    np.testing.assert_array_equal(k_d, k_e)
+    np.testing.assert_array_equal(v_d, v_e)
+
+
+def test_scheduler_drains_pending_swaps_at_wave_boundary():
+    sched = _sched()
+    eng = sched.engine
+    sched.submit(PREFIX + [1, 2], max_new_tokens=3)
+    sched.run()
+    # run() ends on a drained boundary
+    assert sched._pending_swaps == []
+
+    # evict to host: the offload dispatches but does NOT fetch — the
+    # store holds deferred placeholders, the scheduler a pending batch
+    assert sched.prefix.evict_lru(eng.num_pages) > 0
+    assert len(sched._pending_swaps) >= 1
+    assert sched.host_store.pages > 0
+    assert any(isinstance(s, _DeferredSlab)
+               for s in sched.host_store._slabs.values())
+
+    # wave boundary forces the stragglers, exactly once
+    forced = sched.drain_pending_swaps()
+    assert forced >= 1
+    assert sched._pending_swaps == []
+    assert sched.drain_pending_swaps() == 0
+
+    # a hit against the swapped-out prefix rides the deferred slabs
+    # through swap-in and the books still balance
+    sched.submit(PREFIX + [9], max_new_tokens=3)
+    out = sched.run()
+    assert all(len(v) == 3 for v in out.values())
+    assert sched._pending_swaps == []
+    tel = sched.telemetry
+    assert int(tel.swap_out_pages.total()) >= 2
+    assert int(tel.swap_in_pages.total()) >= 2
+    al = sched.alloc
+    assert al.live_pages + al.free_pages == al.num_pages
+    assert sched.prefix.host_pages == sched.host_store.pages
+
+
+def test_hit_before_drain_resolves_lazily_and_boundary_catches_rest():
+    sched = _sched()
+    eng = sched.engine
+    sched.submit(PREFIX + [1, 2], max_new_tokens=3)
+    sched.run()
+    assert sched.prefix.evict_lru(eng.num_pages) > 0
+    assert len(sched._pending_swaps) >= 1
+    # the hit wave swaps the prefix back in BEFORE any explicit drain:
+    # the host store materializes the placeholders lazily, and the
+    # wave boundary clears the (already-resolved) pending list
+    sched.submit(PREFIX + [9], max_new_tokens=3)
+    out = sched.run()
+    assert all(len(v) == 3 for v in out.values())
+    assert sched._pending_swaps == []
+    assert int(sched.telemetry.prefix_host_hits.total()) >= 1
+    al = sched.alloc
+    assert al.live_pages + al.free_pages == al.num_pages

@@ -1,0 +1,317 @@
+"""Fleet front door (ISSUE 19): routing policies, the read-only radix
+peek, swap-aware admission cost ordering, the router-level
+conservation law, the env knob readers, and the discrete-event
+capacity simulator's determinism/monotonicity/provenance contracts.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fleet import (CAPACITY_DRIFT_TOLERANCE, FleetRouter,
+                            POLICIES, ServiceProfile, build_fleet,
+                            default_fleet_policy, drift_ratio,
+                            fleet_replicas_from_env,
+                            profile_from_captures, required_replicas,
+                            simulate)
+from apex_tpu.inference import InferenceEngine, SlotScheduler
+from apex_tpu.inference.scheduler import HOST_HIT_TOKEN_COST
+from apex_tpu.observability import MetricsRegistry, ServeTelemetry
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.testing import GPTConfig, gpt_model_provider
+
+
+def _engine(host_tier_bytes=0, num_pages=16):
+    parallel_state.destroy_model_parallel()
+    parallel_state.initialize_model_parallel(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_attention_heads=2, max_seq_length=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = gpt_model_provider(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))
+    return InferenceEngine("gpt", cfg, params, slots=2, max_seq=64,
+                           page_size=8, num_pages=num_pages,
+                           host_tier_bytes=host_tier_bytes)
+
+
+PREFIX = [int(t) for t in (np.arange(16) * 5 + 2) % 64]
+
+
+# --------------------------------------------------------------------------
+# the read-only peek
+# --------------------------------------------------------------------------
+
+def test_peek_match_is_read_only():
+    """peek_match reports the same coverage as match_tiered WITHOUT
+    ticking the LRU clock or touching stamps — the affinity router
+    probes every replica per request, and N probes must not perturb
+    which edge the next eviction picks."""
+    eng = _engine()
+    sched = SlotScheduler(eng,
+                          telemetry=ServeTelemetry(MetricsRegistry()))
+    sched.submit(PREFIX + [1, 2], max_new_tokens=2)
+    sched.run()
+    pc = sched.prefix
+    clock0 = pc._clock
+    covered, hbm, host = pc.peek_match(PREFIX + [1, 2])
+    for _ in range(10):
+        assert pc.peek_match(PREFIX + [1, 2]) == (covered, hbm, host)
+    assert pc._clock == clock0
+    assert covered >= 16 and hbm >= 2 and host == 0
+    # a miss below min_hit_tokens is the (0, 0, 0) triple
+    assert pc.peek_match([63, 62, 61]) == (0, 0, 0)
+
+
+def test_admission_cost_ordering_hbm_host_cold():
+    """The satellite's pinned ordering: full-HBM hit < host-tier hit
+    < cold, always — the host tier discounts recompute but the swap-in
+    upload is not free (HOST_HIT_TOKEN_COST per covered host token)."""
+    eng = _engine(host_tier_bytes=1 << 20)
+    sched = SlotScheduler(eng,
+                          telemetry=ServeTelemetry(MetricsRegistry()))
+    prompt = PREFIX + [1, 2]
+    sched.submit(prompt, max_new_tokens=2)
+    sched.run()
+    cold_prompt = [int(t) for t in (np.arange(16) * 7 + 3) % 64] + [1, 2]
+    cost_hbm = sched.admission_cost(prompt)
+    cost_cold = sched.admission_cost(cold_prompt)
+    # evict the prefix to the host tier: same coverage, discounted
+    sched.prefix.evict_lru(eng.num_pages)
+    sched.drain_pending_swaps()
+    assert sched.host_store.pages > 0
+    cost_host = sched.admission_cost(prompt)
+    assert cost_hbm < cost_host < cost_cold
+    # the arithmetic, not just the ordering: eviction offloads the two
+    # FULL prefix pages (16 tokens) and discards the partial tail, so
+    # the host hit pays the uncovered tail at full price plus the
+    # swap-in discount on every host-covered token
+    assert cost_cold == pytest.approx(float(len(cold_prompt)))
+    assert cost_host == pytest.approx(
+        float(len(prompt) - 16) + HOST_HIT_TOKEN_COST * 16)
+
+
+def test_admission_cost_without_prefix_cache_is_full_price():
+    eng = _engine()
+    sched = SlotScheduler(eng,
+                          telemetry=ServeTelemetry(MetricsRegistry()),
+                          prefix_cache=False)
+    assert sched.admission_cost(PREFIX) == pytest.approx(16.0)
+
+
+# --------------------------------------------------------------------------
+# routing policies
+# --------------------------------------------------------------------------
+
+def test_round_robin_stripes_uids():
+    fleet = build_fleet([_engine(), _engine(), _engine()],
+                        policy="round_robin")
+    for i in range(6):
+        uid = fleet.submit(PREFIX + [i, i + 1], max_new_tokens=2)
+        assert fleet.placements[uid][0] == i % 3
+    fleet.run()
+    assert fleet.conservation()["holds"]
+
+
+def test_least_loaded_prefers_empty_queue():
+    fleet = build_fleet([_engine(), _engine()], policy="least_loaded")
+    # preload replica 0's queue directly (no run yet)
+    fleet.replicas[0].submit(PREFIX + [9, 9], max_new_tokens=2)
+    uid = fleet.submit(PREFIX + [1, 2], max_new_tokens=2)
+    assert fleet.placements[uid][0] == 1
+
+
+def test_prefix_affinity_chases_cached_pages():
+    """After one seeding wave, every later request sharing the prefix
+    routes to the replica whose radix tree holds it — with counters
+    and route_decision events to show for it."""
+    fleet = build_fleet([_engine(), _engine()],
+                        policy="prefix_affinity")
+    events = []
+    fleet.telemetry.registry.add_sink(
+        type("S", (), {"event": lambda self, e: events.append(e),
+                       "export": lambda self, fams: None})())
+    seed = fleet.submit(PREFIX + [1, 2], max_new_tokens=2)
+    fleet.run()
+    home = fleet.placements[seed][0]
+    for i in range(3, 9, 2):
+        uid = fleet.submit(PREFIX + [i, i + 1], max_new_tokens=2)
+        assert fleet.placements[uid][0] == home
+        fleet.run()
+    assert int(fleet.telemetry.affinity_hits.total()) >= 3
+    assert int(fleet.telemetry.routed_prefix_tokens.total(
+        )) >= 3 * 16
+    routes = [e for e in events if e["kind"] == "route_decision"]
+    assert routes and all(r["policy"] == "prefix_affinity"
+                          for r in routes)
+    assert fleet.conservation()["holds"]
+
+
+def test_affinity_spills_off_deep_queue():
+    """The load-aware spill threshold: a preferred replica with a deep
+    queue loses the request to the least-loaded one (counted)."""
+    fleet = build_fleet([_engine(), _engine()],
+                        policy="prefix_affinity", spill_queue_depth=1)
+    seed = fleet.submit(PREFIX + [1, 2], max_new_tokens=2)
+    fleet.run()
+    home = fleet.placements[seed][0]
+    # queue one request onto the home replica WITHOUT running, then a
+    # prefix-sharing request must spill to the other replica
+    fleet.replicas[home].submit(PREFIX + [40, 41], max_new_tokens=2)
+    uid = fleet.submit(PREFIX + [3, 4], max_new_tokens=2)
+    assert fleet.placements[uid][0] != home
+    assert int(fleet.telemetry.affinity_spills.total()) == 1
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError):
+        FleetRouter([SlotScheduler(
+            _engine(), telemetry=ServeTelemetry(MetricsRegistry()))],
+            policy="hash_ring")
+    with pytest.raises(ValueError):
+        build_fleet([])
+
+
+# --------------------------------------------------------------------------
+# env knobs
+# --------------------------------------------------------------------------
+
+def test_fleet_env_knob_readers(monkeypatch):
+    monkeypatch.delenv("APEX_TPU_FLEET_REPLICAS", raising=False)
+    monkeypatch.delenv("APEX_TPU_FLEET_POLICY", raising=False)
+    assert fleet_replicas_from_env() == 0
+    assert default_fleet_policy() == "prefix_affinity"
+    monkeypatch.setenv("APEX_TPU_FLEET_REPLICAS", "4")
+    monkeypatch.setenv("APEX_TPU_FLEET_POLICY", "least_loaded")
+    assert fleet_replicas_from_env() == 4
+    assert default_fleet_policy() == "least_loaded"
+    monkeypatch.setenv("APEX_TPU_FLEET_REPLICAS", "-1")
+    with pytest.raises(ValueError):
+        fleet_replicas_from_env()
+    monkeypatch.setenv("APEX_TPU_FLEET_REPLICAS", "two")
+    with pytest.raises(ValueError):
+        fleet_replicas_from_env()
+    monkeypatch.setenv("APEX_TPU_FLEET_POLICY", "hash_ring")
+    with pytest.raises(ValueError):
+        default_fleet_policy()
+    for p in POLICIES:
+        monkeypatch.setenv("APEX_TPU_FLEET_POLICY", p)
+        assert default_fleet_policy() == p
+
+
+# --------------------------------------------------------------------------
+# capacity simulator
+# --------------------------------------------------------------------------
+
+PROF = ServiceProfile(10.0, 100.0, "measured:test")
+
+
+def test_simulate_is_deterministic():
+    kw = dict(replicas=2, slots=2, n_requests=64,
+              interarrival_us=500.0, prompt_tokens=32,
+              decode_tokens=8, seed=7)
+    assert simulate(PROF, **kw) == simulate(PROF, **kw)
+    # fixed-spacing arrivals (seed None) are deterministic too
+    kw["seed"] = None
+    assert simulate(PROF, **kw) == simulate(PROF, **kw)
+
+
+def test_more_replicas_never_hurt_ttft():
+    """Monotonicity: each added replica only removes queue wait."""
+    prev = None
+    for n in (1, 2, 4, 8):
+        r = simulate(PROF, replicas=n, slots=2, n_requests=128,
+                     interarrival_us=100.0, prompt_tokens=64,
+                     decode_tokens=16, seed=3)
+        if prev is not None:
+            assert r["ttft_p99_us"] <= prev + 1e-9
+        prev = r["ttft_p99_us"]
+    # the floor is pure prefill: no queue can make TTFT beat it
+    assert prev >= 64 * PROF.prefill_us_per_token - 1e-9
+
+
+def test_required_replicas_meets_slo_and_degrades():
+    ans = required_replicas(PROF, slots=2, slo_ttft_us=2000.0,
+                            n_requests=128, interarrival_us=100.0,
+                            prompt_tokens=64, decode_tokens=16, seed=3)
+    n = ans["replicas"]
+    assert n is not None and ans["ttft_p99_us"] <= 2000.0
+    if n > 1:
+        under = simulate(PROF, replicas=n - 1, slots=2, n_requests=128,
+                         interarrival_us=100.0, prompt_tokens=64,
+                         decode_tokens=16, seed=3)
+        assert under["ttft_p99_us"] > 2000.0
+    # an unmeetable SLO (below one request's own prefill) answers None
+    floor = 64 * PROF.prefill_us_per_token
+    assert required_replicas(PROF, slots=2, slo_ttft_us=floor / 2,
+                             prompt_tokens=64)["replicas"] is None
+
+
+def test_unavailable_profile_refuses_to_price(tmp_path):
+    prof = profile_from_captures(tmp_path)        # no captures at all
+    assert not prof.available
+    assert prof.provenance == "unavailable:no_measured_captures"
+    sim = simulate(prof, replicas=2, slots=2)
+    assert sim["ttft_p99_us"] is None
+    assert sim["provenance"].startswith("unavailable:")
+    assert required_replicas(prof, slots=2,
+                             slo_ttft_us=1.0)["replicas"] is None
+
+
+def test_profile_from_captures_newest_round_wins(tmp_path):
+    (tmp_path / "r3_old.json").write_text(json.dumps(
+        {"infer_prefill_tokens_per_s": 1e5,
+         "infer_decode_token_us": 50.0}))
+    (tmp_path / "r7_new.json").write_text(json.dumps(
+        {"infer_prefill_tokens_per_s": 2e5,
+         "infer_decode_token_us": 25.0, "backend": "cpu"}))
+    (tmp_path / "r9_partial.json").write_text(json.dumps(
+        {"infer_decode_token_us": 10.0}))       # missing prefill: skip
+    (tmp_path / "notes.txt").write_text("not a capture")
+    prof = profile_from_captures(tmp_path)
+    assert prof.provenance == "measured:r7_new.json:cpu"
+    assert prof.prefill_us_per_token == pytest.approx(5.0)
+    assert prof.decode_us_per_token == pytest.approx(25.0)
+
+
+def test_drift_ratio_symmetric_and_null_safe():
+    assert drift_ratio(100.0, 200.0) == pytest.approx(2.0)
+    assert drift_ratio(200.0, 100.0) == pytest.approx(2.0)
+    assert drift_ratio(None, 100.0) is None
+    assert drift_ratio(100.0, None) is None
+    assert drift_ratio(0.0, 100.0) is None
+    assert drift_ratio(100.0, -1.0) is None
+    assert CAPACITY_DRIFT_TOLERANCE >= 1.0
+
+
+def test_bad_sim_shapes_rejected():
+    with pytest.raises(ValueError):
+        simulate(PROF, replicas=0, slots=2)
+    with pytest.raises(ValueError):
+        simulate(PROF, replicas=2, slots=0)
+
+
+# --------------------------------------------------------------------------
+# hygiene/watch ride-alongs (ISSUE 19 satellite)
+# --------------------------------------------------------------------------
+
+def test_fleet_capture_fields_ride_existing_rules():
+    """The fleet leg's stamps need no new hygiene or watch rules: the
+    per-replica/policy TTFTs are ``*_us`` latencies, and the capacity
+    agreement ratio trends lower-is-better by its ``_drift_ratio``
+    suffix — pinned here so a rename breaks loudly."""
+    from apex_tpu.observability.capture_hygiene import is_us_key
+    from apex_tpu.observability.watch import metric_direction
+    for key in ("fleet_affinity_ttft_us", "fleet_round_robin_ttft_us",
+                "fleet_replica0_ttft_us", "fleet_capacity_pred_ttft_us",
+                "fleet_capacity_measured_ttft_us"):
+        assert is_us_key(key), key
+        assert metric_direction(key) == "lower", key
+    assert metric_direction("fleet_capacity_drift_ratio") == "lower"
+    # knob/context stamps must NOT read as measurements
+    for key in ("fleet_replicas", "fleet_policy", "fleet_slots",
+                "fleet_capacity_replicas_needed",
+                "fleet_capacity_provenance"):
+        assert metric_direction(key) is None, key

@@ -375,12 +375,16 @@ def test_swap_batch_dispatch_counts_and_page_host_bytes():
     assert eng.page_host_bytes() == 2 * 1 * 2 * 8 * 16 * 4
     cache = eng.init_cache()
     ids = list(range(5))                          # 5 pages, batch 2
+    # the dispatch counters live on the GLOBAL registry: measure the
+    # deltas so earlier swap traffic in the process cannot skew them
+    out0 = int(eng._swap_out_dispatches.total())
+    in0 = int(eng._swap_in_dispatches.total())
     k, v = eng.swap_out_pages(cache, ids)
     assert k.shape == (5, 1, 2, 8, 16)
     reg = eng._swap_out_dispatches
-    assert int(reg.total()) == 3                  # ceil(5/2) batches
+    assert int(reg.total()) - out0 == 3           # ceil(5/2) batches
     cache = eng.swap_in_pages(cache, ids, k, v)
-    assert int(eng._swap_in_dispatches.total()) == 3
+    assert int(eng._swap_in_dispatches.total()) - in0 == 3
 
 
 @pytest.mark.parametrize("tp", [None, 2])

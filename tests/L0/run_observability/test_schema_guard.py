@@ -85,6 +85,26 @@ def test_tier_families_are_pinned():
     assert "page_swap" in schema.EVENT_FIELDS
 
 
+def test_fleet_families_are_pinned():
+    """ISSUE 19 satellite: the committed schema re-pin covers every
+    fleet-router family FleetTelemetry emits, plus the route_decision
+    event — a new fleet family cannot ship unpinned (the
+    TIER_METRIC_FAMILIES pattern)."""
+    from apex_tpu.observability import serve
+    committed = json.loads((REPO / schema.SCHEMA_NAME).read_text())
+    for fam in serve.FLEET_METRIC_FAMILIES:
+        assert fam in committed["prometheus"], fam
+        assert fam in schema.METRIC_SPECS, fam
+    assert "route_decision" in committed["jsonl"]["events"]
+    assert "route_decision" in schema.EVENT_FIELDS
+    # the per-replica families carry the replica label dashboards
+    # group by
+    for fam in ("fleet_requests_routed_total",
+                "fleet_requests_shed_total",
+                "fleet_replica_queue_depth"):
+        assert "replica" in schema.METRIC_SPECS[fam].labels, fam
+
+
 def test_measured_attribution_families_are_pinned():
     """ISSUE 14 satellite: the committed schema re-pin covers every
     family and event the trace-ingestion/attribution layer emits — a
