@@ -1,6 +1,6 @@
 """apex_tpu.analysis — JAX-aware static analysis.
 
-Four engines (see README "Static analysis"):
+Five engines (see README "Static analysis"):
 
 * :mod:`~apex_tpu.analysis.lint` — AST rules over the whole package
   (host syncs under jit, PRNG key reuse, traced Python branching,
@@ -25,17 +25,25 @@ Four engines (see README "Static analysis"):
   grid/shape divisibility, index-map discipline) and the
   ``.analysis_kernel_budget.json`` ledger ratchet; also the
   fused-decode envelope model behind ``--mesh tp=N``.
+* :mod:`~apex_tpu.analysis.protocol_audit` — bounded exhaustive model
+  checking of the serving control plane: drives the real
+  allocator/prefix-cache/host-tier/scheduler/router classes through a
+  device-free stub engine (:mod:`~apex_tpu.analysis.protocol_model`)
+  over tiny committed scopes, asserting conservation/content/lifecycle
+  invariants (APX401–APX407) at every canonical state, with minimized
+  replayable counterexamples and the ``.analysis_protocol.json``
+  state-space pin.
 
 CLI: ``python -m apex_tpu.analysis`` or the ``apex-tpu-analyze`` entry
-point (``--spmd`` adds the third engine, ``--kernels`` the fourth);
-findings are gated by ``.analysis_baseline.json`` so only NEW
-violations fail the run.
+point (``--spmd`` adds the third engine, ``--kernels`` the fourth,
+``--protocol`` the fifth); findings are gated by
+``.analysis_baseline.json`` so only NEW violations fail the run.
 """
 from apex_tpu.analysis.finding import Finding
 from apex_tpu.analysis.lint import lint_paths, lint_source
 
 __all__ = ["Finding", "lint_paths", "lint_source", "run_jaxpr_audit",
-           "run_spmd_audit", "run_kernel_audit"]
+           "run_spmd_audit", "run_kernel_audit", "run_protocol_audit"]
 
 
 def run_kernel_audit(*args, **kwargs):
@@ -53,4 +61,11 @@ def run_jaxpr_audit(*args, **kwargs):
 def run_spmd_audit(*args, **kwargs):
     """Lazy proxy — the SPMD auditor imports jax and binds meshes."""
     from apex_tpu.analysis.spmd_audit import run_spmd_audit as _run
+    return _run(*args, **kwargs)
+
+
+def run_protocol_audit(*args, **kwargs):
+    """Lazy proxy — the protocol auditor imports the inference stack."""
+    from apex_tpu.analysis.protocol_audit import run_protocol_audit \
+        as _run
     return _run(*args, **kwargs)

@@ -10,16 +10,25 @@ peak-live estimate GROWS).  ``--kernels`` adds the Pallas kernel VMEM
 auditor + the kernel budget ledger, ratcheted the same way against
 ``.analysis_kernel_budget.json`` (exit nonzero only when a kernel's
 modeled VMEM footprint grows or a kernel is unbudgeted).
+``--protocol`` adds the serving control-plane protocol auditor:
+exhaustive small-scope model checking of the allocator/prefix-cache/
+host-tier/scheduler/router state machines, pinned against
+``.analysis_protocol.json`` (exit nonzero on an invariant violation —
+with a minimized replayable counterexample — or when a scope's
+canonical state space drifts from the pin).
 
     apex-tpu-analyze                       # lint + jaxpr audit, baseline-gated
     apex-tpu-analyze --spmd                # + SPMD audit, budget-gated
     apex-tpu-analyze --spmd --json         # machine-readable (schema: README)
     apex-tpu-analyze --kernels             # + Pallas VMEM audit, budget-gated
     apex-tpu-analyze --kernels --mesh tp=2 # + 1/tp-sharded fused-decode envelope
+    apex-tpu-analyze --protocol            # + protocol audit, pin-gated
+    apex-tpu-analyze --protocol --protocol-scope fleet   # one scope only
     apex-tpu-analyze path/ other.py        # restrict lint to paths
     apex-tpu-analyze --write-baseline      # re-pin current findings
     apex-tpu-analyze --spmd --write-budget # re-pin the comm/HBM ledger
     apex-tpu-analyze --kernels --write-budget  # re-pin the kernel VMEM ledger
+    apex-tpu-analyze --protocol --write-protocol  # re-pin the protocol ledger
     apex-tpu-analyze --no-baseline         # show everything, exit 1 if any
     apex-tpu-analyze --list-rules
 """
@@ -122,6 +131,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-budget", action="store_true",
                    help="pin the current ledger(s) as the new budget "
                         "(implies --spmd when --kernels is absent)")
+    p.add_argument("--protocol", action="store_true",
+                   help="run the serving control-plane protocol "
+                        "auditor: exhaustive small-scope model "
+                        "checking of the allocator/prefix-cache/"
+                        "host-tier/scheduler/router state machines, "
+                        "pinned against .analysis_protocol.json")
+    p.add_argument("--protocol-scope", default=None,
+                   help="comma-separated protocol scope names to "
+                        "explore (default: APEX_TPU_PROTOCOL_SCOPE, "
+                        "else all committed scopes)")
+    p.add_argument("--protocol-pin", type=Path, default=None,
+                   help="protocol pin file (default: "
+                        "<root>/.analysis_protocol.json)")
+    p.add_argument("--write-protocol", action="store_true",
+                   help="pin the current protocol exploration "
+                        "(scope configs + canonical state-space "
+                        "sizes) as the new .analysis_protocol.json")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true")
@@ -179,6 +205,14 @@ def main(argv: Optional[list] = None) -> int:
               "index map captures a traced value")
         print("APX305 unbudgeted-kernel           pallas audit: reachable "
               "Pallas kernel has no kernel-budget entry")
+        print("APX400 protocol-audit-drift        protocol audit: "
+              "exploration crashed/truncated, pin missing, or a "
+              "scope's canonical state space drifted from "
+              ".analysis_protocol.json")
+        from apex_tpu.analysis.protocol_audit import INVARIANTS
+        for code, inv in INVARIANTS.items():
+            print(f"{code} {inv['name']:<30} protocol audit: "
+                  f"{inv['description']}")
         return 0
 
     # arg-syntax validation happens before ANY engine runs or file is
@@ -286,6 +320,55 @@ def main(argv: Optional[list] = None) -> int:
             findings.extend(
                 compare_kernel_budget(kernel_report, committed))
 
+    protocol_report = None
+    if args.write_protocol:
+        args.protocol = True
+    if args.protocol:
+        from apex_tpu.analysis.protocol_audit import (
+            PIN_NAME as PROTOCOL_PIN_NAME, compare_protocol,
+            protocol_scope_env, run_protocol_audit)
+        raw = args.protocol_scope
+        scopes = ([s.strip() for s in raw.split(",") if s.strip()]
+                  if raw else protocol_scope_env())
+        pin_path = args.protocol_pin or (root / PROTOCOL_PIN_NAME)
+        if args.write_protocol and scopes is not None \
+                and args.protocol_pin is None:
+            # validated BEFORE exploring: a scope-restricted pin would
+            # silently drop every other scope's proof obligation —
+            # same protection as the budget/baseline writers
+            print("apex-tpu-analyze: refusing --write-protocol for a "
+                  "restricted --protocol-scope run targeting the "
+                  f"shared {PROTOCOL_PIN_NAME}; pass --protocol-pin "
+                  "<file> or run all scopes", file=sys.stderr)
+            return 2
+        try:
+            proto_findings, protocol_report = run_protocol_audit(
+                scopes, repro_dir=root)
+        except ValueError as e:     # unknown --protocol-scope names
+            print(f"apex-tpu-analyze: {e}", file=sys.stderr)
+            return 2
+        findings.extend(proto_findings)
+        if args.write_protocol:
+            if proto_findings:
+                print("apex-tpu-analyze: refusing --write-protocol "
+                      "with protocol findings outstanding — a pin "
+                      "must certify a violation-free exploration",
+                      file=sys.stderr)
+                return 1
+            pin_path.write_text(
+                json.dumps(protocol_report, indent=1, sort_keys=True)
+                + "\n", encoding="utf-8")
+            print(f"protocol pin written: {pin_path} "
+                  f"({len(protocol_report['scopes'])} scope(s) "
+                  f"pinned)",
+                  file=sys.stderr if args.as_json else sys.stdout)
+        else:
+            committed = (json.loads(pin_path.read_text(
+                encoding="utf-8")) if pin_path.is_file() else None)
+            findings.extend(compare_protocol(
+                protocol_report, committed, full=scopes is None))
+
+    if args.kernels:
         if mesh_tp is not None:
             tp = mesh_tp
             mesh_report = {
@@ -339,6 +422,8 @@ def main(argv: Optional[list] = None) -> int:
             out["kernel_budget"] = kernel_report
         if mesh_report is not None:
             out["mesh"] = mesh_report
+        if protocol_report is not None:
+            out["protocol"] = protocol_report
         print(json.dumps(out, indent=1))
     else:
         if not args.quiet:

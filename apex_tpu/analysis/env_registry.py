@@ -331,6 +331,15 @@ KNOBS: Dict[str, EnvKnob] = {k.name: k for k in [
                "bench captures); per-call override: "
                "paged_decode_attention(xla_max_pages=...)",
         read_by="apex_tpu/ops/paged_attention.py"),
+    EnvKnob(
+        name="APEX_TPU_PROTOCOL_SCOPE",
+        default="0",
+        effect="comma-separated scope names `apex-tpu-analyze "
+               "--protocol` restricts the protocol audit to "
+               "(core/tiered/fleet; `0`/unset = all committed "
+               "scopes); a restricted run refuses --write-protocol "
+               "so the shared pin always covers every scope",
+        read_by="apex_tpu/analysis/protocol_audit.py"),
 ]}
 
 

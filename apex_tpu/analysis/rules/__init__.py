@@ -54,5 +54,6 @@ from apex_tpu.analysis.rules import (  # noqa: E402,F401
     precision,
     prng,
     side_effects,
+    state_mutation,
     step_timing,
 )

@@ -1,0 +1,106 @@
+"""APX112 — serving-state internals mutated from outside the owner.
+
+The protocol audit (``apex-tpu-analyze --protocol``) model-checks the
+conservation laws of ``PageAllocator`` (``_free``/``_refs``),
+``HostPageStore`` (``_slabs``/``_next_handle``) and ``PrefixCache``
+(``_root``/``_clock``/``_alloc``/``_host_store``/``_offload``) — but
+only through their PUBLIC transitions.  Code elsewhere in the package
+that assigns, deletes, or calls a mutating method on one of those
+underscore attributes edits the books behind the model checker's back:
+every pinned invariant would still "pass" while the running system
+diverges from the checked protocol.  Observation is sanctioned through
+the read-only surfaces (``snapshot()`` / ``walk_edges()`` /
+``peek_resident()``); mutation belongs in ``apex_tpu/inference/``.
+Tests are exempt (seeded-violation twins MUST reach in to break the
+books on purpose).
+"""
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from apex_tpu.analysis.rules import Rule, register
+
+#: underscore internals of the model-checked serving components; any
+#: name here is distinctive enough repo-wide that attribute mutation
+#: outside apex_tpu/inference/ is an error, not a coincidence
+_PROTECTED = frozenset({
+    "_free", "_refs",                      # PageAllocator
+    "_slabs", "_next_handle",              # HostPageStore
+    "_root", "_clock", "_alloc", "_host_store", "_offload",
+})
+
+#: method names that mutate a list/dict/set receiver in place
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "setdefault", "popitem", "add", "discard", "sort", "reverse",
+})
+
+
+def _is_test_path(path: str) -> bool:
+    parts = posixpath.normpath(path.replace("\\", "/")).split("/")
+    if any(p in ("tests", "test") for p in parts[:-1]):
+        return True
+    base = parts[-1]
+    return base.startswith("test_") or base.endswith("_test.py")
+
+
+def _is_owner_path(path: str) -> bool:
+    parts = posixpath.normpath(path.replace("\\", "/")).split("/")
+    for i, part in enumerate(parts[:-1]):
+        if part == "apex_tpu" and i + 1 < len(parts) \
+                and parts[i + 1] == "inference":
+            return True
+    return False
+
+
+def _protected_attr(node) -> str:
+    """The protected attribute an expression ultimately mutates:
+    peels subscripts (``alloc._refs[p]``) down to the Attribute."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _PROTECTED:
+        return node.attr
+    return ""
+
+
+@register
+class ServingStateMutation(Rule):
+    id = "APX112"
+    name = "serving-state-mutation"
+    description = ("PageAllocator/HostPageStore/PrefixCache underscore "
+                   "internals mutated outside apex_tpu/inference/ — "
+                   "the protocol audit can't see such edits")
+
+    def check_module(self, ctx):
+        if _is_test_path(ctx.path) or _is_owner_path(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            targets = ()
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = (node.target,)
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                attr = _protected_attr(t)
+                if attr:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"direct write to {attr!r} — a serving-state "
+                        f"internal the protocol audit model-checks; "
+                        f"mutate through the owning class's public "
+                        f"API (apex_tpu/inference/) or observe via "
+                        f"snapshot()/walk_edges()")
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                attr = _protected_attr(node.func.value)
+                if attr:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"in-place {node.func.attr}() on {attr!r} — a "
+                        f"serving-state internal the protocol audit "
+                        f"model-checks; use the owning class's public "
+                        f"API (apex_tpu/inference/)")

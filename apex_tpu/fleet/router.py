@@ -43,6 +43,13 @@ Conservation (``conservation()``, churn-swept by the L1 guard): every
 front-door submit is ROUTED to exactly one replica or SHED at the
 router, Σ per-replica submitted == routed, and each replica's own
 ``submitted == finished + active + rejected`` law keeps holding.
+
+Beyond the churn sweeps, the router's state machine is MODEL-CHECKED:
+the protocol auditor's "fleet" scope (``apex-tpu-analyze --protocol``,
+:mod:`apex_tpu.analysis.protocol_audit`) exhaustively explores
+routing, shedding, wave boundaries, and the abstract cross-replica
+KV-page handoff pair over two real replicas, asserting the
+three-level conservation law at every reachable state.
 """
 from __future__ import annotations
 

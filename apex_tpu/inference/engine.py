@@ -265,6 +265,12 @@ class PendingSwapOut:
         self._batches = batches        # [(k_dev, v_dev, valid_rows)]
         self._resolved = None
 
+    @property
+    def done(self) -> bool:
+        """True once :meth:`resolve` has fetched (the wave-boundary
+        drain or a racing hit already paid the ``device_get``)."""
+        return self._resolved is not None
+
     def resolve(self):
         if self._resolved is None:
             ks = [np.asarray(jax.device_get(k_s))[:m]
@@ -721,6 +727,17 @@ class InferenceEngine:
         with obs.trace_annotation("apex_tpu.inference.cow_page",
                                   src=int(src), dst=int(dst)):
             return self._cow(cache, np.int32(src), np.int32(dst))
+
+    def evict_slot(self, cache, slot: int):
+        """Device-side metadata evict of one slot (paged or dense):
+        zero its length and re-park its page-table row on the trash
+        page so the idle slot's masked decode appends can never land
+        in another request's pages.  The retire half of the engine's
+        device surface — the scheduler releases the slot's page
+        REFERENCES host-side only after this returns, so a stub engine
+        (protocol audit) can mirror the whole lifecycle without a
+        device."""
+        return kv_cache.evict(cache, slot)
 
     def page_host_bytes(self) -> int:
         """Host-DRAM bytes ONE page's k+v slabs occupy in the host

@@ -849,6 +849,17 @@ class PageAllocator:
                 del self._refs[pid]
                 self._free.append(pid)
 
+    def snapshot(self) -> dict:
+        """Read-only copy of the books, the SANCTIONED way to observe
+        allocator internals from outside this package (the APX112 lint
+        rule bans underscore-attribute mutation from anywhere else;
+        the protocol auditor canonicalizes states through this).
+        ``free`` preserves LIFO order — it determines which page the
+        next acquire hands out, so two states whose free lists differ
+        only in order are NOT equivalent."""
+        return {"free": tuple(self._free),
+                "refs": dict(self._refs)}
+
 
 class _DeferredSlab:
     """Placeholder for one page whose device→host drain has been
@@ -961,4 +972,32 @@ class HostPageStore:
         entry = self._slabs.pop(int(handle), None)
         if isinstance(entry, _DeferredSlab):
             entry = entry.materialize()
+        return entry
+
+    def snapshot(self) -> dict:
+        """Read-only view of the ledger, the sanctioned external
+        observation surface (APX112): handle -> ``"resident"`` or
+        ``"deferred"``.  Purely observational — an in-flight deferred
+        entry is NOT materialized (that would force its pending drain
+        and mutate the state being observed); a deferred entry whose
+        pending already resolved counts as resident."""
+        return {int(h): ("resident" if not isinstance(e, _DeferredSlab)
+                         or getattr(e.pending, "done", False)
+                         else "deferred")
+                for h, e in self._slabs.items()}
+
+    def peek_resident(self, handle: int):
+        """The ``(k, v)`` slabs behind ``handle`` if resident (eager,
+        or deferred with its drain already resolved), else None —
+        unlike :meth:`get` this never forces an in-flight drain, so
+        invariant checkers can inspect content without mutating the
+        observable state."""
+        entry = self._slabs.get(int(handle))
+        if entry is None:
+            return None
+        if isinstance(entry, _DeferredSlab):
+            if not getattr(entry.pending, "done", False):
+                return None
+            entry = entry.materialize()
+            self._slabs[int(handle)] = entry
         return entry

@@ -241,6 +241,33 @@ class PrefixCache:
             return 0, 0, 0
         return c, hbm, host
 
+    def walk_edges(self) -> List[dict]:
+        """Deterministic READ-ONLY walk of the radix tree, the
+        sanctioned external observation surface (APX112: outside
+        callers never touch ``_root``): one dict per edge, parents
+        before children, siblings in sorted token order —
+        ``{"path", "tokens", "kind" ("full"|"partial"), "page",
+        "host", "stamp"}``.  The protocol auditor canonicalizes tree
+        states and checks the tier invariant through this; no LRU
+        touch, no clock tick."""
+        out: List[dict] = []
+
+        def walk(node: _Node, path: Tuple[int, ...]):
+            for et in sorted(node.children):
+                edge = node.children[et]
+                out.append({"path": path, "tokens": et, "kind": "full",
+                            "page": edge.page, "host": edge.host,
+                            "stamp": edge.stamp})
+                walk(edge.child, path + et)
+            for et in sorted(node.partials):
+                edge = node.partials[et]
+                out.append({"path": path, "tokens": et,
+                            "kind": "partial", "page": edge.page,
+                            "host": edge.host, "stamp": edge.stamp})
+
+        walk(self._root, ())
+        return out
+
     def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
         """Single-tier view of :meth:`match_tiered` for callers that
         cannot swap in: coverage truncates at the first host-resident

@@ -299,6 +299,13 @@ class SlotScheduler:
         # dangling pointer at zeroed pages.  One allocator, one prefix
         # cache, one device cache — one lifetime.
         self.cache = None
+        # per-wave slot books (begin_run .. finish_run); empty between
+        # waves so run_pending() is False outside one
+        self._wave_open = False
+        self._run_slots: list = []
+        self._run_free: list = []
+        self._run_last = np.zeros((engine.slots,), np.int32)
+        self._run_results: dict = {}
         if self.alloc is not None:
             self.telemetry.pool(self.alloc.free_pages,
                                 self.engine.num_pages)
@@ -531,28 +538,31 @@ class SlotScheduler:
         return row_ids, min(len(row_ids) * ps, eng.max_seq), covered, \
             cow_src, swap_plan
 
-    def run(self, cache=None) -> dict:
-        """Drain the queue; returns ``{uid: generated token list}``.
+    # -- the wave loop, stepwise --------------------------------------------
+    # run() is begin_run() + run_pass() until run_pending() clears +
+    # finish_run().  The split exists so the protocol auditor
+    # (``apex_tpu/analysis/protocol_audit.py``) can drive the SAME
+    # admission/prefill/decode/retire code as discrete model-checking
+    # actions interleaved with submits, evictions and handoffs — the
+    # code being explored is the code that serves.
 
-        One pass of the loop = admit what fits (slots, pages —
-        priority/fairness ordered), advance at most
-        ``max_chunks_per_pass`` prefill chunks, then ONE batched
-        decode step over the decoding slots.  The device sees only the
-        fixed-shape prefill/decode (+COW copy) executables; everything
-        else here is host-side bookkeeping on ints.
-        """
+    def begin_run(self, cache=None) -> None:
+        """Open one wave: telemetry wave marker, cache adoption, fresh
+        per-wave slot books.  ``run()`` calls this once per wave; close
+        with :meth:`finish_run`."""
+        if self._wave_open:
+            raise RuntimeError(
+                "begin_run inside an open wave: finish_run() first")
         eng = self.engine
-        tel = self.telemetry
-        tel.begin_wave()
+        self.telemetry.begin_wave()
         if cache is None:
             if self.cache is None:
                 self.cache = eng.init_cache()
-            cache = self.cache
         elif cache is not self.cache:
             # the allocator and prefix cache index PHYSICAL page ids of
             # the cache this scheduler has been serving — swapping in a
             # foreign cache would turn every cached prefix into a
-            # dangling pointer at garbage pages.  A fresh cache is only
+            # dangling pointer at zeroed pages.  A fresh cache is only
             # adoptable while no page state references the old one.
             if self.alloc is not None and (
                     self.alloc.live_pages > 0
@@ -565,321 +575,365 @@ class SlotScheduler:
                     "different cache while pages are live — build a "
                     "new scheduler instead")
             self.cache = cache
-        slots: list = [None] * eng.slots
-        free = list(range(eng.slots))
-        last = np.zeros((eng.slots,), np.int32)
-        results: dict = {}
+        self._run_slots = [None] * eng.slots
+        self._run_free = list(range(eng.slots))
+        self._run_last = np.zeros((eng.slots,), np.int32)
+        self._run_results = {}
+        self._wave_open = True
 
-        def pool_gauges():
-            tel.pool(self.alloc.free_pages, eng.num_pages)
-            tel.prefix_pages(
-                self.alloc.shared_pages(),
-                self.prefix.pinned_pages if self.prefix is not None
-                else 0)
-            if self.host_store is not None:
-                tel.host_tier(self.host_store.pages,
-                              self.host_store.bytes_used)
-                tel.host_tier_evicted(self.prefix.host_evictions)
+    def run_pending(self) -> bool:
+        """True while the open wave still has queued or in-flight
+        requests — i.e. another :meth:`run_pass` would do work."""
+        return bool(self.queue
+                    or any(s is not None for s in self._run_slots))
 
-        def retire(slot, reason):
-            nonlocal cache
+    @property
+    def wave_open(self) -> bool:
+        """True between :meth:`begin_run` and :meth:`finish_run`."""
+        return self._wave_open
+
+    @property
+    def pending_swaps(self) -> int:
+        """Deferred device→host drain batches not yet resolved — 0
+        outside a wave (the boundary drains them)."""
+        return len(self._pending_swaps)
+
+    def slot_states(self) -> list:
+        """Read-only view of the open wave's slot books: one
+        ``_SlotState`` (or None) per slot — the protocol auditor's
+        observation surface for per-row page holdings."""
+        return list(self._run_slots)
+
+    def finish_run(self) -> dict:
+        """Close the wave: force any deferred eviction drains to land
+        (ISSUE 19 — the dispatches have been pipelining behind the
+        wave's real work; the gets happen here, out of line), close one
+        SLO accounting window (burn rate / budget gauges +
+        slo_violation events off the histogram deltas this wave
+        contributed), then flush snapshot sinks (the Prometheus file is
+        only written on export).  Returns ``{uid: generated tokens}``
+        for the wave."""
+        if not self._wave_open:
+            raise RuntimeError("finish_run without an open wave")
+        self.drain_pending_swaps()
+        self.slo.observe_window()
+        self.telemetry.registry.export()
+        self._wave_open = False
+        results, self._run_results = self._run_results, {}
+        return results
+
+    def _pool_gauges(self) -> None:
+        tel = self.telemetry
+        tel.pool(self.alloc.free_pages, self.engine.num_pages)
+        tel.prefix_pages(
+            self.alloc.shared_pages(),
+            self.prefix.pinned_pages if self.prefix is not None
+            else 0)
+        if self.host_store is not None:
+            tel.host_tier(self.host_store.pages,
+                          self.host_store.bytes_used)
+            tel.host_tier_evicted(self.prefix.host_evictions)
+
+    def _retire(self, slot: int, reason: str) -> None:
+        st = self._run_slots[slot]
+        # token budget may have been crossed by an EOS cut
+        gen = st.generated[:st.max_new_tokens]
+        if st.eos_id is not None and st.eos_id in gen:
+            gen = gen[:gen.index(st.eos_id) + 1]
+            reason = REASON_EOS
+        self._run_results[st.uid] = gen
+        self.finish_reasons[st.uid] = reason
+        if st.pages is not None:
+            # device-side metadata evict BEFORE any page could be
+            # reassigned: it re-parks the slot's page-table row on
+            # the trash page, so the idle slot's masked decode
+            # appends can never land in another request's pages.
+            # Host-side the slot then only RELEASES its references
+            # — a page the prefix cache or a prefix-sharing
+            # neighbour still maps stays live until its LAST owner
+            # lets go (the ISSUE 12 silent-overwrite fix).
+            self.cache = self.engine.evict_slot(self.cache, slot)
+            self.alloc.release(st.pages)
+            self._pool_gauges()
+        self._run_slots[slot] = None
+        self._run_free.append(slot)    # eviction = metadata; insert
+        # on re-admit overwrites the stale cache rows
+        if self.drafter is not None:
+            self.drafter.retire(slot)
+        self.telemetry.request_finished(st.uid, reason, len(gen))
+
+    def _prefill_piece(self, slot: int) -> None:
+        """Advance one slot's prefill by one chunk (or the whole
+        uncached tail when chunking is off / the tail fits)."""
+        eng, tel = self.engine, self.telemetry
+        st = self._run_slots[slot]
+        total = st.prompt_len
+        start = st.prefilled
+        end = (total if not self.prefill_chunk
+               else min(total, start + self.prefill_chunk))
+        with tel.prefill_step(
+                prompt_len=end - start,
+                bucket_len=eng.bucket_for(end - start),
+                uid=st.uid, start_tok=start):
+            self.cache, tok, _ = eng.prefill(
+                self.cache, st.prompt[:end], slot, pages=st.pages,
+                prefill_from=start)
+            tok = int(np.asarray(tok))
+        st.prefilled = end
+        if st.chunked:
+            tel.prefill_chunked(st.uid, start, end - start)
+        if end < total:
+            return                     # more chunks to go
+        # final piece: the sampled token is the request's first
+        tel.first_token(st.uid)
+        st.generated.append(tok)
+        self._run_last[slot] = tok
+        if self.drafter is not None and eng.spec_k:
+            self.drafter.begin(slot, st.prompt, tok)
+        if self.prefix is not None:
+            ps = eng.page_size
+            new = self.prefix.insert(
+                st.prompt, st.pages[:-(-total // ps)])
+            if new:
+                self._pool_gauges()
+        if st.done():
+            self._retire(slot, REASON_LENGTH)
+
+    def _admit_one(self) -> bool:
+        eng, tel = self.engine, self.telemetry
+        i = self._pick_index()
+        row_ids, capacity, covered, cow_src, swap_plan = \
+            self._reservation(self.queue[i])
+        if eng.paged and row_ids is None:
+            tel.backpressured()
+            return False               # out of pages: wait for a retire
+        req = self.queue[i]
+        del self.queue[i]
+        slot = self._run_free.pop()
+        self._admit_clock += 1
+        self._tenant_last_admit[req.tenant] = self._admit_clock
+        if self.prefix is not None:
+            tel.prefix_lookup(covered > 0, covered)
+        tel.request_admitted(
+            req.uid, slot, queue_depth=len(self.queue),
+            pages=len(row_ids) if row_ids is not None else None,
+            tenant=req.tenant, prefix_tokens=covered)
+        if row_ids is not None:
+            self._pool_gauges()
+        if cow_src is not None:
+            # privatize the partially-shared boundary page before
+            # the suffix prefill writes into it mid-page: the copy
+            # lands in the first private page of the reservation.
+            # The source was pinned by _reservation only for the
+            # copy window — the slot's row maps the copy, not it.
+            dst = row_ids[covered // eng.page_size]
+            self.cache = eng.cow_page(self.cache, cow_src, dst)
+            self.alloc.release([cow_src])
+            tel.cow_copied(req.uid, slot, cow_src, dst)
+        if swap_plan is not None:
+            # host-tier hit (ISSUE 18): upload the swapped-out
+            # prefix pages into their freshly acquired rows BEFORE
+            # the tail's first prefill chunk — the batched uploads
+            # queue ahead of the tail's compute and the prefill
+            # attends across the partially-materialized prefix via
+            # prefill_from.  The prefix edges resurrect to HBM at
+            # this request's insert() (the swap-in commit and the
+            # cold-dedup path are the same move).
+            ids, kss, vss = swap_plan
+            self.cache = eng.swap_in_pages(self.cache, ids, kss, vss)
+            tel.page_swapped("in", len(ids), uid=req.uid)
+            tel.prefix_host_hit()
+            self._pool_gauges()
+        n_chunks = (1 if not self.prefill_chunk else
+                    -(-(len(req.prompt) - covered)
+                      // self.prefill_chunk))
+        self._run_slots[slot] = _SlotState(
+            req.uid, [], req.max_new_tokens, req.eos_id,
+            prompt_len=len(req.prompt), capacity=capacity,
+            pages=row_ids, tenant=req.tenant, prompt=req.prompt,
+            prefilled=covered, chunked=n_chunks > 1)
+        return True
+
+    def run_pass(self) -> None:
+        """One pass of the wave loop: admit what fits (slots, pages —
+        priority/fairness ordered), advance at most
+        ``max_chunks_per_pass`` prefill chunks, then ONE batched
+        decode (or verify) step over the decoding slots.  The device
+        sees only the fixed-shape prefill/decode (+COW copy)
+        executables; everything else here is host-side bookkeeping on
+        ints."""
+        eng, tel = self.engine, self.telemetry
+        slots = self._run_slots
+        # SLO load observation (ISSUE 13): one host-side sample per
+        # pass through the overload detector; while the advisory
+        # holds and shedding is armed, the worst-ranked queued
+        # request is rejected (at most one per pass — shedding
+        # relieves pressure, it does not empty the queue)
+        advisory = self.slo.observe_load(
+            queue_depth=len(self.queue),
+            backpressure_total=tel.backpressure_waits.total(),
+            free_pages=(self.alloc.free_pages
+                        if self.alloc is not None else None))
+        if advisory and self.shed_on_overload and self.queue:
+            self._shed_one()
+        # admit: fill free slots from the queue (priority/fairness
+        # ordered — a picked request the pool can't cover yet
+        # blocks this pass rather than being starved)
+        blocked = False
+        while self.queue and self._run_free:
+            if not self._admit_one():
+                blocked = True
+                break
+        # advance prefills.  Chunking off: every pending admission
+        # prefills now (the classic loop).  Chunking on: at most
+        # max_chunks_per_pass chunks run BETWEEN decode steps, so a
+        # long-prompt burst cannot starve in-flight decodes.
+        budget = (self.max_chunks_per_pass if self.prefill_chunk
+                  else eng.slots)
+        chunks = 0
+        for slot in range(eng.slots):
             st = slots[slot]
-            # token budget may have been crossed by an EOS cut
-            gen = st.generated[:st.max_new_tokens]
-            if st.eos_id is not None and st.eos_id in gen:
-                gen = gen[:gen.index(st.eos_id) + 1]
-                reason = REASON_EOS
-            results[st.uid] = gen
-            self.finish_reasons[st.uid] = reason
-            if st.pages is not None:
-                # device-side metadata evict BEFORE any page could be
-                # reassigned: it re-parks the slot's page-table row on
-                # the trash page, so the idle slot's masked decode
-                # appends can never land in another request's pages.
-                # Host-side the slot then only RELEASES its references
-                # — a page the prefix cache or a prefix-sharing
-                # neighbour still maps stays live until its LAST owner
-                # lets go (the ISSUE 12 silent-overwrite fix).
-                cache = kv_cache.evict(cache, slot)
-                self.alloc.release(st.pages)
-                pool_gauges()
-            slots[slot] = None
-            free.append(slot)          # eviction = metadata; insert
-            # on re-admit overwrites the stale cache rows
-            if self.drafter is not None:
-                self.drafter.retire(slot)
-            tel.request_finished(st.uid, reason, len(gen))
-
-        def prefill_piece(slot):
-            """Advance one slot's prefill by one chunk (or the whole
-            uncached tail when chunking is off / the tail fits)."""
-            nonlocal cache
-            st = slots[slot]
-            total = st.prompt_len
-            start = st.prefilled
-            end = (total if not self.prefill_chunk
-                   else min(total, start + self.prefill_chunk))
-            with tel.prefill_step(
-                    prompt_len=end - start,
-                    bucket_len=eng.bucket_for(end - start),
-                    uid=st.uid, start_tok=start):
-                cache, tok, _ = eng.prefill(
-                    cache, st.prompt[:end], slot, pages=st.pages,
-                    prefill_from=start)
-                tok = int(np.asarray(tok))
-            st.prefilled = end
-            if st.chunked:
-                tel.prefill_chunked(st.uid, start, end - start)
-            if end < total:
-                return                 # more chunks to go
-            # final piece: the sampled token is the request's first
-            tel.first_token(st.uid)
-            st.generated.append(tok)
-            last[slot] = tok
-            if self.drafter is not None and eng.spec_k:
-                self.drafter.begin(slot, st.prompt, tok)
-            if self.prefix is not None:
-                ps = eng.page_size
-                new = self.prefix.insert(
-                    st.prompt, st.pages[:-(-total // ps)])
-                if new:
-                    pool_gauges()
-            if st.done():
-                retire(slot, REASON_LENGTH)
-
-        def admit_one() -> bool:
-            nonlocal cache
-            # the host-tier offload closure reads the scheduler's live
-            # cache: sync it before _reservation can trigger eviction
-            self.cache = cache
-            i = self._pick_index()
-            row_ids, capacity, covered, cow_src, swap_plan = \
-                self._reservation(self.queue[i])
-            if eng.paged and row_ids is None:
-                tel.backpressured()
-                return False           # out of pages: wait for a retire
-            req = self.queue[i]
-            del self.queue[i]
-            slot = free.pop()
-            self._admit_clock += 1
-            self._tenant_last_admit[req.tenant] = self._admit_clock
-            if self.prefix is not None:
-                tel.prefix_lookup(covered > 0, covered)
-            tel.request_admitted(
-                req.uid, slot, queue_depth=len(self.queue),
-                pages=len(row_ids) if row_ids is not None else None,
-                tenant=req.tenant, prefix_tokens=covered)
-            if row_ids is not None:
-                pool_gauges()
-            if cow_src is not None:
-                # privatize the partially-shared boundary page before
-                # the suffix prefill writes into it mid-page: the copy
-                # lands in the first private page of the reservation.
-                # The source was pinned by _reservation only for the
-                # copy window — the slot's row maps the copy, not it.
-                dst = row_ids[covered // eng.page_size]
-                cache = eng.cow_page(cache, cow_src, dst)
-                self.alloc.release([cow_src])
-                tel.cow_copied(req.uid, slot, cow_src, dst)
-            if swap_plan is not None:
-                # host-tier hit (ISSUE 18): upload the swapped-out
-                # prefix pages into their freshly acquired rows BEFORE
-                # the tail's first prefill chunk — the batched uploads
-                # queue ahead of the tail's compute and the prefill
-                # attends across the partially-materialized prefix via
-                # prefill_from.  The prefix edges resurrect to HBM at
-                # this request's insert() (the swap-in commit and the
-                # cold-dedup path are the same move).
-                ids, kss, vss = swap_plan
-                cache = eng.swap_in_pages(cache, ids, kss, vss)
-                self.cache = cache
-                tel.page_swapped("in", len(ids), uid=req.uid)
-                tel.prefix_host_hit()
-                pool_gauges()
-            n_chunks = (1 if not self.prefill_chunk else
-                        -(-(len(req.prompt) - covered)
-                          // self.prefill_chunk))
-            slots[slot] = _SlotState(
-                req.uid, [], req.max_new_tokens, req.eos_id,
-                prompt_len=len(req.prompt), capacity=capacity,
-                pages=row_ids, tenant=req.tenant, prompt=req.prompt,
-                prefilled=covered, chunked=n_chunks > 1)
-            return True
-
-        while self.queue or any(s is not None for s in slots):
-            # SLO load observation (ISSUE 13): one host-side sample per
-            # pass through the overload detector; while the advisory
-            # holds and shedding is armed, the worst-ranked queued
-            # request is rejected (at most one per pass — shedding
-            # relieves pressure, it does not empty the queue)
-            advisory = self.slo.observe_load(
-                queue_depth=len(self.queue),
-                backpressure_total=tel.backpressure_waits.total(),
-                free_pages=(self.alloc.free_pages
-                            if self.alloc is not None else None))
-            if advisory and self.shed_on_overload and self.queue:
-                self._shed_one()
-            # admit: fill free slots from the queue (priority/fairness
-            # ordered — a picked request the pool can't cover yet
-            # blocks this pass rather than being starved)
-            blocked = False
-            while self.queue and free:
-                if not admit_one():
-                    blocked = True
-                    break
-            # advance prefills.  Chunking off: every pending admission
-            # prefills now (the classic loop).  Chunking on: at most
-            # max_chunks_per_pass chunks run BETWEEN decode steps, so a
-            # long-prompt burst cannot starve in-flight decodes.
-            budget = (self.max_chunks_per_pass if self.prefill_chunk
-                      else eng.slots)
-            chunks = 0
-            for slot in range(eng.slots):
-                st = slots[slot]
-                if st is None or not st.prefilling():
-                    continue
-                prefill_piece(slot)
-                chunks += 1
-                if chunks >= budget:
-                    break
-            active = np.array(
-                [s is not None and not s.prefilling()
-                 and bool(s.generated) for s in slots], bool)
-            if not active.any():
-                if any(s is not None for s in slots):
-                    continue           # still prefilling: next pass
-                if self.queue:
-                    if not blocked:
-                        # slots opened up mid-pass (a request finished
-                        # at its prefill): admit on the next pass
-                        continue
-                    # nothing running and the picked request still
-                    # can't be admitted: the POOL itself is too small
-                    # (prefix-cache eviction already ran)
-                    req = self.queue[self._pick_index()]
-                    raise RuntimeError(
-                        f"request {req.uid} needs more pages than the "
-                        f"pool frees up (prompt {len(req.prompt)} + "
-                        f"budget {req.max_new_tokens} tokens vs "
-                        f"{self.alloc.free_pages} free pages of "
-                        f"{self.alloc.page_size}); grow num_pages or "
-                        f"shrink the request")
+            if st is None or not st.prefilling():
                 continue
-            # guard: a slot at its capacity cannot take another token.
-            # Lengths are derived host-side (_SlotState.cache_len) — no
-            # device readback in the control loop beyond the sampled
-            # tokens themselves.  The decode step's `truncated` output
-            # is the device-side belt to this suspender.
-            for slot, st in enumerate(slots):
-                if st is not None and active[slot] \
-                        and st.cache_len() >= st.capacity:
-                    retire(slot, REASON_TRUNCATED)
-                    active[slot] = False
-            if not active.any():
-                continue
-            # counted AFTER the capacity guard: peak_active measures
-            # requests that actually decode concurrently this step
-            n_active = int(active.sum())
-            self.peak_active = max(self.peak_active, n_active)
-            if getattr(eng, "spec_k", 0):
-                # speculative wave (ISSUE 15): drafts in, the verify
-                # step scores one (k+1)-slab per slot, accepted drafts
-                # + bonus come out.  The emitted stream is ALWAYS the
-                # target's own greedy stream; rejection already rolled
-                # the device lengths back in-program, and pages were
-                # reserved at admission so nothing is released here.
-                k = eng.spec_k
-                slab = np.zeros((eng.slots, k + 1), np.int32)
-                slab[:, 0] = last
-                slab[:, 1:] = self.drafter.draft_batch(active, k)
-                with tel.verify_step(n_active,
-                                     capacity=eng.slots) as vstep:
-                    cache, toks, n_emit, truncated = eng.verify(
-                        cache, slab, active)
-                    toks = np.asarray(toks)
-                    n_emit = np.asarray(n_emit)
-                    truncated = np.asarray(truncated)
-                    # per-token latency back-channel: the bracket's
-                    # histogram sample divides by mean emitted/slot.
-                    # Clamped the way the consumption loop below will
-                    # clamp (capacity AND token budget) so a final
-                    # short round cannot under-report per-token
-                    # latency; only an eos landing mid-slab (terminal
-                    # for the stream) escapes the host-side mirror.
-                    vstep["tokens"] = float(sum(
-                        min(int(n_emit[s]),
-                            slots[s].capacity - slots[s].cache_len(),
-                            slots[s].max_new_tokens
-                            - len(slots[s].generated))
-                        for s in range(eng.slots)
-                        if slots[s] is not None and active[s]))
-                for slot, st in enumerate(slots):
-                    if st is None or not active[slot]:
-                        continue
-                    # the host capacity mirror clamps exactly like the
-                    # device's advance_by did (same inputs, same min)
-                    remaining = st.capacity - st.cache_len()
-                    usable = int(min(int(n_emit[slot]), remaining))
-                    emitted = []
-                    reason = None
-                    for t in toks[slot, :usable]:
-                        st.generated.append(int(t))
-                        emitted.append(int(t))
-                        if st.done():
-                            reason = REASON_LENGTH
-                            break
-                    # emitted counts tokens that actually reached the
-                    # request (capacity- AND budget-clamped), so
-                    # spec_emitted == tokens_generated minus the
-                    # prefill-sampled firsts — conservation-testable
-                    tel.speculation(k, int(n_emit[slot]) - 1,
-                                    len(emitted))
-                    if emitted:
-                        last[slot] = emitted[-1]
-                        self.drafter.observe(slot, emitted)
-                    if reason is not None:
-                        retire(slot, reason)
-                    elif usable < int(n_emit[slot]) or truncated[slot]:
-                        # capacity cut the emitted stream short
-                        retire(slot, REASON_TRUNCATED)
-                continue
-            # the decode bracket closes after the token host-read the
-            # loop performs anyway, so the histogram sample is the true
-            # per-token latency (dispatch + sync), and its recompile
-            # flag feeds serve_recompiles_total (pinned 0 by tests)
-            with tel.decode_step(n_active, capacity=eng.slots):
-                cache, toks, _, truncated = eng.decode(cache, last,
-                                                       active)
+            self._prefill_piece(slot)
+            chunks += 1
+            if chunks >= budget:
+                break
+        active = np.array(
+            [s is not None and not s.prefilling()
+             and bool(s.generated) for s in slots], bool)
+        if not active.any():
+            if any(s is not None for s in slots):
+                return                 # still prefilling: next pass
+            if self.queue:
+                if not blocked:
+                    # slots opened up mid-pass (a request finished
+                    # at its prefill): admit on the next pass
+                    return
+                # nothing running and the picked request still
+                # can't be admitted: the POOL itself is too small
+                # (prefix-cache eviction already ran)
+                req = self.queue[self._pick_index()]
+                raise RuntimeError(
+                    f"request {req.uid} needs more pages than the "
+                    f"pool frees up (prompt {len(req.prompt)} + "
+                    f"budget {req.max_new_tokens} tokens vs "
+                    f"{self.alloc.free_pages} free pages of "
+                    f"{self.alloc.page_size}); grow num_pages or "
+                    f"shrink the request")
+            return
+        # guard: a slot at its capacity cannot take another token.
+        # Lengths are derived host-side (_SlotState.cache_len) — no
+        # device readback in the control loop beyond the sampled
+        # tokens themselves.  The decode step's `truncated` output
+        # is the device-side belt to this suspender.
+        for slot, st in enumerate(slots):
+            if st is not None and active[slot] \
+                    and st.cache_len() >= st.capacity:
+                self._retire(slot, REASON_TRUNCATED)
+                active[slot] = False
+        if not active.any():
+            return
+        # counted AFTER the capacity guard: peak_active measures
+        # requests that actually decode concurrently this step
+        n_active = int(active.sum())
+        self.peak_active = max(self.peak_active, n_active)
+        if getattr(eng, "spec_k", 0):
+            # speculative wave (ISSUE 15): drafts in, the verify
+            # step scores one (k+1)-slab per slot, accepted drafts
+            # + bonus come out.  The emitted stream is ALWAYS the
+            # target's own greedy stream; rejection already rolled
+            # the device lengths back in-program, and pages were
+            # reserved at admission so nothing is released here.
+            k = eng.spec_k
+            slab = np.zeros((eng.slots, k + 1), np.int32)
+            slab[:, 0] = self._run_last
+            slab[:, 1:] = self.drafter.draft_batch(active, k)
+            with tel.verify_step(n_active,
+                                 capacity=eng.slots) as vstep:
+                self.cache, toks, n_emit, truncated = eng.verify(
+                    self.cache, slab, active)
                 toks = np.asarray(toks)
+                n_emit = np.asarray(n_emit)
                 truncated = np.asarray(truncated)
+                # per-token latency back-channel: the bracket's
+                # histogram sample divides by mean emitted/slot.
+                # Clamped the way the consumption loop below will
+                # clamp (capacity AND token budget) so a final
+                # short round cannot under-report per-token
+                # latency; only an eos landing mid-slab (terminal
+                # for the stream) escapes the host-side mirror.
+                vstep["tokens"] = float(sum(
+                    min(int(n_emit[s]),
+                        slots[s].capacity - slots[s].cache_len(),
+                        slots[s].max_new_tokens
+                        - len(slots[s].generated))
+                    for s in range(eng.slots)
+                    if slots[s] is not None and active[s]))
             for slot, st in enumerate(slots):
                 if st is None or not active[slot]:
                     continue
-                if truncated[slot]:
-                    # the host guard above should have retired this
-                    # slot first; trust the device flag regardless
-                    retire(slot, REASON_TRUNCATED)
-                    continue
-                st.generated.append(int(toks[slot]))
-                last[slot] = toks[slot]
-                if st.done():
-                    retire(slot, REASON_LENGTH)
-        # the (donation-threaded) cache carries into the next wave —
-        # cached prefix pages stay valid across run() calls
-        self.cache = cache
-        # wave boundary: force any deferred eviction drains to land
-        # (ISSUE 19) — the dispatches have been pipelining behind the
-        # wave's real work; the gets happen here, out of line
-        self.drain_pending_swaps()
-        # wave boundary: close one SLO accounting window (burn rate /
-        # budget gauges + slo_violation events off the histogram deltas
-        # this wave contributed), then flush snapshot sinks (the
-        # Prometheus file is only written on export — without this,
-        # APEX_TPU_TELEMETRY would produce the JSONL stream but never
-        # metrics.prom)
-        self.slo.observe_window()
-        tel.registry.export()
-        return results
+                # the host capacity mirror clamps exactly like the
+                # device's advance_by did (same inputs, same min)
+                remaining = st.capacity - st.cache_len()
+                usable = int(min(int(n_emit[slot]), remaining))
+                emitted = []
+                reason = None
+                for t in toks[slot, :usable]:
+                    st.generated.append(int(t))
+                    emitted.append(int(t))
+                    if st.done():
+                        reason = REASON_LENGTH
+                        break
+                # emitted counts tokens that actually reached the
+                # request (capacity- AND budget-clamped), so
+                # spec_emitted == tokens_generated minus the
+                # prefill-sampled firsts — conservation-testable
+                tel.speculation(k, int(n_emit[slot]) - 1,
+                                len(emitted))
+                if emitted:
+                    self._run_last[slot] = emitted[-1]
+                    self.drafter.observe(slot, emitted)
+                if reason is not None:
+                    self._retire(slot, reason)
+                elif usable < int(n_emit[slot]) or truncated[slot]:
+                    # capacity cut the emitted stream short
+                    self._retire(slot, REASON_TRUNCATED)
+            return
+        # the decode bracket closes after the token host-read the
+        # loop performs anyway, so the histogram sample is the true
+        # per-token latency (dispatch + sync), and its recompile
+        # flag feeds serve_recompiles_total (pinned 0 by tests)
+        with tel.decode_step(n_active, capacity=eng.slots):
+            self.cache, toks, _, truncated = eng.decode(
+                self.cache, self._run_last, active)
+            toks = np.asarray(toks)
+            truncated = np.asarray(truncated)
+        for slot, st in enumerate(slots):
+            if st is None or not active[slot]:
+                continue
+            if truncated[slot]:
+                # the host guard above should have retired this
+                # slot first; trust the device flag regardless
+                self._retire(slot, REASON_TRUNCATED)
+                continue
+            st.generated.append(int(toks[slot]))
+            self._run_last[slot] = toks[slot]
+            if st.done():
+                self._retire(slot, REASON_LENGTH)
+
+    def run(self, cache=None) -> dict:
+        """Drain the queue; returns ``{uid: generated token list}``.
+
+        One :meth:`begin_run`, :meth:`run_pass` until the queue and
+        slots drain, one :meth:`finish_run` — the wave boundary.  The
+        (donation-threaded) cache carries into the next wave, so
+        cached prefix pages stay valid across ``run()`` calls.
+        """
+        self.begin_run(cache)
+        while self.run_pending():
+            self.run_pass()
+        return self.finish_run()
 
 
 def generate(engine, prompts, max_new_tokens: int = 16,

@@ -233,6 +233,22 @@ def scale(x):
     )(x)
 ''',
     ),
+    "APX112": (
+        '''
+def force_reclaim(scheduler, pages):
+    # reach into the allocator's books to "free" pages directly
+    for p in pages:
+        scheduler.alloc._refs.pop(p, None)
+        scheduler.alloc._free.append(p)
+    scheduler.prefix._clock = 0
+''',
+        '''
+def force_reclaim(scheduler, n):
+    # go through the owner's public transitions; observe via snapshot()
+    freed = scheduler.prefix.evict_lru(n)
+    return freed, scheduler.alloc.snapshot()
+''',
+    ),
     "APX109": (
         '''
 import jax

@@ -1,0 +1,255 @@
+"""Seeded-violation tests for the serving control-plane protocol
+auditor: each invariant family (APX401–APX407) must actually FIRE on a
+deliberately broken component twin — double release, release-before-
+extract swap ordering, skipped COW on a shared boundary page, dangling
+deferred slab, broken handoff ordering — with a MINIMIZED counterexample
+that replays from its repro file to the same finding; and the clean
+components must explore violation-free with exactly the pinned
+canonical state counts.  The twins subclass the REAL classes and break
+one protocol rule each, so these tests double as documentation of what
+each invariant guards against."""
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+from apex_tpu.analysis.protocol_audit import (SCOPES, audit_scope,
+                                              check_harness,
+                                              replay_repro)
+from apex_tpu.analysis.protocol_model import (ProtocolHarness, Scope,
+                                              StubEngine, Template,
+                                              _tag, random_walk,
+                                              replay, write_repro)
+from apex_tpu.inference.kv_cache import PageAllocator
+from apex_tpu.inference.scheduler import SlotScheduler
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+PIN = REPO_ROOT / ".analysis_protocol.json"
+
+
+# ---------------------------------------------------------------------------
+# broken twins — each violates exactly one protocol rule
+# ---------------------------------------------------------------------------
+
+class _DoubleReleaseAllocator(PageAllocator):
+    """Release ignores sharing: dropping a slot's reference also drops
+    any OTHER holder's reference — the classic double-release.  Pages
+    the prefix cache still indexes go back on the free list."""
+
+    def release(self, ids):
+        super().release(ids)
+        for p in ids:
+            if p in self._refs:
+                super().release([p])
+
+
+class _DoubleReleaseEngine(StubEngine):
+    def new_allocator(self):
+        return _DoubleReleaseAllocator(
+            self.num_pages, self.page_size, self.max_pages_per_slot)
+
+
+class _LazyPendingSwapOut:
+    """Snapshots at RESOLVE time instead of dispatch time — the
+    release-before-extract ordering bug: pages freed after the
+    dispatch can be reacquired and overwritten before the drain."""
+
+    def __init__(self, cache, ids):
+        self._cache, self._ids = cache, ids
+        self._resolved = None
+
+    @property
+    def done(self):
+        return self._resolved is not None
+
+    def resolve(self):
+        if self._resolved is None:
+            k = np.array([[int(self._cache.content[p])]
+                          for p in self._ids], np.int64)
+            self._resolved = (k, k.copy())
+        return self._resolved
+
+
+class _LazyExtractEngine(StubEngine):
+    def swap_out_pages(self, cache, page_ids, defer=False):
+        pending = _LazyPendingSwapOut(cache,
+                                      [int(p) for p in page_ids])
+        self.pending_log.append(pending)
+        if defer:
+            return pending
+        return pending.resolve()
+
+
+class _SkipCowScheduler(SlotScheduler):
+    """Maps the shared boundary page straight into the new row instead
+    of privatizing it: the admitted request then writes mid-page into
+    a page the original owner (and the cache) still trust."""
+
+    def _reservation(self, req):
+        row_ids, capacity, covered, cow_src, swap_plan = \
+            super()._reservation(req)
+        if cow_src is not None and row_ids is not None:
+            dst_ord = covered // self.engine.page_size
+            self.alloc.release([row_ids[dst_ord]])
+            row_ids[dst_ord] = cow_src
+            cow_src = None          # admission skips the copy
+        return row_ids, capacity, covered, cow_src, swap_plan
+
+
+class _NoDrainScheduler(SlotScheduler):
+    """drain_pending_swaps is a no-op: deferred device->host drains
+    are never resolved, so finish_run closes the wave with the
+    dispatch queue still holding unfetched extracts."""
+
+    def drain_pending_swaps(self):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# harness builders
+# ---------------------------------------------------------------------------
+
+def _engine_factory(cls):
+    return lambda sc: cls(
+        slots=sc.slots, num_pages=sc.num_pages,
+        page_size=sc.page_size,
+        max_pages_per_slot=sc.max_pages_per_slot,
+        host_tier_pages=sc.host_tier_pages)
+
+
+def _twin_checks(tmp_path, scope, build, expect_code, repro_name):
+    """Shared twin assertions: the exploration finds a violation
+    naming ``expect_code``, the counterexample is 1-minimal, and the
+    written repro replays to the same primary finding."""
+    res = audit_scope(scope, build=build)
+    assert res.violation is not None, \
+        f"broken twin explored clean ({res.states} states)"
+    vio = res.violation
+    assert expect_code in vio.codes, \
+        f"expected {expect_code} among {vio.codes}: {vio.messages}"
+    assert len(vio.trace) >= 1
+    # 1-minimality: no single action can be deleted and still fire
+    # the same primary code (shrink ran to fixpoint)
+    primary = vio.codes[0]
+    for i in range(len(vio.trace)):
+        cand = vio.trace[:i] + vio.trace[i + 1:]
+        _h, v2 = replay(build, cand, check_harness)
+        assert v2 is None or v2.codes[0] != primary, \
+            f"trace not minimal: action {i} ({vio.trace[i]}) removable"
+    # the repro file replays to the same finding
+    repro = tmp_path / repro_name
+    write_repro(repro, scope, vio)
+    replayed = replay_repro(repro, build=build)
+    assert replayed is not None
+    assert replayed.codes[0] == primary
+    return vio
+
+
+# ---------------------------------------------------------------------------
+# seeded violations
+# ---------------------------------------------------------------------------
+
+def test_double_release_names_dangling_refs(tmp_path):
+    scope = SCOPES["core"]
+    build = lambda: ProtocolHarness(
+        scope, engine_factory=_engine_factory(_DoubleReleaseEngine))
+    vio = _twin_checks(tmp_path, scope, build, "APX404",
+                       "repro_double_release.json")
+    # the same bug breaks the weighted books too
+    assert any(c in ("APX402", "APX403") for c in vio.codes)
+
+
+def test_release_before_extract_names_slab_content(tmp_path):
+    scope = SCOPES["tiered"]
+    build = lambda: ProtocolHarness(
+        scope, engine_factory=_engine_factory(_LazyExtractEngine))
+    vio = _twin_checks(tmp_path, scope, build, "APX405",
+                       "repro_lazy_extract.json")
+    assert "does not match its tokens" in " ".join(vio.messages)
+
+
+def test_skipped_cow_names_row_content(tmp_path):
+    scope = SCOPES["core"]
+    build = lambda: ProtocolHarness(
+        scope, scheduler_factory=_SkipCowScheduler)
+    vio = _twin_checks(tmp_path, scope, build, "APX403",
+                       "repro_skip_cow.json")
+    assert "clobbered" in " ".join(vio.messages)
+
+
+def test_dangling_deferred_slab_names_wave_boundary(tmp_path):
+    scope = SCOPES["tiered"]
+    build = lambda: ProtocolHarness(
+        scope, scheduler_factory=_NoDrainScheduler)
+    _twin_checks(tmp_path, scope, build, "APX407",
+                 "repro_no_drain.json")
+
+
+def test_broken_handoff_ordering_names_wave_boundary(tmp_path):
+    scope = SCOPES["fleet"]
+    build = lambda: ProtocolHarness(
+        scope, abort_transit_on_end_wave=False)
+    vio = _twin_checks(tmp_path, scope, build, "APX407",
+                       "repro_broken_handoff.json")
+    assert "handoff" in " ".join(vio.messages)
+
+
+# ---------------------------------------------------------------------------
+# clean twins: violation-free with the PINNED state counts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(SCOPES))
+def test_clean_scope_matches_pin(name):
+    res = audit_scope(SCOPES[name])
+    assert res.violation is None, \
+        f"{name}: {res.violation and res.violation.messages}"
+    assert not res.truncated
+    pinned = json.loads(PIN.read_text())["scopes"][name]
+    assert res.states == pinned["states"]
+    assert res.transitions == pinned["transitions"]
+
+
+def test_exploration_is_deterministic():
+    a = audit_scope(SCOPES["fleet"])
+    b = audit_scope(SCOPES["fleet"])
+    assert (a.states, a.transitions) == (b.states, b.transitions)
+
+
+# ---------------------------------------------------------------------------
+# slow lane: seeded random long walk one notch above the exhaustive pin
+# ---------------------------------------------------------------------------
+
+# Bigger than anything exhaustive exploration can cover: more slots
+# than "core", a host tier AND COW sharing in the same scope, a third
+# prefix depth (C extends A's (1, 2)), and page pressure (3 slots x 4
+# pages > 10 pages forces admission deferral).  Handoff stays out:
+# it needs replicas > 1, where the harness caps total submits below
+# the router's queue detector threshold — far too few for a long
+# walk (the "fleet" scope covers handoff exhaustively instead).
+_WALK_SCOPE = Scope(
+    name="walk", replicas=1, slots=3, num_pages=10, page_size=2,
+    max_pages_per_slot=4, host_tier_pages=3, prefill_chunk=2,
+    max_chunks_per_pass=2, shed=True,
+    evict_sizes=(1, 2), evict_cap=500,
+    templates=(
+        Template("A", (1, 2, 3), max_new_tokens=4, cap=500),
+        Template("A2", (1, 2, 3, 4), max_new_tokens=3, cap=500),
+        Template("B", (5, 6), max_new_tokens=2, tenant="t2", cap=500),
+        Template("C", (1, 2, 5, 6, 7), max_new_tokens=2, cap=500),
+    ))
+
+
+@pytest.mark.slow
+def test_random_long_walk_above_pinned_scope():
+    # With 2000 submits of headroom, submit is enabled at every step,
+    # so the walk never runs out of actions: exactly 2000 applied,
+    # every invariant checked after each one.
+    applied = random_walk(lambda: ProtocolHarness(_WALK_SCOPE),
+                          check_harness, steps=2000, seed=20260807)
+    assert applied == 2000

@@ -1,0 +1,145 @@
+"""Tier-1 gate: ``apex-tpu-analyze --protocol`` explores every
+committed small scope clean in seconds, the committed
+``.analysis_protocol.json`` is BIT-identical to a fresh run (canonical
+hashing is deterministic end to end), the ratchet fires on an injected
+pin drift, scope-restricted runs refuse ``--write-protocol``, and the
+pinned invariant battery covers every conservation law the L0 churn
+sweeps assert wave-by-wave — the model checker can never quietly
+check less than the runtime tests do."""
+import json
+
+import pytest
+
+from apex_tpu.analysis.cli import main, repo_root
+from apex_tpu.analysis.protocol_audit import (INVARIANTS, PIN_NAME,
+                                              run_protocol_audit)
+
+REPO = repo_root()
+
+# The conservation laws the L0 churn sweeps walk step-by-step
+# (tests/L0/run_inference/: test_paged_kv_cache, test_prefix_sharing,
+# test_host_tier, test_deferred_swap, test_scheduler,
+# test_fleet_router).  Every one must be owned by a pinned invariant.
+CHURN_SWEEP_LAWS = {
+    "allocator-conservation",            # live + free == num_pages
+    "refcount-weighted-conservation",    # refs == rows + cache pins
+    "share-ref-matching",                # holder count == refcount
+    "cow-write-isolation",               # writers never touch shared
+    "no-dangling-page-refs",             # no freed page referenced
+    "prefix-pin-books",                  # pinned_pages bookkeeping
+    "host-tier-shape",                   # page XOR host per edge
+    "host-byte-budget",                  # bytes_used <= capacity
+    "host-mirror",                       # prefix.host_pages == store
+    "lifecycle-conservation",            # submitted == fin+act+rej
+    "wave-boundary-swaps",               # no pending across a wave
+    "fleet-three-level",                 # router/replica/fleet books
+}
+
+
+@pytest.fixture(scope="module")
+def fresh():
+    findings, report = run_protocol_audit()
+    return findings, report
+
+
+def test_protocol_cli_clean_json_schema(capsys):
+    """One in-process run gates the engine: all committed scopes
+    explored violation-free against the committed pin, and the
+    documented --json schema (the "protocol" key)."""
+    rc = main(["--protocol", "--no-lint", "--no-jaxpr", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["new"]
+    assert set(out) == {"new", "suppressed", "total", "protocol"}
+    assert out["new"] == []
+    scopes = out["protocol"]["scopes"]
+    assert set(scopes) == {"core", "tiered", "fleet"}
+    for name, entry in scopes.items():
+        assert entry["violations"] == 0, name
+        assert entry["states"] > 0 and entry["transitions"] > 0
+        assert {"states", "transitions", "depth", "violations",
+                "config"} <= set(entry), name
+    # the disaggregation handoff pair is part of the pinned CLEAN
+    # scope — ROADMAP item 1's protocol is model-checked, not just
+    # reachable
+    assert scopes["fleet"]["config"]["handoff"] is True
+    assert scopes["fleet"]["config"]["replicas"] == 2
+
+
+def test_committed_pin_bit_identical_to_fresh_run(fresh):
+    """Exploration is deterministic down to the serialized byte: the
+    committed pin equals a fresh report rendered with the writer's
+    exact formatting.  Any nondeterminism (hash ordering, wall clock,
+    stray RNG) breaks this first."""
+    findings, report = fresh
+    assert findings == []
+    rendered = json.dumps(report, indent=1, sort_keys=True) + "\n"
+    assert (REPO / PIN_NAME).read_text(encoding="utf-8") == rendered
+
+
+def test_ratchet_fires_on_injected_drift(tmp_path, fresh, capsys):
+    """A doctored pin (yesterday's run saw fewer states) must FAIL the
+    run with APX400; re-pinning to the doctored file clears it."""
+    _, report = fresh
+    doctored = json.loads(json.dumps(report))
+    doctored["scopes"]["fleet"]["states"] -= 1
+    pin = tmp_path / "protocol_pin.json"
+    pin.write_text(json.dumps(doctored))
+
+    args = ["--protocol", "--no-lint", "--no-jaxpr",
+            "--protocol-pin", str(pin)]
+    rc = main(args)
+    out = capsys.readouterr().out
+    assert rc == 1 and "APX400" in out
+
+    assert main(args + ["--write-protocol"]) == 0
+    capsys.readouterr()
+    assert main(args) == 0
+
+
+def test_missing_pin_is_a_finding(tmp_path, capsys):
+    rc = main(["--protocol", "--no-lint", "--no-jaxpr",
+               "--protocol-pin", str(tmp_path / "absent.json")])
+    out = capsys.readouterr().out
+    assert rc == 1 and "APX400" in out
+
+
+def test_write_protocol_refuses_restricted_scope(capsys):
+    """A --protocol-scope run must not replace the shared pin: the
+    dropped scopes' proof obligations would silently vanish.  The
+    refusal is validated BEFORE exploring (instant), rc 2."""
+    rc = main(["--no-lint", "--no-jaxpr",
+               "--protocol-scope", "fleet", "--write-protocol"])
+    assert rc == 2
+
+
+def test_env_scope_restriction_and_write_refusal(monkeypatch, capsys):
+    """APEX_TPU_PROTOCOL_SCOPE restricts the run (registered knob) and
+    a knob-restricted run refuses --write-protocol exactly like the
+    flag-restricted one."""
+    monkeypatch.setenv("APEX_TPU_PROTOCOL_SCOPE", "fleet")
+    rc = main(["--protocol", "--no-lint", "--no-jaxpr", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(out["protocol"]["scopes"]) == {"fleet"}
+    assert main(["--no-lint", "--no-jaxpr", "--write-protocol"]) == 2
+
+
+def test_unknown_scope_is_arg_error(capsys):
+    assert main(["--protocol", "--no-lint", "--no-jaxpr",
+                 "--protocol-scope", "galaxy"]) == 2
+
+
+def test_invariants_cover_every_churn_sweep_law():
+    """The pinned battery can never check LESS than the runtime
+    sweeps: every churn-sweep conservation law is owned by exactly
+    one APX4xx invariant."""
+    assert sorted(INVARIANTS) == [f"APX40{i}" for i in range(1, 8)]
+    owners = {}
+    for code, inv in INVARIANTS.items():
+        assert inv["name"] and inv["description"]
+        for law in inv["covers"]:
+            assert law not in owners, \
+                f"{law} claimed by {owners[law]} and {code}"
+            owners[law] = code
+    missing = CHURN_SWEEP_LAWS - set(owners)
+    assert not missing, f"churn-sweep laws with no invariant: {missing}"
